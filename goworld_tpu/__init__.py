@@ -1,0 +1,19 @@
+"""goworld_tpu — a TPU-native distributed game-server framework.
+
+A from-scratch rebuild of the capability surface of GoWorld
+(reference: /root/reference, a pure-Go distributed game server engine;
+see doc.go:6-13) re-designed TPU-first:
+
+- Control plane: asyncio processes (dispatcher / gate / game) speaking a
+  framed msgpack protocol (reference: engine/netutil, engine/proto).
+- Compute plane: the per-Space AOI (area-of-interest) hot loop
+  (reference: engine/entity/Space.go:211-259 + xiaonanln/go-aoi) runs as
+  batched JAX/Pallas spatial-hash neighbor kernels on TPU, with
+  jax.sharding/shard_map for multi-chip position all-gather.
+
+The public facade mirrors the reference's ``goworld.go`` (goworld.go:17-256).
+"""
+
+__version__ = "0.1.0"
+
+from goworld_tpu.facade import *  # noqa: F401,F403

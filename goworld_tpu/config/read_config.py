@@ -1,0 +1,310 @@
+"""INI configuration shared by every process in a deployment.
+
+Reference parity: ``engine/config/read_config.go`` — one ``goworld.ini`` read
+by dispatchers, gates, games and the CLI. Sections (read_config.go:239-314):
+
+- ``[deployment]``: desired process counts — also the readiness barrier
+  (DispatcherService.go:446-476).
+- ``[dispatcherN]`` / ``[gameN]`` / ``[gateN]``: per-process sections, each
+  inheriting defaults from ``[dispatcher_common]`` / ``[game_common]`` /
+  ``[gate_common]`` (read_config.go:316-470).
+- ``[storage]``, ``[kvdb]``, ``[debug]``.
+
+TPU addition: ``[aoi]`` configures the compute plane (backend, capacities,
+device mesh axis sizes) — no reference analog.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import threading
+from typing import Optional
+
+DEFAULT_CONFIG_FILES = ("goworld.ini",)
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    desired_games: int = 1
+    desired_gates: int = 1
+    desired_dispatchers: int = 1
+
+
+@dataclasses.dataclass
+class DispatcherConfig:
+    host: str = "127.0.0.1"
+    port: int = 0
+    http_addr: str = ""
+    log_file: str = ""
+    log_level: str = "info"
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+
+@dataclasses.dataclass
+class GameConfig:
+    boot_entity: str = ""
+    save_interval: float = 300.0
+    http_addr: str = ""
+    log_file: str = ""
+    log_level: str = "info"
+    position_sync_interval: float = 0.1  # server→client cadence (read_config.go:328)
+
+
+@dataclasses.dataclass
+class GateConfig:
+    host: str = "127.0.0.1"
+    port: int = 0
+    ws_addr: str = ""  # websocket listen addr ("host:port" or "")
+    http_addr: str = ""
+    log_file: str = ""
+    log_level: str = "info"
+    compress_connection: bool = False
+    encrypt_connection: bool = False
+    rsa_key: str = ""
+    rsa_cert: str = ""
+    heartbeat_timeout: float = 30.0
+    position_sync_interval: float = 0.1  # client→server coalescing cadence
+
+    @property
+    def addr(self) -> tuple[str, int]:
+        return (self.host, self.port)
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    type: str = "filesystem"
+    directory: str = "_entity_storage"  # filesystem backend
+    url: str = ""  # network backends
+    db: str = "goworld"
+
+
+@dataclasses.dataclass
+class KVDBConfig:
+    type: str = "filesystem"
+    directory: str = "_kvdb"
+    url: str = ""
+    db: str = "goworld"
+    collection: str = "kvdb"
+
+
+@dataclasses.dataclass
+class AOIConfig:
+    """TPU compute-plane knobs (no reference analog; see SURVEY.md §7)."""
+
+    backend: str = "auto"  # auto | xzlist | tpu
+    max_neighbors: int = 128
+    cell_capacity: int = 64
+    max_entities: int = 16384  # padded capacity of the batched engine
+    mesh_shards: int = 1  # entity-shard axis over devices
+
+
+@dataclasses.dataclass
+class DebugConfig:
+    debug: bool = False
+
+
+@dataclasses.dataclass
+class GoWorldConfig:
+    deployment: DeploymentConfig = dataclasses.field(default_factory=DeploymentConfig)
+    dispatchers: dict[int, DispatcherConfig] = dataclasses.field(default_factory=dict)
+    games: dict[int, GameConfig] = dataclasses.field(default_factory=dict)
+    gates: dict[int, GateConfig] = dataclasses.field(default_factory=dict)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    kvdb: KVDBConfig = dataclasses.field(default_factory=KVDBConfig)
+    aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
+    debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
+
+
+_lock = threading.Lock()
+_config_file: Optional[str] = None
+_config: Optional[GoWorldConfig] = None
+
+
+def set_config_file(path: str) -> None:
+    global _config_file, _config
+    with _lock:
+        _config_file = path
+        _config = None
+
+
+def set_config(cfg: GoWorldConfig) -> None:
+    """Inject a config object directly (tests / embedded clusters)."""
+    global _config
+    with _lock:
+        _config = cfg
+
+
+def get() -> GoWorldConfig:
+    global _config
+    with _lock:
+        if _config is None:
+            _config = _load(_config_file)
+        return _config
+
+
+def reload() -> GoWorldConfig:
+    global _config
+    with _lock:
+        _config = _load(_config_file)
+        return _config
+
+
+def _load(path: Optional[str]) -> GoWorldConfig:
+    cp = configparser.ConfigParser()
+    if path is not None:
+        read = cp.read(path)
+        if not read:
+            raise FileNotFoundError(f"config file not found: {path}")
+    else:
+        cp.read(DEFAULT_CONFIG_FILES)
+
+    cfg = GoWorldConfig()
+
+    if cp.has_section("deployment"):
+        s = cp["deployment"]
+        cfg.deployment = DeploymentConfig(
+            desired_games=s.getint("games", 1),
+            desired_gates=s.getint("gates", 1),
+            desired_dispatchers=s.getint("dispatchers", 1),
+        )
+
+    def merged(section: str, common: str) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if cp.has_section(common):
+            out.update(cp[common])
+        if cp.has_section(section):
+            out.update(cp[section])
+        return out
+
+    for i in range(1, cfg.deployment.desired_dispatchers + 1):
+        s = merged(f"dispatcher{i}", "dispatcher_common")
+        cfg.dispatchers[i] = DispatcherConfig(
+            host=s.get("host", "127.0.0.1"),
+            port=int(s.get("port", 14000 + i)),
+            http_addr=s.get("http_addr", ""),
+            log_file=s.get("log_file", ""),
+            log_level=s.get("log_level", "info"),
+        )
+
+    for i in range(1, cfg.deployment.desired_games + 1):
+        s = merged(f"game{i}", "game_common")
+        cfg.games[i] = GameConfig(
+            boot_entity=s.get("boot_entity", ""),
+            save_interval=float(s.get("save_interval", 300)),
+            http_addr=s.get("http_addr", ""),
+            log_file=s.get("log_file", ""),
+            log_level=s.get("log_level", "info"),
+            position_sync_interval=float(s.get("position_sync_interval", 0.1)),
+        )
+
+    for i in range(1, cfg.deployment.desired_gates + 1):
+        s = merged(f"gate{i}", "gate_common")
+        cfg.gates[i] = GateConfig(
+            host=s.get("host", "127.0.0.1"),
+            port=int(s.get("port", 15000 + i)),
+            ws_addr=s.get("ws_addr", ""),
+            http_addr=s.get("http_addr", ""),
+            log_file=s.get("log_file", ""),
+            log_level=s.get("log_level", "info"),
+            compress_connection=s.get("compress_connection", "false").lower() in ("1", "true", "yes"),
+            encrypt_connection=s.get("encrypt_connection", "false").lower() in ("1", "true", "yes"),
+            rsa_key=s.get("rsa_key", ""),
+            rsa_cert=s.get("rsa_cert", ""),
+            heartbeat_timeout=float(s.get("heartbeat_timeout", 30)),
+            position_sync_interval=float(s.get("position_sync_interval", 0.1)),
+        )
+
+    if cp.has_section("storage"):
+        s = cp["storage"]
+        cfg.storage = StorageConfig(
+            type=s.get("type", "filesystem"),
+            directory=s.get("directory", "_entity_storage"),
+            url=s.get("url", ""),
+            db=s.get("db", "goworld"),
+        )
+    if cp.has_section("kvdb"):
+        s = cp["kvdb"]
+        cfg.kvdb = KVDBConfig(
+            type=s.get("type", "filesystem"),
+            directory=s.get("directory", "_kvdb"),
+            url=s.get("url", ""),
+            db=s.get("db", "goworld"),
+            collection=s.get("collection", "kvdb"),
+        )
+    if cp.has_section("aoi"):
+        s = cp["aoi"]
+        cfg.aoi = AOIConfig(
+            backend=s.get("backend", "auto"),
+            max_neighbors=int(s.get("max_neighbors", 128)),
+            cell_capacity=int(s.get("cell_capacity", 64)),
+            max_entities=int(s.get("max_entities", 16384)),
+            mesh_shards=int(s.get("mesh_shards", 1)),
+        )
+    if cp.has_section("debug"):
+        cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
+
+    _validate(cfg)
+    return cfg
+
+
+def _validate(cfg: GoWorldConfig) -> None:
+    """Sanity checks, mirroring read_config.go:538-661."""
+    if cfg.deployment.desired_dispatchers < 1:
+        raise ValueError("deployment.dispatchers must be >= 1")
+    if cfg.deployment.desired_games < 1:
+        raise ValueError("deployment.games must be >= 1")
+    seen: dict[tuple[str, int], str] = {}
+    for did, d in cfg.dispatchers.items():
+        key = (d.host, d.port)
+        if key in seen:
+            raise ValueError(f"dispatcher{did} addr {key} duplicates {seen[key]}")
+        seen[key] = f"dispatcher{did}"
+    for gid, g in cfg.gates.items():
+        key = (g.host, g.port)
+        if key in seen:
+            raise ValueError(f"gate{gid} addr {key} duplicates {seen[key]}")
+        seen[key] = f"gate{gid}"
+        if g.encrypt_connection and not (g.rsa_key and g.rsa_cert):
+            raise ValueError(f"gate{gid}: encrypt_connection requires rsa_key and rsa_cert")
+
+
+# --- typed accessors (reference read_config.go:178-214) ---------------------
+
+def get_deployment() -> DeploymentConfig:
+    return get().deployment
+
+
+def get_game(gameid: int) -> GameConfig:
+    return get().games[gameid]
+
+
+def get_gate(gateid: int) -> GateConfig:
+    return get().gates[gateid]
+
+
+def get_dispatcher(dispid: int) -> DispatcherConfig:
+    return get().dispatchers[dispid]
+
+
+def get_game_ids() -> list[int]:
+    return sorted(get().games)
+
+
+def get_gate_ids() -> list[int]:
+    return sorted(get().gates)
+
+
+def get_dispatcher_ids() -> list[int]:
+    return sorted(get().dispatchers)
+
+
+def get_storage() -> StorageConfig:
+    return get().storage
+
+
+def get_kvdb() -> KVDBConfig:
+    return get().kvdb

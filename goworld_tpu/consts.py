@@ -1,0 +1,53 @@
+"""Centralized compile-time tunables.
+
+Mirrors the role of the reference's ``engine/consts/consts.go:6-137``: every
+magic number that shapes runtime behavior lives here so operators can audit
+them in one place.
+"""
+
+# --- ticking ----------------------------------------------------------------
+# Reference runs 5 ms ticks on game/gate/dispatcher (consts.go:36,46,57).
+GAME_SERVICE_TICK_INTERVAL = 0.005  # seconds
+GATE_SERVICE_TICK_INTERVAL = 0.005
+DISPATCHER_SERVICE_TICK_INTERVAL = 0.005
+
+# --- networking -------------------------------------------------------------
+MAX_PACKET_SIZE = 25 * 1024 * 1024  # reference PacketConnection.go:23
+SIZE_FIELD_SIZE = 4  # 4-byte little-endian length prefix
+PAYLOAD_LEN_MASK = 0x7FFFFFFF  # high bit reserved (reference: compressed flag)
+CONNECTION_WRITE_BUFFER_SIZE = 1024 * 1024  # consts.go:14-61
+CONNECTION_READ_BUFFER_SIZE = 1024 * 1024
+BUFFERED_IO_SIZE = 16 * 1024
+FLUSH_INTERVAL = 0.005  # auto-flush cadence (GoWorldConnection.go:437-452)
+
+# --- dispatcher queue bounds (consts.go:30-34) ------------------------------
+ENTITY_PENDING_PACKET_QUEUE_MAX_LEN = 1000
+GAME_PENDING_PACKET_QUEUE_MAX_LEN = 1_000_000
+DISPATCHER_MESSAGE_QUEUE_LEN = 10_000
+
+# --- timeouts ---------------------------------------------------------------
+DISPATCHER_MIGRATE_TIMEOUT = 60.0  # consts.go (1 min migrate window)
+DISPATCHER_LOAD_TIMEOUT = 60.0
+DISPATCHER_FREEZE_GAME_TIMEOUT = 10.0
+RECONNECT_INTERVAL = 1.0  # DispatcherConnMgr reconnect backoff
+CLIENT_HEARTBEAT_TIMEOUT = 30.0  # gate kills silent clients
+
+# --- persistence ------------------------------------------------------------
+DEFAULT_SAVE_INTERVAL = 300.0  # 5 min (read_config.go:28)
+
+# --- AOI / TPU compute plane ------------------------------------------------
+# Default fixed neighbor-set capacity per entity on the TPU path. The
+# reference's go-aoi has no cap; interest sets in practice are bounded by
+# design caps (e.g. 100 avatars/space, unity_demo/SpaceService.go:13-15).
+AOI_MAX_NEIGHBORS = 128
+# Default per-cell capacity of the spatial hash grid (padded, static shape).
+AOI_CELL_CAPACITY = 64
+# Default position-sync cadence (read_config.go:328,380 → 100 ms).
+POSITION_SYNC_INTERVAL = 0.1
+
+# --- debug switches ---------------------------------------------------------
+DEBUG_PACKETS = False
+DEBUG_SPACES = False
+DEBUG_SAVE_LOAD = False
+DEBUG_CLIENTS = False
+DEBUG_MIGRATE = False

@@ -1,0 +1,39 @@
+"""Public API facade.
+
+Reference parity: ``goworld.go:17-256`` — the single module game developers
+import: Run, RegisterEntity/Space/Service, CreateSpace*/CreateEntity*/
+LoadEntity*, Call/CallService*/CallNilSpaces, KVDB helpers, timers, crontab.
+
+This module grows as subsystems land; symbols are re-exported lazily so that
+importing ``goworld_tpu`` never drags in networking or JAX until used.
+"""
+
+from __future__ import annotations
+
+from goworld_tpu.common import (  # noqa: F401
+    EntityID,
+    ClientID,
+    gen_entity_id,
+    gen_fixed_entity_id,
+)
+
+__all__ = [
+    "EntityID",
+    "ClientID",
+    "gen_entity_id",
+    "gen_fixed_entity_id",
+]
+
+
+def __getattr__(name: str):
+    # Lazy exports wired up as subsystems are implemented.
+    if name in _LAZY:
+        module, attr = _LAZY[name]
+        import importlib
+
+        mod = importlib.import_module(module)
+        return getattr(mod, attr)
+    raise AttributeError(f"module 'goworld_tpu' has no attribute {name!r}")
+
+
+_LAZY: dict[str, tuple[str, str]] = {}

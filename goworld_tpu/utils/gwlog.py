@@ -1,0 +1,113 @@
+"""Structured logging for all framework components.
+
+Reference parity: ``engine/gwlog/gwlog.go:16-169`` — zap-based sugar logger
+with a per-component ``source`` field, level parsing, ``TraceError`` (error +
+stack dump) and Fatal/Panic helpers. Here we build on the stdlib ``logging``
+module with the same surface.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import traceback
+
+_FORMAT = "%(asctime)s.%(msecs)03d %(levelname).1s %(source)s %(message)s"
+_DATEFMT = "%Y-%m-%d %H:%M:%S"
+
+_source = "goworld"
+_logger = logging.getLogger("goworld_tpu")
+_configured = False
+
+
+class _SourceFilter(logging.Filter):
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "source"):
+            record.source = _source
+        return True
+
+
+def set_source(source: str) -> None:
+    """Set the component tag (e.g. ``game1`` / ``gate2`` / ``dispatcher1``)."""
+    global _source
+    _source = source
+
+
+def setup(level: str = "info", logfile: str | None = None, stderr: bool = True) -> None:
+    """Initialise handlers. Mirrors binutil.SetupGWLog (binutil.go:50-82)."""
+    global _configured
+    for h in _logger.handlers:
+        h.close()
+    _logger.handlers.clear()
+    _logger.setLevel(parse_level(level))
+    _logger.propagate = False
+    handlers: list[logging.Handler] = []
+    if logfile:
+        handlers.append(logging.FileHandler(logfile))
+    if stderr or not handlers:
+        handlers.append(logging.StreamHandler(sys.stderr))
+    for h in handlers:
+        h.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        h.addFilter(_SourceFilter())
+        _logger.addHandler(h)
+    _configured = True
+
+
+def parse_level(level: str) -> int:
+    m = {
+        "debug": logging.DEBUG,
+        "info": logging.INFO,
+        "warn": logging.WARNING,
+        "warning": logging.WARNING,
+        "error": logging.ERROR,
+        "panic": logging.CRITICAL,
+        "fatal": logging.CRITICAL,
+    }
+    try:
+        return m[level.lower()]
+    except KeyError:
+        raise ValueError(f"unknown log level: {level!r}")
+
+
+def _ensure() -> None:
+    if not _configured:
+        setup()
+
+
+def debugf(fmt: str, *args) -> None:
+    _ensure()
+    _logger.debug(fmt, *args)
+
+
+def infof(fmt: str, *args) -> None:
+    _ensure()
+    _logger.info(fmt, *args)
+
+
+def warnf(fmt: str, *args) -> None:
+    _ensure()
+    _logger.warning(fmt, *args)
+
+
+def errorf(fmt: str, *args) -> None:
+    _ensure()
+    _logger.error(fmt, *args)
+
+
+def trace_error(fmt: str, *args) -> None:
+    """Error + current stack, like gwlog.TraceError (gwlog.go)."""
+    _ensure()
+    msg = fmt % args if args else fmt
+    _logger.error("%s\n%s", msg, "".join(traceback.format_stack()))
+
+
+def panicf(fmt: str, *args) -> None:
+    _ensure()
+    _logger.critical(fmt, *args)
+    raise RuntimeError(fmt % args if args else fmt)
+
+
+def fatalf(fmt: str, *args) -> None:
+    _ensure()
+    _logger.critical(fmt, *args)
+    sys.exit(1)

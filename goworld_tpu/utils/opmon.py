@@ -1,0 +1,75 @@
+"""Operation monitor: named op duration stats + slow-op warnings.
+
+Reference parity: ``engine/opmon/opmon.go:37-118`` — operations are wrapped
+with a monitor that records count/total/max duration and warns when an op
+exceeds its threshold; a periodic dump prints the table.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from goworld_tpu.utils import gwlog
+
+
+class _OpStat:
+    __slots__ = ("count", "total", "max")
+
+    def __init__(self) -> None:
+        self.count = 0
+        self.total = 0.0
+        self.max = 0.0
+
+
+_lock = threading.Lock()
+_stats: dict[str, _OpStat] = {}
+
+
+class Operation:
+    """Usage: ``op = opmon.Operation("dispatch"); ...; op.finish(0.01)``."""
+
+    __slots__ = ("name", "start")
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.start = time.monotonic()
+
+    def finish(self, warn_threshold: float = 0.0) -> float:
+        took = time.monotonic() - self.start
+        with _lock:
+            st = _stats.get(self.name)
+            if st is None:
+                st = _stats[self.name] = _OpStat()
+            st.count += 1
+            st.total += took
+            if took > st.max:
+                st.max = took
+        if warn_threshold and took > warn_threshold:
+            gwlog.warnf("opmon: operation %s took %.3fs > %.3fs", self.name, took, warn_threshold)
+        return took
+
+
+def dump() -> dict[str, dict[str, float]]:
+    with _lock:
+        out = {}
+        for name, st in _stats.items():
+            out[name] = {
+                "count": st.count,
+                "avg": st.total / st.count if st.count else 0.0,
+                "max": st.max,
+            }
+        return out
+
+
+def dump_log() -> None:
+    for name, st in sorted(dump().items()):
+        gwlog.infof(
+            "opmon: %-32s count=%-8d avg=%.3fms max=%.3fms",
+            name, st["count"], st["avg"] * 1000, st["max"] * 1000,
+        )
+
+
+def reset() -> None:
+    with _lock:
+        _stats.clear()

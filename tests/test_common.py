@@ -1,0 +1,54 @@
+"""Tests for ids, hashing (reference: engine/uuid/uuid_test.go,
+engine/common tests)."""
+
+from goworld_tpu.common import (
+    ENTITYID_LENGTH,
+    gen_entity_id,
+    gen_client_id,
+    gen_fixed_entity_id,
+    hash_entity_id,
+    hash_string,
+    is_entity_id,
+)
+
+
+def test_entity_id_shape_and_uniqueness():
+    ids = {gen_entity_id() for _ in range(10000)}
+    assert len(ids) == 10000
+    for eid in list(ids)[:100]:
+        assert len(eid) == ENTITYID_LENGTH
+        assert is_entity_id(eid)
+
+
+def test_client_id():
+    cid = gen_client_id()
+    assert len(cid) == ENTITYID_LENGTH
+
+
+def test_fixed_entity_id_deterministic():
+    a = gen_fixed_entity_id(1)
+    b = gen_fixed_entity_id(1)
+    c = gen_fixed_entity_id(2)
+    assert a == b
+    assert a != c
+    assert is_entity_id(a)
+
+
+def test_hash_string_stable():
+    # Routing hashes must be process-stable (unlike builtin hash()).
+    assert hash_string("OnlineService") == hash_string("OnlineService")
+    assert hash_string("a") != hash_string("b")
+
+
+def test_hash_entity_id_distribution():
+    buckets = [0] * 3
+    for _ in range(3000):
+        buckets[hash_entity_id(gen_entity_id()) % 3] += 1
+    # Roughly uniform across dispatchers.
+    assert all(b > 500 for b in buckets), buckets
+
+
+def test_is_entity_id_rejects():
+    assert not is_entity_id("short")
+    assert not is_entity_id(123)
+    assert not is_entity_id("x" * 15 + "!")

@@ -1,0 +1,106 @@
+"""Config parsing tests (reference: engine/config/config_test.go parses the
+sample ini)."""
+
+import textwrap
+
+import pytest
+
+from goworld_tpu.config import read_config
+
+
+SAMPLE = textwrap.dedent(
+    """
+    [deployment]
+    dispatchers = 2
+    games = 2
+    gates = 2
+
+    [dispatcher_common]
+    host = 127.0.0.1
+
+    [dispatcher1]
+    port = 14001
+
+    [dispatcher2]
+    port = 14002
+
+    [game_common]
+    boot_entity = Account
+    save_interval = 600
+
+    [game1]
+    [game2]
+    log_level = debug
+
+    [gate_common]
+    host = 127.0.0.1
+    compress_connection = true
+
+    [gate1]
+    port = 15001
+
+    [gate2]
+    port = 15002
+    compress_connection = false
+
+    [storage]
+    type = filesystem
+    directory = /tmp/teststorage
+
+    [kvdb]
+    type = filesystem
+    directory = /tmp/testkvdb
+
+    [aoi]
+    backend = xzlist
+    max_entities = 4096
+    """
+)
+
+
+@pytest.fixture()
+def cfg(tmp_path):
+    p = tmp_path / "goworld.ini"
+    p.write_text(SAMPLE)
+    read_config.set_config_file(str(p))
+    yield read_config.get()
+    read_config.set_config_file(None)
+
+
+def test_deployment(cfg):
+    assert cfg.deployment.desired_dispatchers == 2
+    assert cfg.deployment.desired_games == 2
+    assert cfg.deployment.desired_gates == 2
+
+
+def test_common_inheritance(cfg):
+    assert cfg.games[1].boot_entity == "Account"
+    assert cfg.games[1].save_interval == 600
+    assert cfg.games[2].log_level == "debug"
+    assert cfg.games[1].log_level == "info"
+    assert cfg.gates[1].compress_connection is True
+    assert cfg.gates[2].compress_connection is False
+
+
+def test_addrs(cfg):
+    assert cfg.dispatchers[1].addr == ("127.0.0.1", 14001)
+    assert cfg.gates[2].addr == ("127.0.0.1", 15002)
+
+
+def test_storage_kvdb_aoi(cfg):
+    assert cfg.storage.directory == "/tmp/teststorage"
+    assert cfg.kvdb.type == "filesystem"
+    assert cfg.aoi.backend == "xzlist"
+    assert cfg.aoi.max_entities == 4096
+
+
+def test_duplicate_addr_rejected(tmp_path):
+    bad = SAMPLE.replace("port = 14002", "port = 14001")
+    p = tmp_path / "bad.ini"
+    p.write_text(bad)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)

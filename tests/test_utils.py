@@ -1,0 +1,143 @@
+"""Tests for post/timer/gwutils/opmon/crontab/async groups
+(reference: engine/post, engine/gwutils, engine/opmon, engine/crontab,
+engine/async package tests)."""
+
+import time
+
+from goworld_tpu.utils import async_jobs, gwutils, opmon, post
+from goworld_tpu.utils.crontab import Crontab
+from goworld_tpu.utils.timer import TimerService
+
+
+def test_post_drains_nested():
+    post.clear()
+    order = []
+    post.post(lambda: order.append(1))
+    post.post(lambda: (order.append(2), post.post(lambda: order.append(3))))
+    n = post.tick()
+    assert order == [1, 2, 3]
+    assert n == 3
+    assert post.tick() == 0
+
+
+def test_post_panicless():
+    post.clear()
+    ran = []
+
+    def bad():
+        raise ValueError("boom")
+
+    post.post(bad)
+    post.post(lambda: ran.append(1))
+    post.tick()
+    assert ran == [1]
+
+
+def test_run_panicless():
+    assert gwutils.run_panicless(lambda: None)
+    assert not gwutils.run_panicless(lambda: 1 / 0)
+
+
+def test_repeat_until_panicless():
+    attempts = []
+
+    def flaky():
+        attempts.append(1)
+        if len(attempts) < 3:
+            raise RuntimeError("retry")
+
+    gwutils.repeat_until_panicless(flaky)
+    assert len(attempts) == 3
+
+
+def test_timer_one_shot_and_repeat():
+    now = [0.0]
+    ts = TimerService(now=lambda: now[0])
+    fired = []
+    ts.add_callback(1.0, lambda: fired.append("once"))
+    h = ts.add_timer(0.5, lambda: fired.append("rep"))
+    ts.tick()
+    assert fired == []
+    now[0] = 0.6
+    ts.tick()
+    assert fired == ["rep"]
+    now[0] = 1.2
+    ts.tick()
+    assert sorted(fired) == ["once", "rep", "rep"]
+    h.cancel()
+    now[0] = 5.0
+    ts.tick()
+    assert sorted(fired) == ["once", "rep", "rep"]
+
+
+def test_timer_no_burst_after_stall():
+    now = [0.0]
+    ts = TimerService(now=lambda: now[0])
+    fired = []
+    ts.add_timer(0.1, lambda: fired.append(1))
+    now[0] = 10.0  # stalled 100 intervals
+    ts.tick()
+    assert len(fired) == 1  # not 100
+
+
+def test_opmon():
+    opmon.reset()
+    op = opmon.Operation("test.op")
+    op.finish()
+    op = opmon.Operation("test.op")
+    op.finish()
+    d = opmon.dump()
+    assert d["test.op"]["count"] == 2
+
+
+def test_crontab_every_n_minutes():
+    now = [0.0]
+    ct = Crontab(now=lambda: now[0])
+    fired = []
+    ct.register(-5, -1, -1, -1, -1, lambda: fired.append(1))
+    now[0] = 60 * 61  # advance 61 minutes
+    ct.check()
+    # every-5-minutes over 61 minutes → 12 or 13 fires depending on phase
+    assert 11 <= len(fired) <= 13
+
+
+def test_crontab_cancel():
+    now = [0.0]
+    ct = Crontab(now=lambda: now[0])
+    fired = []
+    h = ct.register(-1, -1, -1, -1, -1, lambda: fired.append(1))
+    h.cancel()
+    now[0] = 600
+    ct.check()
+    assert fired == []
+
+
+def test_async_jobs_serial_order_and_callback():
+    post.clear()
+    done = []
+    results = []
+    for i in range(5):
+        async_jobs.append_job(
+            "testgroup",
+            lambda i=i: (time.sleep(0.001), done.append(i))[-1] or i,
+            lambda r, e: results.append((r, e)),
+        )
+    assert async_jobs.wait_clear(timeout=5)
+    post.tick()
+    assert done == [0, 1, 2, 3, 4]
+    assert [r for r, e in results] == [None] * 5 or len(results) == 5
+
+
+def test_async_jobs_error_callback():
+    post.clear()
+    got = []
+
+    def bad():
+        raise RuntimeError("db down")
+
+    async_jobs.append_job("errgroup", bad, lambda r, e: got.append((r, e)))
+    assert async_jobs.wait_clear(timeout=5)
+    post.tick()
+    assert len(got) == 1
+    assert got[0][0] is None
+    assert isinstance(got[0][1], RuntimeError)
