@@ -1,0 +1,88 @@
+"""Headline benchmark: AOI updates/sec at 100k moving entities on one chip.
+
+Target (BASELINE.json): sustain 100k moving entities at 30 Hz with p99
+enter/leave-diff latency < 5 ms on one v5e chip. Baseline value is therefore
+100k * 30 = 3.0M AOI entity-updates/sec; ``vs_baseline`` is measured
+throughput against that target.
+
+The measured loop is the full production path: host position upload → jitted
+spatial-hash neighbor + diff step → compacted event readback to numpy
+(what TPUAOIManager does every tick).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    from goworld_tpu.ops import NeighborEngine, NeighborParams
+
+    n = 102400  # ~100k entities
+    params = NeighborParams(
+        capacity=n,
+        max_neighbors=128,
+        cell_size=100.0,
+        grid_x=128,
+        grid_z=128,
+        space_slots=4,
+        cell_capacity=64,
+        max_events=262144,
+    )
+    eng = NeighborEngine(params)
+    eng.reset()
+
+    rng = np.random.default_rng(0)
+    # ~6 entities per 100x100 cell over a 12800^2 world → ~19 AOI neighbors
+    # each (AOI distance 100, density like the reference demos, BASELINE.md).
+    pos = rng.uniform(0, 12800, (n, 2)).astype(np.float32)
+    active = np.ones(n, bool)
+    space = np.zeros(n, np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    # Random-walk velocities ~ 3 units/tick (entities cross cells regularly).
+    vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
+
+    # Warmup: compile + first-tick full enter storm.
+    eng.step(pos, active, space, radius)
+
+    steps = 90
+    lat = []
+    t_all0 = time.perf_counter()
+    for _ in range(steps):
+        pos += vel
+        np.clip(pos, 0.0, 12800.0, out=pos)
+        t0 = time.perf_counter()
+        enters, leaves, overflow = eng.step(pos, active, space, radius)
+        lat.append(time.perf_counter() - t0)
+    t_all = time.perf_counter() - t_all0
+
+    lat_ms = np.array(lat) * 1000.0
+    p50 = float(np.percentile(lat_ms, 50))
+    p99 = float(np.percentile(lat_ms, 99))
+    ticks_per_sec = steps / t_all
+    updates_per_sec = ticks_per_sec * n
+    baseline = 100_000 * 30  # 100k entities @ 30 Hz
+    print(
+        json.dumps(
+            {
+                "metric": "aoi_entity_updates_per_sec_100k",
+                "value": round(updates_per_sec, 1),
+                "unit": "entity-updates/sec",
+                "vs_baseline": round(updates_per_sec / baseline, 3),
+                "entities": n,
+                "ticks_per_sec": round(ticks_per_sec, 2),
+                "p50_ms": round(p50, 3),
+                "p99_ms": round(p99, 3),
+                "p99_target_ms": 5.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,13 @@
+"""TPU compute plane: batched AOI neighbor queries, interest-set diffs and
+steering kernels (JAX / Pallas).
+
+This package is the TPU-native replacement for the reference's per-move CPU
+sweep AOI (``xiaonanln/go-aoi`` driven from engine/entity/Space.go:211-259).
+Instead of updating sweep lists entity-by-entity, every Space's positions are
+batched once per tick into fixed-shape device arrays and a single jitted
+program computes all neighbor sets and enter/leave diffs (SURVEY.md §7.1).
+"""
+
+from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
+
+__all__ = ["NeighborEngine", "NeighborParams"]

@@ -1,0 +1,369 @@
+"""Batched AOI neighbor engine — the TPU-native hot loop.
+
+What the reference does per entity move (Space.go:253-261 → go-aoi
+``Moved(aoi, x, z)`` → synchronous OnEnterAOI/OnLeaveAOI callbacks), this
+engine does for *all* entities of *all* spaces in one jitted launch per tick:
+
+1. **Spatial hash grid build** — entities are binned into grid cells of side
+   ``cell_size`` (= max AOI distance). Static shapes throughout: the grid is a
+   ``[space_slots * grid_z * grid_x, cell_capacity]`` table of entity slots,
+   built with a sort + rank-within-cell + scatter (no data-dependent shapes,
+   XLA-friendly).
+2. **Candidate gather** — each entity reads the 3×3 neighborhood of its cell:
+   ``9 * cell_capacity`` candidate slots. Cell coords wrap modulo the grid
+   (torus); false adjacencies from wrap/space folding are removed by the
+   distance and space-id masks, so correctness never depends on grid extents.
+3. **Neighbor set** — the K lowest-id candidates within radius form the
+   entity's interest set, as a sorted, ``capacity``-padded id list. Sorted
+   fixed-K lists make set-diff a vectorized searchsorted, and make results
+   deterministic (ties cannot occur: ids are unique).
+4. **Diff** — enter = in new set but not old, leave = in old but not new.
+   Diffs are compacted on-device into a ``[max_events, 2]`` pair list so the
+   host readback is O(events), not O(N·K).
+
+The engine is a pure function of (previous neighbor state, current positions);
+the stateful wrapper just carries the device arrays. Statelessness per tick is
+what keeps freeze/restore and migration semantics intact (SURVEY.md §5.8): on
+restart the host simply re-uploads positions.
+
+Asymmetric interest (per-entity radius) is supported — a superset of the
+reference's single uniform distance per AOIManager (go-aoi limitation noted in
+reference TODO.md:17).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class NeighborParams:
+    """Static configuration of a neighbor engine (shapes are compiled in)."""
+
+    capacity: int = 16384  # max entity slots (N)
+    max_neighbors: int = 128  # K: interest-set capacity per entity
+    cell_size: float = 100.0  # grid cell side; must be >= max AOI distance
+    grid_x: int = 64  # grid extent in cells (wraps modulo)
+    grid_z: int = 64
+    space_slots: int = 8  # space-id folding slots for the shared grid
+    cell_capacity: int = 64  # M: max entities stored per grid cell
+    max_events: int = 65536  # compacted enter/leave pair capacity per tick
+
+    def __post_init__(self) -> None:
+        if self.grid_x < 4 or self.grid_z < 4:
+            # 3x3 neighborhoods must touch 9 distinct buckets after wrap.
+            raise ValueError("grid_x and grid_z must be >= 4")
+        if self.capacity % 8 != 0:
+            raise ValueError("capacity must be a multiple of 8 (TPU sublanes)")
+
+    @property
+    def num_buckets(self) -> int:
+        return self.space_slots * self.grid_z * self.grid_x
+
+
+class MatrixStepResult(NamedTuple):
+    """Step output with device-resident event matrices (drained in chunks)."""
+
+    neighbors: jax.Array  # i32[N, K]
+    enter_ids: jax.Array  # i32[N, K]: other-id where entered, else sentinel N
+    leave_ids: jax.Array  # i32[N, K]: other-id where left, else sentinel N
+    n_enters: jax.Array  # i32[] total enter events
+    n_leaves: jax.Array  # i32[] total leave events
+    overflow: jax.Array  # i32[] entities whose true neighbor count exceeded K
+    grid_dropped: jax.Array  # i32[] active entities not inserted in the grid
+
+
+def _bucket_of(p: NeighborParams, cx: jax.Array, cz: jax.Array, space: jax.Array) -> jax.Array:
+    """Fold (cell_x, cell_z, space_id) into a grid bucket index (torus wrap)."""
+    cxm = jnp.mod(cx, p.grid_x)
+    czm = jnp.mod(cz, p.grid_z)
+    sm = jnp.mod(space, p.space_slots)
+    return (sm * p.grid_z + czm) * p.grid_x + cxm
+
+
+def _build_grid(
+    p: NeighborParams, bucket: jax.Array, active: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter entity slots into the [num_buckets * M] grid table.
+
+    Rank-within-bucket is derived from a stable sort: after sorting slots by
+    bucket id, an entity's rank is its position minus the first position of
+    its bucket. Entities beyond ``cell_capacity`` in a cell are dropped from
+    the grid (they still *query*, so they receive neighbors; they are just
+    invisible to others this tick). Returns (grid, dropped_count) so callers
+    can alert operators to size cell_capacity / space_slots properly.
+    """
+    n = p.capacity
+    # Inactive entities sort to the end with an out-of-range bucket.
+    key = jnp.where(active, bucket, p.num_buckets)
+    order = jnp.argsort(key)  # stable
+    sorted_key = key[order]
+    first_pos = jnp.searchsorted(sorted_key, sorted_key, side="left")
+    rank = jnp.arange(n, dtype=jnp.int32) - first_pos.astype(jnp.int32)
+    ok = (sorted_key < p.num_buckets) & (rank < p.cell_capacity)
+    dropped = jnp.sum((sorted_key < p.num_buckets) & ~ok).astype(jnp.int32)
+    table_size = p.num_buckets * p.cell_capacity
+    # Out-of-range index + mode="drop" discards non-ok writes.
+    flat_idx = jnp.where(ok, sorted_key * p.cell_capacity + rank, table_size)
+    grid = jnp.full((table_size,), n, dtype=jnp.int32)
+    grid = grid.at[flat_idx].set(order.astype(jnp.int32), mode="drop")
+    return grid, dropped
+
+
+def _neighbor_sets(
+    p: NeighborParams,
+    grid: jax.Array,
+    pos: jax.Array,  # f32[N,2] global positions
+    active: jax.Array,  # bool[N] global
+    space: jax.Array,  # i32[N] global
+    q_ids: jax.Array,  # i32[Q] global slot ids of the query entities
+    q_pos: jax.Array,  # f32[Q,2]
+    q_active: jax.Array,  # bool[Q]
+    q_space: jax.Array,  # i32[Q]
+    q_radius: jax.Array,  # f32[Q]
+) -> tuple[jax.Array, jax.Array]:
+    """Compute sorted fixed-K neighbor id lists for the Q query entities
+    against the full (possibly all-gathered) world.
+
+    Single-device: Q == N and q_ids == arange(N). Sharded: each device passes
+    only the slots it owns (SURVEY.md §2.9: entity-sharded global query).
+    """
+    n, k, m = p.capacity, p.max_neighbors, p.cell_capacity
+
+    q_cx = jnp.floor(q_pos[:, 0] / p.cell_size).astype(jnp.int32)
+    q_cz = jnp.floor(q_pos[:, 1] / p.cell_size).astype(jnp.int32)
+
+    # Gather 3x3 cell neighborhoods → candidate slot ids [Q, 9*M].
+    offsets = [(dx, dz) for dz in (-1, 0, 1) for dx in (-1, 0, 1)]
+    cand_parts = []
+    for dx, dz in offsets:
+        b = _bucket_of(p, q_cx + dx, q_cz + dz, q_space)  # [Q]
+        base = b * m
+        idx = base[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]  # [Q, M]
+        cand_parts.append(grid[idx])
+    cand = jnp.concatenate(cand_parts, axis=1)  # [Q, 9M]
+
+    cand_safe = jnp.minimum(cand, n - 1)  # safe gather index for sentinel rows
+    # Gather x and z separately: a trailing dim of 2 would be padded to 128
+    # lanes by TPU tiling (64x memory blowup on the [Q, 9M] intermediates).
+    dx = pos[:, 0][cand_safe] - q_pos[:, 0][:, None]  # [Q, 9M]
+    dz = pos[:, 1][cand_safe] - q_pos[:, 1][:, None]
+    d2 = dx * dx + dz * dz
+    r2 = (q_radius * q_radius)[:, None]
+
+    valid = (
+        (cand < n)
+        & (cand != q_ids[:, None])
+        & q_active[:, None]
+        & active[cand_safe]
+        & (space[cand_safe] == q_space[:, None])
+        & (d2 <= r2)
+    )
+    # True neighbor degree (before K-truncation) for overflow accounting.
+    degree = jnp.sum(valid, axis=1)
+
+    # K lowest ids among valid candidates; sentinel n pads the tail. A cell
+    # neighborhood holds at most 9*M candidates, so clamp the top_k width and
+    # pad the remaining columns with the sentinel.
+    keys = jnp.where(valid, cand, n)
+    kk = min(k, 9 * m)
+    neg_topk, _ = jax.lax.top_k(-keys, kk)  # top_k of negated → kk smallest
+    neighbors = -neg_topk  # ascending, padded with n
+    if kk < k:
+        pad = jnp.full((neighbors.shape[0], k - kk), n, neighbors.dtype)
+        neighbors = jnp.concatenate([neighbors, pad], axis=1)
+    overflow = jnp.sum(degree > k)
+    return neighbors.astype(jnp.int32), overflow.astype(jnp.int32)
+
+
+def _row_membership(sorted_ref: jax.Array, queries: jax.Array, sentinel: int) -> jax.Array:
+    """For each row: is queries[i,j] present in sorted_ref[i,:]? (vectorized)"""
+
+    def one_row(ref_row, q_row):
+        pos = jnp.searchsorted(ref_row, q_row)
+        pos = jnp.minimum(pos, ref_row.shape[0] - 1)
+        return (ref_row[pos] == q_row) & (q_row < sentinel)
+
+    return jax.vmap(one_row)(sorted_ref, queries)
+
+
+def _step(
+    p: NeighborParams,
+    prev_neighbors: jax.Array,
+    pos: jax.Array,
+    active: jax.Array,
+    space: jax.Array,
+    radius: jax.Array,
+) -> MatrixStepResult:
+    n = p.capacity
+    cx = jnp.floor(pos[:, 0] / p.cell_size).astype(jnp.int32)
+    cz = jnp.floor(pos[:, 1] / p.cell_size).astype(jnp.int32)
+    bucket = _bucket_of(p, cx, cz, space)
+
+    grid, grid_dropped = _build_grid(p, bucket, active)
+    q_ids = jnp.arange(n, dtype=jnp.int32)
+    neighbors, overflow = _neighbor_sets(
+        p, grid, pos, active, space, q_ids, pos, active, space, radius
+    )
+
+    entered = ~_row_membership(prev_neighbors, neighbors, n) & (neighbors < n)
+    left = ~_row_membership(neighbors, prev_neighbors, n) & (prev_neighbors < n)
+
+    enter_ids = jnp.where(entered, neighbors, n)
+    leave_ids = jnp.where(left, prev_neighbors, n)
+    n_enters = jnp.sum(entered).astype(jnp.int32)
+    n_leaves = jnp.sum(left).astype(jnp.int32)
+    return MatrixStepResult(
+        neighbors, enter_ids, leave_ids, n_enters, n_leaves, overflow, grid_dropped
+    )
+
+
+def _drain(
+    p: NeighborParams, ids: jax.Array, start_flat: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Compact one chunk of events from an id matrix.
+
+    ``ids`` is i32[N,K] with sentinel N in non-event slots. Returns
+    (pairs i32[max_events, 2], flat_positions i32[max_events]) for the first
+    ``max_events`` events at flat index >= start_flat. Host pages through by
+    passing last_flat+1 as the next start.
+    """
+    n, k = p.capacity, p.max_neighbors
+    total = n * k
+    flat = ids.reshape(-1)
+    mask = (flat < n) & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
+    (idx,) = jnp.nonzero(mask, size=p.max_events, fill_value=total)
+    idx = idx.astype(jnp.int32)
+    valid = idx < total
+    safe = jnp.minimum(idx, total - 1)
+    ent = jnp.where(valid, safe // k, n)
+    oth = jnp.where(valid, flat[safe], n)
+    return jnp.stack([ent, oth], axis=1), idx
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_step(params: NeighborParams):
+    """One compiled step per distinct NeighborParams (shared across engines)."""
+    return jax.jit(functools.partial(_step, params), donate_argnums=(0,))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_drain(params: NeighborParams):
+    return jax.jit(functools.partial(_drain, params))
+
+
+class NeighborEngine:
+    """Stateful wrapper around the jitted step function.
+
+    Usage (one engine per game process; all spaces batched together):
+
+        eng = NeighborEngine(NeighborParams(capacity=1024))
+        eng.reset()
+        enters, leaves = eng.step(pos, active, space, radius)
+
+    ``enters`` / ``leaves`` are numpy ``[E, 2]`` arrays of (slot, other_slot)
+    pairs — the batched equivalent of the reference's OnEnterAOI/OnLeaveAOI
+    callback invocations (Entity.go:227-246).
+    """
+
+    def __init__(self, params: NeighborParams, device: jax.Device | None = None):
+        self.params = params
+        self.device = device
+        self._jit_step = _jitted_step(params)
+        self._jit_drain = _jitted_drain(params)
+        self._neighbors: jax.Array | None = None
+        # Diagnostics from the latest step() (see MatrixStepResult).
+        self.last_grid_dropped = 0
+        self.last_overflow = 0
+
+    def reset(self) -> None:
+        n, k = self.params.capacity, self.params.max_neighbors
+        arr = jnp.full((n, k), n, dtype=jnp.int32)
+        if self.device is not None:
+            arr = jax.device_put(arr, self.device)
+        self._neighbors = arr
+
+    @property
+    def neighbors(self) -> jax.Array:
+        assert self._neighbors is not None, "call reset() first"
+        return self._neighbors
+
+    def step_device(self, pos, active, space, radius) -> MatrixStepResult:
+        """Run one tick; returns device arrays (no host sync)."""
+        assert self._neighbors is not None, "call reset() first"
+        res = self._jit_step(self._neighbors, pos, active, space, radius)
+        self._neighbors = res.neighbors
+        return res
+
+    def _drain_all(self, ids: jax.Array, total: int) -> np.ndarray:
+        """Page all events out of an id matrix in max_events-sized chunks."""
+        if total == 0:
+            return np.empty((0, 2), np.int32)
+        chunks = []
+        start = jnp.int32(0)
+        remaining = total
+        while remaining > 0:
+            pairs, idx = self._jit_drain(ids, start)
+            take = min(self.params.max_events, remaining)
+            pairs_np = np.asarray(pairs[:take])
+            chunks.append(pairs_np)
+            remaining -= take
+            if remaining > 0:
+                start = idx[take - 1] + 1
+        return np.concatenate(chunks)
+
+    def step(
+        self,
+        pos: np.ndarray,
+        active: np.ndarray,
+        space: np.ndarray,
+        radius: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Run one tick; returns (enter_pairs, leave_pairs, overflow) on host.
+
+        Event counts are unbounded: a mass spawn's "enter storm" is drained in
+        max_events-sized chunks rather than overflowing a fixed buffer.
+        """
+        self._check_radius(radius, active)
+        res = self.step_device(
+            jnp.asarray(pos, jnp.float32),
+            jnp.asarray(active, jnp.bool_),
+            jnp.asarray(space, jnp.int32),
+            jnp.asarray(radius, jnp.float32),
+        )
+        n_e = int(res.n_enters)
+        n_l = int(res.n_leaves)
+        enters = self._drain_all(res.enter_ids, n_e)
+        leaves = self._drain_all(res.leave_ids, n_l)
+        dropped = int(res.grid_dropped)
+        self.last_grid_dropped = dropped
+        self.last_overflow = int(res.overflow)
+        if dropped:
+            from goworld_tpu.utils import gwlog
+
+            gwlog.warnf(
+                "AOI grid overflow: %d active entities exceeded cell_capacity=%d "
+                "and are invisible to neighbors this tick; raise cell_capacity "
+                "or space_slots/grid size",
+                dropped,
+                self.params.cell_capacity,
+            )
+        return enters, leaves, int(res.overflow)
+
+    def _check_radius(self, radius: np.ndarray, active: np.ndarray) -> None:
+        """The 3x3 cell gather only covers AOI distance <= cell_size: a larger
+        radius would silently miss true neighbors, so reject it loudly."""
+        r = np.asarray(radius)
+        a = np.asarray(active)
+        if a.any() and float(r[a].max()) > self.params.cell_size:
+            raise ValueError(
+                f"AOI radius {float(r[a].max())} exceeds cell_size "
+                f"{self.params.cell_size}; enlarge cell_size (it must be >= "
+                f"the maximum AOI distance)"
+            )

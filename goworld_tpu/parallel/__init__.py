@@ -1,0 +1,8 @@
+"""Multi-chip parallelism: entity-sharded global AOI queries over a device
+mesh (jax.sharding + shard_map), the TPU-native analog of the reference's
+entity-sharding across game processes (SURVEY.md §2.9).
+"""
+
+from goworld_tpu.parallel.mesh import ShardedNeighborEngine, make_mesh
+
+__all__ = ["ShardedNeighborEngine", "make_mesh"]

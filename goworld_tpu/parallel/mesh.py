@@ -1,0 +1,215 @@
+"""Entity-sharded AOI over a device mesh.
+
+The reference scales by sharding entities/spaces across game processes, with
+no cross-process AOI at all (SURVEY.md §5.7: AOI is strictly per-Space,
+per-game). The TPU-native design goes further: entity slots are sharded over
+a mesh axis; each tick, **positions are all-gathered over ICI** so every
+device sees the whole world, then each device computes neighbor sets and
+enter/leave diffs only for the slots it owns. This is the "sequence
+parallelism" of this domain (BASELINE.json config 5: 1M entities, 8 game
+processes → v5e-16 pod).
+
+Communication per tick = one all-gather of [N, 2] f32 positions + [N] masks
+(~1 MB at 100k entities) — rides ICI, far below its bandwidth. Grid build is
+replicated per device (cheap: one sort of N keys); the O(N·9M) candidate math
+— the actual FLOPs — is perfectly sharded.
+
+Collectives are XLA's (all_gather inside shard_map); there is no NCCL/MPI
+analog to port — the reference's TCP star stays the control plane
+(SURVEY.md §5.8).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from goworld_tpu.ops.neighbor import (
+    MatrixStepResult,
+    NeighborParams,
+    _bucket_of,
+    _build_grid,
+    _jitted_drain,
+    _neighbor_sets,
+    _row_membership,
+)
+
+SHARD_AXIS = "shard"
+
+
+def make_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
+    """Build a 1-D mesh over the entity-shard axis.
+
+    Prefers explicitly passed devices; otherwise takes the first n of
+    jax.devices(). For CPU-hosted multi-device testing, set
+    ``--xla_force_host_platform_device_count`` (tests/conftest.py does).
+    """
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                # Fall back to virtual CPU devices when the default platform
+                # has too few chips (e.g. one real TPU during development).
+                cpu = jax.devices("cpu")
+                if len(cpu) >= n_devices:
+                    devices = cpu
+                else:
+                    raise ValueError(
+                        f"need {n_devices} devices, have {len(devices)} "
+                        f"{devices[0].platform} and {len(cpu)} cpu"
+                    )
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def _sharded_step(
+    p: NeighborParams,
+    prev_nb: jax.Array,  # i32[chunk, K] this shard's previous neighbor lists
+    pos_l: jax.Array,  # f32[chunk, 2] this shard's positions
+    active_l: jax.Array,
+    space_l: jax.Array,
+    radius_l: jax.Array,
+) -> MatrixStepResult:
+    """Per-shard body run under shard_map."""
+    n = p.capacity
+    chunk = pos_l.shape[0]
+    shard = jax.lax.axis_index(SHARD_AXIS)
+    q_ids = shard * chunk + jnp.arange(chunk, dtype=jnp.int32)
+
+    # ICI all-gather: full world view on every device.
+    pos = jax.lax.all_gather(pos_l, SHARD_AXIS, tiled=True)  # [N, 2]
+    active = jax.lax.all_gather(active_l, SHARD_AXIS, tiled=True)
+    space = jax.lax.all_gather(space_l, SHARD_AXIS, tiled=True)
+
+    cx = jnp.floor(pos[:, 0] / p.cell_size).astype(jnp.int32)
+    cz = jnp.floor(pos[:, 1] / p.cell_size).astype(jnp.int32)
+    bucket = _bucket_of(p, cx, cz, space)
+    grid, grid_dropped = _build_grid(p, bucket, active)
+
+    neighbors, overflow = _neighbor_sets(
+        p, grid, pos, active, space, q_ids, pos_l, active_l, space_l, radius_l
+    )
+
+    entered = ~_row_membership(prev_nb, neighbors, n) & (neighbors < n)
+    left = ~_row_membership(neighbors, prev_nb, n) & (prev_nb < n)
+
+    # Event matrices with global ids in non-event slots = sentinel n; the host
+    # drains them in chunks exactly like the single-device engine (the [N, K]
+    # event matrices are sharded on rows, so flat indices stay global).
+    enter_ids = jnp.where(entered, neighbors, n)
+    leave_ids = jnp.where(left, prev_nb, n)
+    n_enters = jnp.sum(entered).astype(jnp.int32)
+    n_leaves = jnp.sum(left).astype(jnp.int32)
+    # grid_dropped is identical on every shard (computed from the all-gathered
+    # world); divide after psum-free sum on host instead of psumming here.
+    return MatrixStepResult(
+        neighbors,
+        enter_ids,
+        leave_ids,
+        n_enters[None],
+        n_leaves[None],
+        overflow[None],
+        grid_dropped[None],
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_sharded_step(params: NeighborParams, mesh: Mesh):
+    from jax import shard_map
+
+    body = functools.partial(_sharded_step, params)
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec, spec, spec, spec, spec),
+        out_specs=MatrixStepResult(
+            neighbors=spec,
+            enter_ids=spec,
+            leave_ids=spec,
+            n_enters=spec,
+            n_leaves=spec,
+            overflow=spec,
+            grid_dropped=spec,
+        ),
+    )
+    return jax.jit(mapped, donate_argnums=(0,))
+
+
+class ShardedNeighborEngine:
+    """Multi-device AOI engine: same semantics as NeighborEngine, with entity
+    slots sharded over a mesh. Slot i lives on device i // (N / D).
+
+    Event results come back as D per-shard blocks; ``step`` flattens them.
+    """
+
+    def __init__(self, params: NeighborParams, mesh: Mesh):
+        n_dev = mesh.devices.size
+        if params.capacity % (8 * n_dev) != 0:
+            raise ValueError(
+                f"capacity {params.capacity} must be a multiple of 8*{n_dev}"
+            )
+        self.params = params
+        self.mesh = mesh
+        self.n_devices = n_dev
+        self._jit_step = _jitted_sharded_step(params, mesh)
+        self._jit_drain = _jitted_drain(params)
+        self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._neighbors: jax.Array | None = None
+
+    def reset(self) -> None:
+        n, k = self.params.capacity, self.params.max_neighbors
+        self._neighbors = jax.device_put(
+            jnp.full((n, k), n, dtype=jnp.int32), self._sharding
+        )
+
+    def step_device(self, pos, active, space, radius) -> MatrixStepResult:
+        assert self._neighbors is not None, "call reset() first"
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        res = self._jit_step(
+            self._neighbors, put(pos), put(active), put(space), put(radius)
+        )
+        self._neighbors = res.neighbors
+        return res
+
+    def _drain_all(self, ids: jax.Array, total: int) -> np.ndarray:
+        """Chunked event drain, identical semantics to NeighborEngine: the
+        [N, K] event matrix is row-sharded, so global flat indices page
+        through all shards in order."""
+        if total == 0:
+            return np.empty((0, 2), np.int32)
+        chunks = []
+        start = jnp.int32(0)
+        remaining = total
+        while remaining > 0:
+            pairs, idx = self._jit_drain(ids, start)
+            take = min(self.params.max_events, remaining)
+            chunks.append(np.asarray(pairs[:take]))
+            remaining -= take
+            if remaining > 0:
+                start = idx[take - 1] + 1
+        return np.concatenate(chunks)
+
+    def step(
+        self,
+        pos: np.ndarray,
+        active: np.ndarray,
+        space: np.ndarray,
+        radius: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray, int]:
+        """Run one tick; returns host (enter_pairs, leave_pairs, overflow)."""
+        res = self.step_device(
+            jnp.asarray(pos, jnp.float32),
+            jnp.asarray(active, jnp.bool_),
+            jnp.asarray(space, jnp.int32),
+            jnp.asarray(radius, jnp.float32),
+        )
+        n_e = int(np.sum(np.asarray(res.n_enters)))
+        n_l = int(np.sum(np.asarray(res.n_leaves)))
+        enters = self._drain_all(res.enter_ids, n_e)
+        leaves = self._drain_all(res.leave_ids, n_l)
+        return enters, leaves, int(np.sum(np.asarray(res.overflow)))

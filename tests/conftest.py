@@ -3,13 +3,19 @@
 Mirrors how the reference tests multi-process behavior on localhost
 (SURVEY.md §4.3): multi-chip sharding logic is exercised on virtual CPU
 devices; real-TPU runs happen via bench.py / the driver.
+
+Note: the axon TPU plugin ignores the JAX_PLATFORMS env var, so we must
+force the platform via jax.config after import.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,230 @@
+"""Correctness tests for the batched AOI neighbor engine.
+
+The oracle is a brute-force O(N^2) numpy computation of the same interest
+semantics: entity j is in entity i's set iff both active, same space, j != i,
+and dist(i,j) <= radius_i. This mirrors how the reference's AOI behavior is
+pinned by its CPU implementation (SURVEY.md §7.2 step 7: "correctness oracle =
+CPU manager on identical traces").
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import NeighborEngine, NeighborParams
+
+
+def brute_force_sets(pos, active, space, radius):
+    n = len(pos)
+    out = []
+    for i in range(n):
+        if not active[i]:
+            out.append(set())
+            continue
+        d2 = np.sum((pos - pos[i]) ** 2, axis=1)
+        mask = (
+            active
+            & (space == space[i])
+            & (d2 <= radius[i] ** 2)
+            & (np.arange(n) != i)
+        )
+        out.append(set(np.nonzero(mask)[0].tolist()))
+    return out
+
+
+def pairs_to_setlist(pairs, n):
+    out = [set() for _ in range(n)]
+    for a, b in pairs:
+        out[int(a)].add(int(b))
+    return out
+
+
+def make_world(n, n_active, seed, world=1000.0, n_spaces=1):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(0, world, size=(n, 2)).astype(np.float32)
+    active = np.zeros(n, bool)
+    active[:n_active] = True
+    space = rng.integers(0, n_spaces, size=n).astype(np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    return pos, active, space, radius
+
+
+PARAMS = NeighborParams(
+    capacity=256, max_neighbors=64, cell_size=100.0, grid_x=16, grid_z=16,
+    space_slots=4, cell_capacity=64, max_events=16384,
+)
+
+
+def engine():
+    e = NeighborEngine(PARAMS)
+    e.reset()
+    return e
+
+
+def test_first_tick_all_enters():
+    eng = engine()
+    pos, active, space, radius = make_world(256, 200, seed=0)
+    enters, leaves, overflow = eng.step(pos, active, space, radius)
+    assert len(leaves) == 0
+    assert overflow == 0
+    got = pairs_to_setlist(enters, 256)
+    want = brute_force_sets(pos, active, space, radius)
+    assert got == want
+
+
+def test_incremental_diffs_match_oracle():
+    eng = engine()
+    rng = np.random.default_rng(1)
+    pos, active, space, radius = make_world(256, 180, seed=1)
+    cur = [set() for _ in range(256)]
+    for tick in range(10):
+        pos = pos + rng.normal(0, 15, size=pos.shape).astype(np.float32)
+        pos = np.clip(pos, 0, 1500).astype(np.float32)
+        enters, leaves, overflow = eng.step(pos, active, space, radius)
+        assert overflow == 0
+        for a, b in leaves:
+            cur[int(a)].discard(int(b))
+        for a, b in enters:
+            cur[int(a)].add(int(b))
+        want = brute_force_sets(pos, active, space, radius)
+        assert cur == want, f"tick {tick} mismatch"
+
+
+def test_space_isolation():
+    eng = engine()
+    n = 256
+    pos = np.zeros((n, 2), np.float32)  # everyone at the same point
+    active = np.ones(n, bool)
+    space = (np.arange(n) % 4).astype(np.int32)
+    radius = np.full(n, 50.0, np.float32)
+    enters, leaves, _ = eng.step(pos, active, space, radius)
+    got = pairs_to_setlist(enters, n)
+    for i in range(n):
+        assert all(space[j] == space[i] for j in got[i])
+        assert len(got[i]) == 64 - 1  # 256/4 per space minus self
+
+
+def test_entity_deactivation_emits_leaves():
+    eng = engine()
+    pos, active, space, radius = make_world(256, 100, seed=2, world=300.0)
+    enters, _, _ = eng.step(pos, active, space, radius)
+    sets0 = pairs_to_setlist(enters, 256)
+    # Deactivate entity 0 (destroy/migrate-out); its neighbors must see a leave.
+    active2 = active.copy()
+    active2[0] = False
+    enters2, leaves2, _ = eng.step(pos, active2, space, radius)
+    leave_sets = pairs_to_setlist(leaves2, 256)
+    for j in sets0[0]:
+        assert 0 in leave_sets[j], f"entity {j} did not see entity 0 leave"
+    # And entity 0 lost all its neighbors.
+    assert leave_sets[0] == sets0[0]
+
+
+def test_asymmetric_radius():
+    """Per-entity radius: big-radius entity sees small, not vice versa."""
+    eng = engine()
+    n = 256
+    pos = np.zeros((n, 2), np.float32)
+    active = np.zeros(n, bool)
+    active[:2] = True
+    pos[0] = (0.0, 0.0)
+    pos[1] = (70.0, 0.0)
+    space = np.zeros(n, np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    radius[1] = 30.0
+    enters, _, _ = eng.step(pos, active, space, radius)
+    got = pairs_to_setlist(enters, n)
+    assert got[0] == {1}
+    assert got[1] == set()
+
+
+def test_wraparound_no_false_neighbors():
+    """Entities separated by more than a grid period still never match:
+    distance filter kills torus aliases."""
+    eng = engine()
+    n = 256
+    pos = np.zeros((n, 2), np.float32)
+    active = np.zeros(n, bool)
+    active[:2] = True
+    # 16 cells * 100 = 1600 period: these two alias to the same cell.
+    pos[0] = (50.0, 50.0)
+    pos[1] = (50.0 + 1600.0, 50.0)
+    space = np.zeros(n, np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    enters, _, _ = eng.step(pos, active, space, radius)
+    assert len(enters) == 0
+
+
+def test_overflow_reported():
+    p = NeighborParams(
+        capacity=256, max_neighbors=8, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=16384,
+    )
+    eng = NeighborEngine(p)
+    eng.reset()
+    pos = np.zeros((256, 2), np.float32)
+    active = np.ones(256, bool)
+    space = np.zeros(256, np.int32)
+    radius = np.full(256, 100.0, np.float32)
+    _, _, overflow = eng.step(pos, active, space, radius)
+    assert overflow == 256  # every entity has 255 > 8 true neighbors
+
+
+def test_negative_coordinates():
+    eng = engine()
+    pos, active, space, radius = make_world(256, 150, seed=3)
+    pos = pos - 800.0  # straddle the origin
+    enters, _, _ = eng.step(pos, active, space, radius)
+    got = pairs_to_setlist(enters, 256)
+    want = brute_force_sets(pos, active, space, radius)
+    assert got == want
+
+
+def test_chunked_drain_small_buffer():
+    """max_events far below the first-tick enter storm: chunked drain must
+    still deliver every event exactly once."""
+    p = NeighborParams(
+        capacity=256, max_neighbors=64, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=64,
+    )
+    eng = NeighborEngine(p)
+    eng.reset()
+    pos, active, space, radius = make_world(256, 200, seed=0)
+    enters, leaves, _ = eng.step(pos, active, space, radius)
+    got = pairs_to_setlist(enters, 256)
+    want = brute_force_sets(pos, active, space, radius)
+    assert got == want
+    # No duplicates across chunks.
+    assert len(enters) == sum(len(s) for s in want)
+
+
+def test_radius_exceeding_cell_size_rejected():
+    eng = engine()
+    pos, active, space, radius = make_world(256, 10, seed=5)
+    radius[:] = 150.0  # > cell_size 100 → 3x3 gather would miss neighbors
+    with pytest.raises(ValueError, match="cell_size"):
+        eng.step(pos, active, space, radius)
+
+
+def test_grid_capacity_drop_reported():
+    """More entities in one cell than cell_capacity: dropped count surfaces
+    via the engine diagnostics (entities become invisible, never silently)."""
+    p = NeighborParams(
+        capacity=256, max_neighbors=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=16, max_events=65536,
+    )
+    eng = NeighborEngine(p)
+    eng.reset()
+    pos = np.full((256, 2), 50.0, np.float32)  # all in one cell
+    active = np.ones(256, bool)
+    space = np.zeros(256, np.int32)
+    radius = np.full(256, 90.0, np.float32)
+    eng.step(pos, active, space, radius)
+    assert eng.last_grid_dropped == 256 - 16  # cell holds 16 of 256
+
+
+def test_determinism():
+    pos, active, space, radius = make_world(256, 200, seed=4)
+    e1, e2 = engine(), engine()
+    a, _, _ = e1.step(pos, active, space, radius)
+    b, _, _ = e2.step(pos, active, space, radius)
+    assert np.array_equal(a, b)

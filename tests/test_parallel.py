@@ -1,0 +1,89 @@
+"""Sharded (multi-device) AOI engine must agree exactly with the
+single-device engine on identical inputs — run on the virtual 8-device CPU
+mesh (conftest.py), the analog of the reference testing its multi-process
+cluster on localhost (SURVEY.md §4.3)."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.ops import NeighborEngine, NeighborParams
+from goworld_tpu.parallel import ShardedNeighborEngine, make_mesh
+
+PARAMS = NeighborParams(
+    capacity=512, max_neighbors=32, cell_size=100.0, grid_x=16, grid_z=16,
+    space_slots=4, cell_capacity=64, max_events=8192,
+)
+
+
+def make_world(n, n_active, seed, world=1200.0, n_spaces=3):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(0, world, size=(n, 2)).astype(np.float32)
+    active = np.zeros(n, bool)
+    active[:n_active] = True
+    space = rng.integers(0, n_spaces, size=n).astype(np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    return pos, active, space, radius
+
+
+def to_sets(pairs, n):
+    out = [set() for _ in range(n)]
+    for a, b in pairs:
+        out[int(a)].add(int(b))
+    return out
+
+
+def test_sharded_matches_single_device():
+    mesh = make_mesh(8)
+    single = NeighborEngine(PARAMS)
+    sharded = ShardedNeighborEngine(PARAMS, mesh)
+    single.reset()
+    sharded.reset()
+
+    rng = np.random.default_rng(7)
+    pos, active, space, radius = make_world(512, 400, seed=7)
+    for tick in range(5):
+        pos = np.clip(
+            pos + rng.normal(0, 20, pos.shape), 0, 1500
+        ).astype(np.float32)
+        e1, l1, o1 = single.step(pos, active, space, radius)
+        e2, l2, o2 = sharded.step(pos, active, space, radius)
+        assert to_sets(e1, 512) == to_sets(e2, 512), f"enters differ @ tick {tick}"
+        assert to_sets(l1, 512) == to_sets(l2, 512), f"leaves differ @ tick {tick}"
+        assert o1 == o2
+
+
+def test_sharded_neighbor_state_matches():
+    mesh = make_mesh(8)
+    single = NeighborEngine(PARAMS)
+    sharded = ShardedNeighborEngine(PARAMS, mesh)
+    single.reset()
+    sharded.reset()
+    pos, active, space, radius = make_world(512, 512, seed=9)
+    single.step(pos, active, space, radius)
+    sharded.step(pos, active, space, radius)
+    assert np.array_equal(np.asarray(single.neighbors), np.asarray(sharded._neighbors))
+
+
+def test_sharded_chunked_drain_small_buffer():
+    p = NeighborParams(
+        capacity=512, max_neighbors=32, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=128,
+    )
+    mesh = make_mesh(8)
+    single = NeighborEngine(PARAMS)  # big buffer reference
+    sharded = ShardedNeighborEngine(p, mesh)  # tiny buffer, must chunk
+    single.reset()
+    sharded.reset()
+    pos, active, space, radius = make_world(512, 400, seed=11)
+    e1, _, _ = single.step(pos, active, space, radius)
+    e2, _, _ = sharded.step(pos, active, space, radius)
+    assert to_sets(e1, 512) == to_sets(e2, 512)
+    assert len(e1) == len(e2)  # exactly-once across chunks
+
+
+def test_capacity_must_divide():
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError):
+        ShardedNeighborEngine(
+            NeighborParams(capacity=520, grid_x=8, grid_z=8), mesh
+        )
