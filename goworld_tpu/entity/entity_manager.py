@@ -1,0 +1,509 @@
+"""Entity registration, creation, routing and process-level operations.
+
+Reference parity: ``engine/entity/EntityManager.go`` — type registry with
+declarative attr flags (:154-193), createEntity (:233-277), restoreEntity
+(:279-339), load-with-persistent-filter (:341-375), Call routing (:433-446),
+CallNilSpaces (:448-459), Freeze/RestoreFreezedEntities (:554-656) — plus
+``SpaceManager.go`` and the nil-space bookkeeping of ``space_ops.go:32-50``.
+
+The ``Runtime`` object is the seam between pure entity logic and the process
+around it (timers, post queue, storage, AOI backend, dispatcher presence); a
+default Runtime makes the whole runtime unit-testable in-process, matching
+how reference entity tests run without a dispatcher (SURVEY.md §4.1).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional, Type
+
+from goworld_tpu import consts, dispatchercluster
+from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
+from goworld_tpu.entity.attrs import MapAttr
+from goworld_tpu.entity.entity import (
+    SIF_SYNC_NEIGHBOR_CLIENTS,
+    SIF_SYNC_OWN_CLIENT,
+    Entity,
+    EntityTypeDesc,
+)
+from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.entity.space import SPACE_KIND_NIL, Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.proto.conn import pack_sync_record
+from goworld_tpu.utils import gwlog, gwutils, post as post_mod
+from goworld_tpu.utils.timer import TimerService
+
+
+class Runtime:
+    """Process context for entity logic (see module docstring)."""
+
+    def __init__(self) -> None:
+        self.gameid: int = 1
+        self.timer_service = TimerService()
+        self.save_interval: float = 0.0  # 0 = no periodic save (tests)
+        self.position_sync_interval: float = consts.POSITION_SYNC_INTERVAL
+        self.aoi_backend: str = "xzlist"  # xzlist | batched
+        self.aoi_service = None  # BatchAOIService, lazily created
+        self.aoi_params = None  # NeighborParams override
+        self.storage = None  # object with .save/.load/.exists (storage module)
+
+    def post(self, cb) -> None:
+        post_mod.post(cb)
+
+    def now(self) -> float:
+        return time.monotonic()
+
+    def timer_service_for(self, entity) -> TimerService:
+        return self.timer_service
+
+    # --- AOI backend -------------------------------------------------------
+
+    def get_aoi_service(self):
+        if self.aoi_service is None:
+            from goworld_tpu.entity.aoi.batched import BatchAOIService
+            from goworld_tpu.ops.neighbor import NeighborParams
+
+            params = self.aoi_params or NeighborParams()
+            self.aoi_service = BatchAOIService(params)
+        return self.aoi_service
+
+    def new_aoi_manager(self, distance: float):
+        if self.aoi_backend == "xzlist":
+            from goworld_tpu.entity.aoi.xzlist import XZListAOIManager
+
+            return XZListAOIManager(distance)
+        from goworld_tpu.entity.aoi.batched import BatchSpaceAOIManager
+
+        return BatchSpaceAOIManager(self.get_aoi_service(), distance)
+
+    # --- persistence -------------------------------------------------------
+
+    def save_entity(self, typename: str, eid: str, data: dict) -> None:
+        if self.storage is not None:
+            self.storage.save(typename, eid, data)
+
+    def load_entity(self, typename: str, eid: str) -> Optional[dict]:
+        if self.storage is not None:
+            return self.storage.load(typename, eid)
+        return None
+
+    # --- ticking (tests / embedded) ----------------------------------------
+
+    def tick(self) -> None:
+        self.timer_service.tick()
+        if self.aoi_service is not None:
+            self.aoi_service.tick()
+        post_mod.tick()
+
+
+runtime = Runtime()
+
+_registry: dict[str, EntityTypeDesc] = {}
+_space_class: Optional[Type[Space]] = None
+_entities: dict[str, Entity] = {}
+_spaces: dict[str, Space] = {}
+_client_owners: dict[str, Entity] = {}
+_save_interval_override: Optional[float] = None
+
+
+# --- registration (EntityManager.go:154-193) --------------------------------
+
+
+def register_entity(entity_class: Type[Entity], typename: str | None = None) -> EntityTypeDesc:
+    name = typename or entity_class.__name__
+    if name in _registry:
+        raise ValueError(f"entity type {name!r} already registered")
+    desc = EntityTypeDesc(name, entity_class)
+    desc.is_space = issubclass(entity_class, Space)
+    if desc.is_space:
+        # AOI enablement must survive storage round-trips (Space.go:117-125).
+        desc.define_attr("_EnableAOI", "Persistent")
+    describe = getattr(entity_class, "describe_entity_type", None)
+    if describe is not None:
+        describe(desc)
+    entity_class._type_desc = desc
+    _registry[name] = desc
+    return desc
+
+
+def register_space(space_class: Type[Space]) -> EntityTypeDesc:
+    """Register THE space class of this game (reference RegisterSpace)."""
+    global _space_class
+    desc = register_entity(space_class)
+    _space_class = space_class
+    return desc
+
+
+def get_entity_type_desc(typename: str) -> EntityTypeDesc:
+    return _registry[typename]
+
+
+# --- creation (EntityManager.go:233-277) ------------------------------------
+
+
+def create_entity_locally(
+    typename: str,
+    eid: str | None = None,
+    attrs: dict | None = None,
+    space: Space | None = None,
+    pos: Vector3 | None = None,
+) -> Entity:
+    desc = _registry.get(typename)
+    if desc is None:
+        raise KeyError(f"entity type {typename!r} not registered")
+    if desc.is_space:
+        raise TypeError(f"{typename} is a space type; use create_space_locally")
+    return _new_entity(desc, eid, attrs, space, pos)
+
+
+def _new_entity(
+    desc: EntityTypeDesc,
+    eid: str | None,
+    attrs: dict | None,
+    space: Space | None,
+    pos: Vector3 | None,
+    kind: int | None = None,
+) -> Entity:
+    e = desc.entity_class()
+    e.id = eid or gen_entity_id()
+    if e.id in _entities:
+        raise ValueError(f"entity id {e.id} already exists")
+    root = MapAttr()
+    e._bind_attrs(root)
+    if attrs:
+        root.assign(attrs)
+    if isinstance(e, Space) and kind is not None:
+        e.kind = kind
+    _entities[e.id] = e
+    if isinstance(e, Space):
+        _spaces[e.id] = e
+    gwutils.run_panicless(e.on_init)
+    if isinstance(e, Space):
+        e._maybe_restore_aoi()
+        gwutils.run_panicless(e.on_space_init)
+    gwutils.run_panicless(e.on_attrs_ready)
+    # Tell the dispatcher this entity lives here (DispatcherService.go:643-661).
+    dispatchercluster.select_by_entity_id(e.id).send_notify_create_entity(e.id)
+    interval = _save_interval_override if _save_interval_override is not None else runtime.save_interval
+    e._start_save_timer(interval)
+    gwutils.run_panicless(e.on_created)
+    if isinstance(e, Space):
+        gwutils.run_panicless(e.on_space_created)
+    if space is not None:
+        space._enter(e, pos or Vector3())
+    return e
+
+
+def create_space_locally(kind: int, eid: str | None = None, attrs: dict | None = None) -> Space:
+    if _space_class is None:
+        raise RuntimeError("no space class registered (register_space)")
+    if kind == SPACE_KIND_NIL:
+        raise ValueError("kind 0 is reserved for nil spaces")
+    return _new_entity(_space_class._type_desc, eid, attrs, None, None, kind=kind)  # type: ignore[union-attr]
+
+
+def create_space_somewhere(kind: int) -> None:
+    """Ask the dispatcher to create a space on the least-loaded game."""
+    if not dispatchercluster.is_connected():
+        create_space_locally(kind)
+        return
+    eid = gen_entity_id()
+    dispatchercluster.select_by_entity_id(eid).send_create_entity_somewhere(
+        0, _space_class._type_desc.typename, eid, {"_kind": kind}  # type: ignore[union-attr]
+    )
+
+
+def create_nil_space(gameid: int) -> Space:
+    """The per-game nil space with deterministic id (space_ops.go:32-46)."""
+    if _space_class is None:
+        raise RuntimeError("no space class registered (register_space)")
+    eid = get_nil_space_id(gameid)
+    return _new_entity(_space_class._type_desc, eid, None, None, None, kind=SPACE_KIND_NIL)
+
+
+def get_nil_space_id(gameid: int) -> str:
+    return gen_fixed_entity_id(gameid)
+
+
+def get_nil_space() -> Optional[Space]:
+    return _spaces.get(get_nil_space_id(runtime.gameid))
+
+
+def create_entity_somewhere(typename: str, attrs: dict | None = None, gameid: int = 0) -> str:
+    """Create on some game (0 = dispatcher load-balanced choose,
+    DispatcherService.go:529-542). Returns the pre-generated entity id."""
+    eid = gen_entity_id()
+    if not dispatchercluster.is_connected():
+        create_entity_locally(typename, eid=eid, attrs=attrs)
+        return eid
+    dispatchercluster.select_by_entity_id(eid).send_create_entity_somewhere(
+        gameid, typename, eid, attrs or {}
+    )
+    return eid
+
+
+# --- load from storage (EntityManager.go:341-375) ---------------------------
+
+
+def load_entity_locally(typename: str, eid: str) -> Optional[Entity]:
+    if eid in _entities:
+        return _entities[eid]
+    data = runtime.load_entity(typename, eid)
+    if data is None:
+        return None
+    desc = _registry[typename]
+    persistent = {k: v for k, v in data.items() if k in desc.persistent_attrs}
+    return _new_entity(desc, eid, persistent, None, None)
+
+
+def load_entity_somewhere(typename: str, eid: str, gameid: int = 0) -> None:
+    if not dispatchercluster.is_connected():
+        load_entity_locally(typename, eid)
+        return
+    dispatchercluster.select_by_entity_id(eid).send_load_entity_somewhere(
+        typename, eid, gameid
+    )
+
+
+# --- lookup / call (EntityManager.go:103-152,433-446) -----------------------
+
+
+def get_entity(eid: str) -> Optional[Entity]:
+    return _entities.get(eid)
+
+
+def get_space(eid: str) -> Optional[Space]:
+    return _spaces.get(eid)
+
+
+def get_entities_by_type(typename: str) -> list[Entity]:
+    return [e for e in _entities.values() if e.typename == typename]
+
+
+def entities() -> dict[str, Entity]:
+    return _entities
+
+
+def call_entity(eid: str, method: str, *args) -> None:
+    """Local direct dispatch, else route via the entity's dispatcher."""
+    e = _entities.get(eid)
+    if e is not None:
+        e.on_call_from_remote(method, args, None)
+        return
+    dispatchercluster.select_by_entity_id(eid).send_call_entity_method(eid, method, args)
+
+
+def call_nil_spaces(method: str, *args) -> None:
+    """Call a method on every game's nil space (EntityManager.go:448-459)."""
+    ns = get_nil_space()
+    if ns is not None:
+        ns.on_call_from_remote(method, args, None)
+    if dispatchercluster.is_connected():
+        dispatchercluster.select_by_entity_id(
+            get_nil_space_id(runtime.gameid)
+        ).send_call_nil_spaces(runtime.gameid, method, args)
+
+
+def handle_call(eid: str, method: str, args: tuple, clientid: str | None) -> None:
+    e = _entities.get(eid)
+    if e is None:
+        gwlog.warnf("call %s on unknown entity %s (migrated away?)", method, eid)
+        return
+    e.on_call_from_remote(method, args, clientid)
+
+
+# --- client bookkeeping ------------------------------------------------------
+
+
+def on_client_attached(clientid: str, entity: Entity) -> None:
+    _client_owners[clientid] = entity
+
+
+def on_client_detached(clientid: str, entity: Entity) -> None:
+    if _client_owners.get(clientid) is entity:
+        del _client_owners[clientid]
+
+
+def get_client_owner(clientid: str) -> Optional[Entity]:
+    return _client_owners.get(clientid)
+
+
+def on_gate_disconnected(gateid: int) -> None:
+    """Detach every client of a dead gate (EntityManager.go:145-152)."""
+    for e in [e for e in _client_owners.values() if e.client and e.client.gateid == gateid]:
+        e.notify_client_disconnected()
+
+
+# --- destroy bookkeeping -----------------------------------------------------
+
+
+def on_entity_destroyed(entity: Entity, is_migrate: bool) -> None:
+    _entities.pop(entity.id, None)
+    if not is_migrate:
+        dispatchercluster.select_by_entity_id(entity.id).send_notify_destroy_entity(
+            entity.id
+        )
+
+
+def on_space_destroyed(space: Space) -> None:
+    _spaces.pop(space.id, None)
+
+
+# --- save interval -----------------------------------------------------------
+
+
+def set_save_interval(interval: float) -> None:
+    global _save_interval_override
+    _save_interval_override = interval
+
+
+# --- game-ready --------------------------------------------------------------
+
+
+def on_game_ready() -> None:
+    """Deployment became ready: notify nil space first, then all entities."""
+    ns = get_nil_space()
+    if ns is not None:
+        gwutils.run_panicless(ns.on_game_ready)
+    for e in list(_entities.values()):
+        if e is not ns:
+            gwutils.run_panicless(e.on_game_ready)
+
+
+# --- position sync collection (Entity.go:1221-1267) --------------------------
+
+
+def collect_entity_sync_infos() -> dict[int, bytearray]:
+    """Build one buffer per gate of [clientid(16) + 32B sync record] blocks
+    for every entity whose position/yaw changed since last collection."""
+    per_gate: dict[int, bytearray] = {}
+    for e in _entities.values():
+        flag = e._sync_info_flag
+        if not flag:
+            continue
+        e._sync_info_flag = 0
+        record = pack_sync_record(
+            e.id, e.position.x, e.position.y, e.position.z, e.yaw
+        )
+        if (
+            flag & SIF_SYNC_OWN_CLIENT
+            and e.client is not None
+            and not e._syncing_from_client
+        ):
+            buf = per_gate.setdefault(e.client.gateid, bytearray())
+            buf += e.client.clientid.encode("ascii") + record
+        if flag & SIF_SYNC_NEIGHBOR_CLIENTS:
+            for other in e.interested_by:
+                c = other.client
+                if c is not None:
+                    buf = per_gate.setdefault(c.gateid, bytearray())
+                    buf += c.clientid.encode("ascii") + record
+    return per_gate
+
+
+# --- migration receive side (EntityManager.go:279-339) -----------------------
+
+
+def restore_entity(eid: str, data: dict, is_migrate: bool) -> Entity:
+    """Rebuild an entity from migrate/freeze data: struct, attrs, timers,
+    client binding, space membership."""
+    desc = _registry[data["type"]]
+    e = desc.entity_class()
+    e.id = eid
+    if e.id in _entities:
+        raise ValueError(f"restore: entity {eid} already exists")
+    root = MapAttr()
+    e._bind_attrs(root)
+    root.assign(data["attrs"])
+    if isinstance(e, Space):
+        e.kind = data.get("kind", SPACE_KIND_NIL)
+    _entities[e.id] = e
+    if isinstance(e, Space):
+        _spaces[e.id] = e
+    gwutils.run_panicless(e.on_init)
+    if isinstance(e, Space):
+        e._maybe_restore_aoi()
+        gwutils.run_panicless(e.on_space_init)
+    gwutils.run_panicless(e.on_attrs_ready)
+    if is_migrate:
+        dispatchercluster.select_by_entity_id(e.id).send_notify_create_entity(e.id)
+    interval = _save_interval_override if _save_interval_override is not None else runtime.save_interval
+    e._start_save_timer(interval)
+    e._syncing_from_client = data.get("syncing", False)
+    e._restore_timers(data.get("timers", []))
+    client = data.get("client")
+    if client is not None:
+        # Reattach quietly: the client already has the entity mirror.
+        gc = GameClient(client["clientid"], client["gateid"], e.id)
+        e.client = gc
+        on_client_attached(gc.clientid, e)
+    pos = data.get("pos") or [0.0, 0.0, 0.0]
+    e.position = Vector3(*pos)
+    e.yaw = data.get("yaw", 0.0)
+    spaceid = data.get("space_id")
+    if spaceid:
+        space = _spaces.get(spaceid)
+        if space is not None:
+            space._enter(e, e.position)
+    if is_migrate:
+        gwutils.run_panicless(e.on_migrate_in)
+    else:
+        gwutils.run_panicless(e.on_restored)
+    return e
+
+
+# --- freeze / restore (EntityManager.go:554-656) -----------------------------
+
+
+def freeze_entities(gameid: int) -> dict:
+    """Pack every entity for process freeze. Requires exactly one nil space
+    (EntityManager.go:578-584)."""
+    nil_id = get_nil_space_id(gameid)
+    if nil_id not in _spaces:
+        raise RuntimeError("freeze requires the nil space to exist")
+    frozen_spaces: dict[str, dict] = {}
+    frozen_entities: dict[str, dict] = {}
+    for e in _entities.values():
+        gwutils.run_panicless(e.on_freeze)
+        data = e.get_freeze_data()
+        if isinstance(e, Space):
+            data["kind"] = e.kind
+            frozen_spaces[e.id] = data
+        else:
+            frozen_entities[e.id] = data
+    return {
+        "gameid": gameid,
+        "nil_space_id": nil_id,
+        "spaces": frozen_spaces,
+        "entities": frozen_entities,
+    }
+
+
+def restore_freezed_entities(data: dict) -> None:
+    """3-pass restore: nil space → other spaces → entities
+    (EntityManager.go:630-643)."""
+    nil_id = data["nil_space_id"]
+    spaces = data["spaces"]
+    if nil_id in spaces:
+        restore_entity(nil_id, spaces[nil_id], is_migrate=False)
+    for sid, sdata in spaces.items():
+        if sid != nil_id:
+            restore_entity(sid, sdata, is_migrate=False)
+    for eid, edata in data["entities"].items():
+        restore_entity(eid, edata, is_migrate=False)
+
+
+# --- test / process reset ----------------------------------------------------
+
+
+def cleanup_for_tests() -> None:
+    """Reset all module state (tests and process teardown)."""
+    global _space_class, _save_interval_override, runtime
+    _entities.clear()
+    _spaces.clear()
+    _registry.clear()
+    _client_owners.clear()
+    _space_class = None
+    _save_interval_override = None
+    runtime = Runtime()
+    post_mod.clear()

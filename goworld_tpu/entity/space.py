@@ -1,0 +1,133 @@
+"""Spaces: containers of entities with optional AOI.
+
+Reference parity: ``engine/entity/Space.go`` — Space is itself an entity
+(Space.go:26-34); enter/leave/move with AOI bookkeeping (Space.go:188-261);
+``EnableAOI`` picks the manager (Space.go:105-125); one **nil space** per game
+with a deterministic id for cross-game placement and CallNilSpaces broadcast
+(space_ops.go:32-46); the persisted ``_EnableAOI`` attr re-enables AOI after
+freeze/restore (Space.go:117-125).
+"""
+
+from __future__ import annotations
+
+from goworld_tpu.entity.entity import Entity, EntityTypeDesc
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.utils import gwlog, gwutils
+
+_ENABLE_AOI_KEY = "_EnableAOI"
+SPACE_KIND_NIL = 0
+
+
+class Space(Entity):
+    """Base class for spaces; user spaces subclass this (MySpace etc.)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.entities: set[Entity] = set()
+        self.kind = SPACE_KIND_NIL
+        self.aoi_mgr = None
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def on_space_init(self) -> None:
+        pass
+
+    def on_space_created(self) -> None:
+        pass
+
+    def on_space_destroy(self) -> None:
+        pass
+
+    def on_entity_enter_space(self, entity: Entity) -> None:
+        pass
+
+    def on_entity_leave_space(self, entity: Entity) -> None:
+        pass
+
+    def on_game_ready(self) -> None:
+        """Nil space's on_game_ready is the user code entry point
+        (Space.go:324-326)."""
+
+    def on_destroy(self) -> None:
+        # Evict remaining entities, then drop the AOI manager.
+        for e in list(self.entities):
+            self._leave(e)
+        if self.aoi_mgr is not None:
+            self.aoi_mgr.destroy()
+            self.aoi_mgr = None
+        gwutils.run_panicless(self.on_space_destroy)
+        from goworld_tpu.entity import entity_manager
+
+        entity_manager.on_space_destroyed(self)
+
+    # --- nil space ---------------------------------------------------------
+
+    def is_nil(self) -> bool:
+        return self.kind == SPACE_KIND_NIL
+
+    # --- AOI ---------------------------------------------------------------
+
+    def enable_aoi(self, distance: float) -> None:
+        """Turn on AOI for this space (Space.go:105-125). Backend comes from
+        [aoi] config: xzlist (CPU, synchronous) or batched TPU engine."""
+        if self.aoi_mgr is not None:
+            gwlog.errorf("%s: AOI already enabled", self)
+            return
+        if len(self.entities) > 0:
+            # Mirror of the reference's constraint (Space.go:118: panics if
+            # entities exist): enabling late would miss existing members.
+            raise RuntimeError("enable_aoi must be called before entities enter")
+        self.attrs.set(_ENABLE_AOI_KEY, float(distance))
+        self._create_aoi_manager(distance)
+
+    def _create_aoi_manager(self, distance: float) -> None:
+        from goworld_tpu.entity import entity_manager
+
+        self.aoi_mgr = entity_manager.runtime.new_aoi_manager(distance)
+
+    def _maybe_restore_aoi(self) -> None:
+        """Re-enable AOI from the persisted attr after load/restore."""
+        dist = self.attrs.get(_ENABLE_AOI_KEY)
+        if dist and self.aoi_mgr is None:
+            self._create_aoi_manager(float(dist))
+
+    # --- membership (Space.go:188-261) -------------------------------------
+
+    def _enter(self, entity: Entity, pos: Vector3) -> None:
+        entity.space = self
+        entity.position = pos
+        self.entities.add(entity)
+        if self.aoi_mgr is not None and entity.type_desc.use_aoi:
+            self.aoi_mgr.enter(entity, pos.x, pos.z)
+        gwutils.run_panicless(entity.on_enter_space)
+        gwutils.run_panicless(lambda: self.on_entity_enter_space(entity))
+
+    def _leave(self, entity: Entity) -> None:
+        if entity.space is not self:
+            return
+        if self.aoi_mgr is not None and entity.type_desc.use_aoi:
+            self.aoi_mgr.leave(entity)
+        self.entities.discard(entity)
+        entity.space = None
+        gwutils.run_panicless(lambda: entity.on_leave_space(self))
+        gwutils.run_panicless(lambda: self.on_entity_leave_space(entity))
+
+    def _move(self, entity: Entity, pos: Vector3) -> None:
+        if self.aoi_mgr is not None and entity.type_desc.use_aoi:
+            self.aoi_mgr.moved(entity, pos.x, pos.z)
+
+    # --- helpers -----------------------------------------------------------
+
+    def create_entity(self, typename: str, pos: Vector3 | None = None, attrs: dict | None = None):
+        """Create an entity directly into this space."""
+        from goworld_tpu.entity import entity_manager
+
+        return entity_manager.create_entity_locally(
+            typename, attrs=attrs, space=self, pos=pos or Vector3()
+        )
+
+    def get_entity_count(self) -> int:
+        return len(self.entities)
+
+    def __repr__(self) -> str:
+        return f"Space<{self.typename}|{self.id}|kind={self.kind}>"

@@ -1,0 +1,124 @@
+"""Length-prefixed packet framing over an asyncio stream.
+
+Reference parity: ``engine/netutil/PacketConnection.go:50-186`` — every wire
+message is [u32 LE payload length][payload]; payloads are capped at 25 MiB
+(PacketConnection.go:23). The reference queues sends and flushes on a 5 ms
+timer to batch small writes (GoWorldConnection.go:437-452); asyncio's
+transport write buffering plus an explicit ``flush_interval`` drain task
+provides the same batching.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from goworld_tpu import consts
+from goworld_tpu.netutil.packet import Packet
+
+_LEN = struct.Struct("<I")
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+class PacketConnection:
+    """Framed packet transport over an asyncio (reader, writer) pair."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        flush_interval: float = consts.FLUSH_INTERVAL,
+    ) -> None:
+        self._reader = reader
+        self._writer = writer
+        self._flush_interval = flush_interval
+        self._pending: list[bytes] = []
+        self._flush_task: asyncio.Task | None = None
+        self._closed = False
+        self.dropped = 0  # packets discarded because the conn was closed
+
+    @property
+    def peername(self):
+        try:
+            return self._writer.get_extra_info("peername")
+        except Exception:
+            return None
+
+    # --- send --------------------------------------------------------------
+
+    def send_packet(self, msgtype: int, packet: Packet) -> None:
+        """Queue one packet; wire format = [len][u16 msgtype][payload].
+
+        Sends on a closed connection are counted and dropped (the reference
+        likewise drops packets to dead peers; reconnect logic re-syncs state,
+        DispatcherConnMgr.go:66-88)."""
+        if self._closed:
+            self.dropped += 1
+            return
+        payload = packet.payload
+        total = 2 + len(payload)
+        if total > consts.MAX_PACKET_SIZE:
+            raise ValueError(f"packet too large: {total}")
+        buf = _LEN.pack(total) + struct.pack("<H", msgtype) + payload
+        self._pending.append(buf)
+        if self._flush_task is None or self._flush_task.done():
+            self._flush_task = asyncio.get_running_loop().create_task(
+                self._flush_later()
+            )
+
+    async def _flush_later(self) -> None:
+        if self._flush_interval > 0:
+            await asyncio.sleep(self._flush_interval)
+        self.flush()
+
+    def flush(self) -> None:
+        if self._closed or not self._pending:
+            return
+        data = b"".join(self._pending)
+        self._pending.clear()
+        try:
+            self._writer.write(data)
+        except Exception:
+            self._closed = True
+
+    async def drain(self) -> None:
+        self.flush()
+        try:
+            await self._writer.drain()
+        except Exception:
+            self._closed = True
+            raise ConnectionClosed("drain failed")
+
+    # --- recv --------------------------------------------------------------
+
+    async def recv_packet(self) -> tuple[int, Packet]:
+        """Read one framed packet; returns (msgtype, packet)."""
+        try:
+            header = await self._reader.readexactly(4)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            raise ConnectionClosed("connection closed while reading length")
+        (length,) = _LEN.unpack(header)
+        if length < 2 or length > consts.MAX_PACKET_SIZE:
+            raise ConnectionClosed(f"bad packet length {length}")
+        try:
+            body = await self._reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            raise ConnectionClosed("connection closed while reading body")
+        msgtype = struct.unpack_from("<H", body, 0)[0]
+        return msgtype, Packet(body[2:])
+
+    # --- close -------------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._writer.close()
+        except Exception:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

@@ -357,13 +357,17 @@ class NeighborEngine:
         return enters, leaves, int(res.overflow)
 
     def _check_radius(self, radius: np.ndarray, active: np.ndarray) -> None:
-        """The 3x3 cell gather only covers AOI distance <= cell_size: a larger
-        radius would silently miss true neighbors, so reject it loudly."""
-        r = np.asarray(radius)
-        a = np.asarray(active)
-        if a.any() and float(r[a].max()) > self.params.cell_size:
-            raise ValueError(
-                f"AOI radius {float(r[a].max())} exceeds cell_size "
-                f"{self.params.cell_size}; enlarge cell_size (it must be >= "
-                f"the maximum AOI distance)"
-            )
+        check_radius(self.params, radius, active)
+
+
+def check_radius(params: NeighborParams, radius: np.ndarray, active: np.ndarray) -> None:
+    """The 3x3 cell gather only covers AOI distance <= cell_size: a larger
+    radius would silently miss true neighbors, so reject it loudly."""
+    r = np.asarray(radius)
+    a = np.asarray(active)
+    if a.any() and float(r[a].max()) > params.cell_size:
+        raise ValueError(
+            f"AOI radius {float(r[a].max())} exceeds cell_size "
+            f"{params.cell_size}; enlarge cell_size (it must be >= "
+            f"the maximum AOI distance)"
+        )

@@ -202,6 +202,9 @@ class ShardedNeighborEngine:
         radius: np.ndarray,
     ) -> tuple[np.ndarray, np.ndarray, int]:
         """Run one tick; returns host (enter_pairs, leave_pairs, overflow)."""
+        from goworld_tpu.ops.neighbor import check_radius
+
+        check_radius(self.params, radius, active)
         res = self.step_device(
             jnp.asarray(pos, jnp.float32),
             jnp.asarray(active, jnp.bool_),

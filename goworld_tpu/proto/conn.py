@@ -1,0 +1,405 @@
+"""Typed message senders over a PacketConnection.
+
+Reference parity: ``engine/proto/GoWorldConnection.go:16-497`` — one SendXxx
+method per message type, so payload layouts live in exactly one place.
+Position-sync records are fixed 32 B = EntityID(16) + x,y,z,yaw float32
+(proto.go:135-139).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.packet_conn import PacketConnection
+from goworld_tpu.proto.msgtypes import FilterOp, MsgType
+
+SYNC_RECORD_SIZE = 16 + 4 * 4  # EntityID + x,y,z,yaw (proto.go:135-139)
+_SYNC = struct.Struct("<16s4f")
+
+
+def pack_sync_record(eid: str, x: float, y: float, z: float, yaw: float) -> bytes:
+    return _SYNC.pack(eid.encode("ascii"), x, y, z, yaw)
+
+
+def unpack_sync_records(data: bytes) -> list[tuple[str, float, float, float, float]]:
+    out = []
+    for off in range(0, len(data), SYNC_RECORD_SIZE):
+        eid, x, y, z, yaw = _SYNC.unpack_from(data, off)
+        out.append((eid.decode("ascii"), x, y, z, yaw))
+    return out
+
+
+class GoWorldConnection:
+    """Wraps a PacketConnection with typed senders."""
+
+    def __init__(self, conn: PacketConnection) -> None:
+        self.conn = conn
+
+    # --- generic -----------------------------------------------------------
+
+    def send(self, msgtype: int, packet: Packet) -> None:
+        self.conn.send_packet(msgtype, packet)
+
+    def send_packet_raw(self, msgtype: int, payload: bytes) -> None:
+        self.conn.send_packet(msgtype, Packet(payload))
+
+    async def recv(self):
+        return await self.conn.recv_packet()
+
+    def flush(self) -> None:
+        self.conn.flush()
+
+    def close(self) -> None:
+        self.conn.close()
+
+    @property
+    def closed(self) -> bool:
+        return self.conn.closed
+
+    # --- handshakes --------------------------------------------------------
+
+    def send_set_game_id(
+        self,
+        gameid: int,
+        is_reconnect: bool,
+        is_restore: bool,
+        is_ban_boot_entity: bool,
+        entity_ids: list[str],
+    ) -> None:
+        """Game→dispatcher handshake (DispatcherConnMgr.go:66-88); carries the
+        game's live entity list for reconnect reconciliation
+        (DispatcherService.go:327-402)."""
+        p = Packet()
+        p.append_uint16(gameid)
+        p.append_bool(is_reconnect)
+        p.append_bool(is_restore)
+        p.append_bool(is_ban_boot_entity)
+        p.append_data(entity_ids)
+        self.send(MsgType.SET_GAME_ID, p)
+
+    def send_set_game_id_ack(
+        self,
+        online_games: list[int],
+        rejected_entity_ids: list[str],
+        kvreg_map: dict[str, str],
+        deployment_ready: bool,
+    ) -> None:
+        p = Packet()
+        p.append_data(
+            {
+                "online_games": online_games,
+                "rejected": rejected_entity_ids,
+                "kvreg": kvreg_map,
+                "ready": deployment_ready,
+            }
+        )
+        self.send(MsgType.SET_GAME_ID_ACK, p)
+
+    def send_set_gate_id(self, gateid: int) -> None:
+        p = Packet()
+        p.append_uint16(gateid)
+        self.send(MsgType.SET_GATE_ID, p)
+
+    # --- entity lifecycle notifications ------------------------------------
+
+    def send_notify_create_entity(self, eid: str) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        self.send(MsgType.NOTIFY_CREATE_ENTITY, p)
+
+    def send_notify_destroy_entity(self, eid: str) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        self.send(MsgType.NOTIFY_DESTROY_ENTITY, p)
+
+    # --- client lifecycle --------------------------------------------------
+
+    def send_notify_client_connected(self, clientid: str, gateid: int, boot_eid: str) -> None:
+        p = Packet()
+        p.append_client_id(clientid)
+        p.append_uint16(gateid)
+        p.append_entity_id(boot_eid)
+        self.send(MsgType.NOTIFY_CLIENT_CONNECTED, p)
+
+    def send_notify_client_disconnected(self, clientid: str, owner_eid: str) -> None:
+        p = Packet()
+        p.append_client_id(clientid)
+        p.append_entity_id(owner_eid)
+        self.send(MsgType.NOTIFY_CLIENT_DISCONNECTED, p)
+
+    # --- RPC ---------------------------------------------------------------
+
+    def send_call_entity_method(self, eid: str, method: str, args: tuple) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_varstr(method)
+        p.append_args(args)
+        self.send(MsgType.CALL_ENTITY_METHOD, p)
+
+    def send_call_entity_method_from_client(
+        self, eid: str, method: str, args: tuple, clientid: str
+    ) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_varstr(method)
+        p.append_args(args)
+        p.append_client_id(clientid)
+        self.send(MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, p)
+
+    def send_call_nil_spaces(self, except_game: int, method: str, args: tuple) -> None:
+        p = Packet()
+        p.append_uint16(except_game)
+        p.append_varstr(method)
+        p.append_args(args)
+        self.send(MsgType.CALL_NIL_SPACES, p)
+
+    # --- create/load somewhere ---------------------------------------------
+
+    def send_create_entity_somewhere(self, gameid: int, typename: str, eid: str, attrs: dict) -> None:
+        """gameid 0 = dispatcher picks the least-loaded game
+        (DispatcherService.go:529-542)."""
+        p = Packet()
+        p.append_uint16(gameid)
+        p.append_varstr(typename)
+        p.append_entity_id(eid)
+        p.append_data(attrs)
+        self.send(MsgType.CREATE_ENTITY_SOMEWHERE, p)
+
+    def send_load_entity_somewhere(self, typename: str, eid: str, gameid: int) -> None:
+        p = Packet()
+        p.append_uint16(gameid)
+        p.append_varstr(typename)
+        p.append_entity_id(eid)
+        self.send(MsgType.LOAD_ENTITY_SOMEWHERE, p)
+
+    # --- migration (Entity.go:956-1115, DispatcherService.go:850-907) ------
+
+    def send_query_space_gameid_for_migrate(self, spaceid: str, eid: str) -> None:
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_entity_id(eid)
+        self.send(MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE, p)
+
+    def send_query_space_gameid_for_migrate_ack(
+        self, spaceid: str, eid: str, gameid: int
+    ) -> None:
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_entity_id(eid)
+        p.append_uint16(gameid)
+        self.send(MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK, p)
+
+    def send_migrate_request(self, eid: str, spaceid: str, space_gameid: int) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_entity_id(spaceid)
+        p.append_uint16(space_gameid)
+        self.send(MsgType.MIGRATE_REQUEST, p)
+
+    def send_migrate_request_ack(self, eid: str, spaceid: str, space_gameid: int) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_entity_id(spaceid)
+        p.append_uint16(space_gameid)
+        self.send(MsgType.MIGRATE_REQUEST_ACK, p)
+
+    def send_real_migrate(self, eid: str, target_game: int, migrate_data: dict) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_uint16(target_game)
+        p.append_data(migrate_data)
+        self.send(MsgType.REAL_MIGRATE, p)
+
+    def send_cancel_migrate(self, eid: str) -> None:
+        p = Packet()
+        p.append_entity_id(eid)
+        self.send(MsgType.CANCEL_MIGRATE, p)
+
+    # --- position sync -----------------------------------------------------
+
+    def send_sync_position_yaw_from_client(self, records: bytes) -> None:
+        """records = concatenated 32 B sync records (gate→dispatcher,
+        GateService.go:398-425)."""
+        self.send_packet_raw(MsgType.SYNC_POSITION_YAW_FROM_CLIENT, records)
+
+    def send_sync_position_yaw_on_clients(self, gateid: int, records: bytes) -> None:
+        """records = concatenated [clientid(16) + 32 B sync record] blocks
+        (game→dispatcher→gate, Entity.go:1221-1267)."""
+        p = Packet()
+        p.append_uint16(gateid)
+        p.append_bytes(records)
+        self.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, p)
+
+    # --- process / deployment events ---------------------------------------
+
+    def send_notify_game_connected(self, gameid: int) -> None:
+        p = Packet()
+        p.append_uint16(gameid)
+        self.send(MsgType.NOTIFY_GAME_CONNECTED, p)
+
+    def send_notify_game_disconnected(self, gameid: int) -> None:
+        p = Packet()
+        p.append_uint16(gameid)
+        self.send(MsgType.NOTIFY_GAME_DISCONNECTED, p)
+
+    def send_notify_gate_disconnected(self, gateid: int) -> None:
+        p = Packet()
+        p.append_uint16(gateid)
+        self.send(MsgType.NOTIFY_GATE_DISCONNECTED, p)
+
+    def send_notify_deployment_ready(self) -> None:
+        self.send(MsgType.NOTIFY_DEPLOYMENT_READY, Packet())
+
+    def send_start_freeze_game(self) -> None:
+        self.send(MsgType.START_FREEZE_GAME, Packet())
+
+    def send_start_freeze_game_ack(self) -> None:
+        self.send(MsgType.START_FREEZE_GAME_ACK, Packet())
+
+    def send_kvreg_register(self, key: str, value: str, force: bool) -> None:
+        p = Packet()
+        p.append_varstr(key)
+        p.append_varstr(value)
+        p.append_bool(force)
+        self.send(MsgType.KVREG_REGISTER, p)
+
+    def send_game_lbc_info(self, cpu_percent: float) -> None:
+        p = Packet()
+        p.append_float32(cpu_percent)
+        self.send(MsgType.GAME_LBC_INFO, p)
+
+    # --- redirect range: game → client via gate ----------------------------
+    # Payloads start with [u16 gateid][clientid]; the dispatcher routes on the
+    # gateid (DispatcherService.go:841-844) and the gate strips the prefix
+    # before forwarding to the client (GateService.go:262-293).
+
+    def _client_packet(self, gateid: int, clientid: str) -> Packet:
+        p = Packet()
+        p.append_uint16(gateid)
+        p.append_client_id(clientid)
+        return p
+
+    def send_create_entity_on_client(
+        self,
+        gateid: int,
+        clientid: str,
+        is_player: bool,
+        eid: str,
+        typename: str,
+        client_attrs: dict,
+        x: float,
+        y: float,
+        z: float,
+        yaw: float,
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_bool(is_player)
+        p.append_entity_id(eid)
+        p.append_varstr(typename)
+        p.append_data(client_attrs)
+        p.append_float32(x).append_float32(y).append_float32(z).append_float32(yaw)
+        self.send(MsgType.CREATE_ENTITY_ON_CLIENT, p)
+
+    def send_destroy_entity_on_client(
+        self, gateid: int, clientid: str, typename: str, eid: str
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_varstr(typename)
+        p.append_entity_id(eid)
+        self.send(MsgType.DESTROY_ENTITY_ON_CLIENT, p)
+
+    def send_notify_map_attr_change_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list, key: str, val
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        p.append_varstr(key)
+        p.append_data(val)
+        self.send(MsgType.NOTIFY_MAP_ATTR_CHANGE_ON_CLIENT, p)
+
+    def send_notify_map_attr_del_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list, key: str
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        p.append_varstr(key)
+        self.send(MsgType.NOTIFY_MAP_ATTR_DEL_ON_CLIENT, p)
+
+    def send_notify_map_attr_clear_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        self.send(MsgType.NOTIFY_MAP_ATTR_CLEAR_ON_CLIENT, p)
+
+    def send_notify_list_attr_change_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list, index: int, val
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        p.append_uint32(index)
+        p.append_data(val)
+        self.send(MsgType.NOTIFY_LIST_ATTR_CHANGE_ON_CLIENT, p)
+
+    def send_notify_list_attr_pop_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        self.send(MsgType.NOTIFY_LIST_ATTR_POP_ON_CLIENT, p)
+
+    def send_notify_list_attr_append_on_client(
+        self, gateid: int, clientid: str, eid: str, path: list, val
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_data(path)
+        p.append_data(val)
+        self.send(MsgType.NOTIFY_LIST_ATTR_APPEND_ON_CLIENT, p)
+
+    def send_call_entity_method_on_client(
+        self, gateid: int, clientid: str, eid: str, method: str, args: tuple
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_entity_id(eid)
+        p.append_varstr(method)
+        p.append_args(args)
+        self.send(MsgType.CALL_ENTITY_METHOD_ON_CLIENT, p)
+
+    def send_set_clientproxy_filter_prop(
+        self, gateid: int, clientid: str, key: str, val: str
+    ) -> None:
+        p = self._client_packet(gateid, clientid)
+        p.append_varstr(key)
+        p.append_varstr(val)
+        self.send(MsgType.SET_CLIENTPROXY_FILTER_PROP, p)
+
+    def send_clear_clientproxy_filter_props(self, gateid: int, clientid: str) -> None:
+        p = self._client_packet(gateid, clientid)
+        self.send(MsgType.CLEAR_CLIENTPROXY_FILTER_PROPS, p)
+
+    # --- gate-handled broadcast --------------------------------------------
+
+    def send_call_filtered_client_proxies(
+        self, op: FilterOp, key: str, val: str, method: str, args: tuple
+    ) -> None:
+        """Broadcast an RPC to every client whose filter prop ``key`` compares
+        to ``val`` under ``op`` (gate FilterTree, GateService.go / FilterTree.go)."""
+        p = Packet()
+        p.append_byte(int(op))
+        p.append_varstr(key)
+        p.append_varstr(val)
+        p.append_varstr(method)
+        p.append_args(args)
+        self.send(MsgType.CALL_FILTERED_CLIENTS, p)
+
+    # --- client → gate -----------------------------------------------------
+
+    def send_heartbeat(self) -> None:
+        self.send(MsgType.HEARTBEAT_FROM_CLIENT, Packet())

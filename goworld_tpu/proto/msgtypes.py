@@ -1,0 +1,97 @@
+"""Message type space.
+
+Reference parity: ``engine/proto/proto.go:19-151``. The numeric ranges are
+semantic routing classes (the dispatcher routes by range, not by individual
+type — DispatcherService.go:214-285):
+
+- 1..999:      handled by the dispatcher itself
+- 1001..1499:  "redirect" range — game→dispatcher→gate→client; payload starts
+               with [u16 gateid][clientid] which the gate strips
+- 1501..1999:  handled by the gate (broadcast/filtered operations)
+- 2001..:      gate↔client only
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class MsgType(enum.IntEnum):
+    # --- dispatcher-handled (proto.go:19-76) -------------------------------
+    SET_GAME_ID = 1
+    SET_GAME_ID_ACK = 2
+    SET_GATE_ID = 3
+    NOTIFY_CREATE_ENTITY = 4
+    NOTIFY_DESTROY_ENTITY = 5
+    NOTIFY_CLIENT_CONNECTED = 6
+    NOTIFY_CLIENT_DISCONNECTED = 7
+    CALL_ENTITY_METHOD = 8
+    CALL_ENTITY_METHOD_FROM_CLIENT = 9
+    QUERY_SPACE_GAMEID_FOR_MIGRATE = 10
+    QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK = 11
+    MIGRATE_REQUEST = 12
+    MIGRATE_REQUEST_ACK = 13
+    REAL_MIGRATE = 14
+    CANCEL_MIGRATE = 15
+    LOAD_ENTITY_SOMEWHERE = 16
+    CREATE_ENTITY_SOMEWHERE = 17
+    CALL_NIL_SPACES = 18
+    SYNC_POSITION_YAW_FROM_CLIENT = 19
+    NOTIFY_GAME_CONNECTED = 20
+    NOTIFY_GAME_DISCONNECTED = 21
+    NOTIFY_GATE_DISCONNECTED = 22
+    NOTIFY_DEPLOYMENT_READY = 23
+    START_FREEZE_GAME = 24
+    START_FREEZE_GAME_ACK = 25
+    KVREG_REGISTER = 26
+    GAME_LBC_INFO = 27
+
+    # --- redirected to client via gate (proto.go:85-114) -------------------
+    CREATE_ENTITY_ON_CLIENT = 1001
+    DESTROY_ENTITY_ON_CLIENT = 1002
+    NOTIFY_MAP_ATTR_CHANGE_ON_CLIENT = 1003
+    NOTIFY_MAP_ATTR_DEL_ON_CLIENT = 1004
+    NOTIFY_MAP_ATTR_CLEAR_ON_CLIENT = 1005
+    NOTIFY_LIST_ATTR_CHANGE_ON_CLIENT = 1006
+    NOTIFY_LIST_ATTR_POP_ON_CLIENT = 1007
+    NOTIFY_LIST_ATTR_APPEND_ON_CLIENT = 1008
+    CALL_ENTITY_METHOD_ON_CLIENT = 1009
+    SET_CLIENTPROXY_FILTER_PROP = 1010
+    CLEAR_CLIENTPROXY_FILTER_PROPS = 1011
+
+    # --- gate-handled (proto.go:116-123) -----------------------------------
+    CALL_FILTERED_CLIENTS = 1501
+    SYNC_POSITION_YAW_ON_CLIENTS = 1502
+
+    # --- gate↔client direct (proto.go:126-133) -----------------------------
+    HEARTBEAT_FROM_CLIENT = 2001
+
+
+REDIRECT_MIN = 1001
+REDIRECT_MAX = 1499
+GATE_MIN = 1501
+GATE_MAX = 1999
+CLIENT_MIN = 2001
+
+
+def is_dispatcher_handled(t: int) -> bool:
+    return t < 1000
+
+
+def is_gate_redirect(t: int) -> bool:
+    return REDIRECT_MIN <= t <= REDIRECT_MAX
+
+
+def is_gate_handled(t: int) -> bool:
+    return GATE_MIN <= t <= GATE_MAX
+
+
+class FilterOp(enum.IntEnum):
+    """Filtered-client broadcast comparison ops (proto.go:142-151)."""
+
+    EQ = 0
+    NE = 1
+    LT = 2
+    LTE = 3
+    GT = 4
+    GTE = 5

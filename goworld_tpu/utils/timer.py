@@ -15,7 +15,7 @@ from __future__ import annotations
 import heapq
 import itertools
 import time
-from typing import Any, Callable
+from typing import Callable
 
 from goworld_tpu.utils import gwutils
 
@@ -78,15 +78,3 @@ class TimerService:
 
     def __len__(self) -> int:
         return sum(1 for item in self._heap if not item[2].cancelled)
-
-
-def pack_timers(
-    timers: dict[int, tuple[float, float, str, tuple]], now: float
-) -> list[tuple[float, float, str, Any]]:
-    """Serialize entity timers as (remaining, repeat_interval, method, args)
-    records for migration/freeze (reference packs timers into migrate data,
-    Entity.go:631-651). Provided here so entity code stays codec-free."""
-    return [
-        (max(0.0, deadline - now), repeat, method, args)
-        for deadline, repeat, method, args in timers.values()
-    ]

@@ -1,0 +1,462 @@
+"""Entity runtime tests.
+
+Mirrors the reference test strategy (SURVEY.md §4.1): attr tree behavior
+(attr_test.go:12-105), in-process migration data round-trip
+(migarte_test.go:18-49), plus lifecycle, RPC permission flags, timers,
+client ownership, and AOI interest with both backends.
+"""
+
+import pytest
+
+from goworld_tpu.entity import attrs as attrs_mod
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.attrs import ListAttr, MapAttr
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+
+
+class MySpace(Space):
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.define_attr("_EnableAOI", "Persistent")
+
+
+class Avatar(Entity):
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True)
+        desc.define_attr("name", "Client", "Persistent")
+        desc.define_attr("hp", "AllClients", "Persistent")
+        desc.define_attr("secret", "Persistent")
+        desc.define_attr("bag", "Client", "Persistent")
+
+    def __init__(self):
+        super().__init__()
+        self.enter_events = []
+        self.leave_events = []
+        self.rpc_log = []
+
+    def on_enter_aoi(self, other):
+        self.enter_events.append(other)
+        super().on_enter_aoi(other)
+
+    def on_leave_aoi(self, other):
+        self.leave_events.append(other)
+        super().on_leave_aoi(other)
+
+    def Hello(self, a, b):
+        self.rpc_log.append(("Hello", a, b))
+
+    def Login_Client(self, token):
+        self.rpc_log.append(("Login_Client", token))
+
+    def Shout_AllClients(self, msg):
+        self.rpc_log.append(("Shout_AllClients", msg))
+
+    def TimerFired(self, tag):
+        self.rpc_log.append(("TimerFired", tag))
+
+
+class Monster(Entity):
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True)
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    em.cleanup_for_tests()
+    em.register_space(MySpace)
+    em.register_entity(Avatar)
+    em.register_entity(Monster)
+    yield
+    em.cleanup_for_tests()
+
+
+# --- attrs ------------------------------------------------------------------
+
+
+def test_attr_uniformization_and_nesting():
+    m = MapAttr()
+    m.set("a", 1)
+    m.set("b", {"x": [1, 2, {"deep": True}]})
+    assert m.get_int("a") == 1
+    inner = m["b"]
+    assert isinstance(inner, MapAttr)
+    lst = inner["x"]
+    assert isinstance(lst, ListAttr)
+    assert isinstance(lst[2], MapAttr)
+    assert m.to_dict() == {"a": 1, "b": {"x": [1, 2, {"deep": True}]}}
+
+
+def test_attr_path_computation():
+    m = MapAttr()
+    m.set("b", {"x": [{"k": 1}]})
+    node = m["b"]["x"][0]
+    assert node.path() == ["b", "x", 0]
+    assert node.top_key() == "b"
+
+
+def test_attr_subtree_reattach_rejected():
+    m = MapAttr()
+    m.set("a", {"x": 1})
+    sub = m["a"]
+    m2 = MapAttr()
+    with pytest.raises(ValueError):
+        m2.set("stolen", sub)
+
+
+def test_attr_change_stream():
+    changes = []
+    m = MapAttr()
+    m._owner_cb = lambda kind, path, *args: changes.append((kind, path, args))
+    m.set("hp", 100)
+    m.set("bag", {"gold": 5})
+    m["bag"].set("gold", 6)
+    m["bag"].delete("gold")
+    lst = m.get_list("items")
+    changes.clear()
+    lst.append("sword")
+    lst.set(0, "axe")
+    lst.pop()
+    kinds = [c[0] for c in changes]
+    assert kinds == [attrs_mod.LIST_APPEND, attrs_mod.LIST_CHANGE, attrs_mod.LIST_POP]
+    assert changes[0][1] == ["items"]
+
+
+# --- creation / lifecycle ---------------------------------------------------
+
+
+def test_create_entity_lifecycle():
+    a = em.create_entity_locally("Avatar", attrs={"name": "bob", "hp": 10})
+    assert em.get_entity(a.id) is a
+    assert a.attrs.get_str("name") == "bob"
+    assert a.is_persistent()
+    a.destroy()
+    assert a.is_destroyed()
+    assert em.get_entity(a.id) is None
+
+
+def test_client_attr_filtering():
+    a = em.create_entity_locally(
+        "Avatar", attrs={"name": "bob", "hp": 10, "secret": "s3", "bag": {}}
+    )
+    assert a.client_attrs() == {"name": "bob", "hp": 10, "bag": {}}
+    assert a.all_client_attrs() == {"hp": 10}
+    assert a.persistent_attrs() == {"name": "bob", "hp": 10, "secret": "s3", "bag": {}}
+
+
+def test_nil_space_deterministic():
+    ns = em.create_nil_space(1)
+    assert ns.is_nil()
+    assert ns.id == em.get_nil_space_id(1)
+    assert em.get_nil_space() is ns
+
+
+# --- RPC --------------------------------------------------------------------
+
+
+def test_rpc_server_call():
+    a = em.create_entity_locally("Avatar")
+    em.call_entity(a.id, "Hello", 1, "x")
+    assert a.rpc_log == [("Hello", 1, "x")]
+
+
+def test_rpc_client_permission_flags():
+    a = em.create_entity_locally("Avatar")
+    a.client = GameClient("C" * 16, 1, a.id)
+    # own client may call _Client methods
+    a.on_call_from_remote("Login_Client", ("tok",), "C" * 16)
+    # other client may not
+    a.on_call_from_remote("Login_Client", ("hax",), "X" * 16)
+    # any client may call _AllClients
+    a.on_call_from_remote("Shout_AllClients", ("hi",), "X" * 16)
+    # no client may call plain server methods
+    a.on_call_from_remote("Hello", (1, 2), "C" * 16)
+    assert a.rpc_log == [("Login_Client", "tok"), ("Shout_AllClients", "hi")]
+
+
+def test_rpc_base_methods_not_exposed():
+    a = em.create_entity_locally("Avatar")
+    # Entity base methods (e.g. destroy) are not in the RPC surface.
+    a.on_call_from_remote("destroy", (), None)
+    assert not a.is_destroyed()
+
+
+# --- timers ------------------------------------------------------------------
+
+
+def test_entity_timers_fire_and_cancel():
+    now = [0.0]
+    em.runtime.now = lambda: now[0]
+    em.runtime.timer_service._now = lambda: now[0]
+    a = em.create_entity_locally("Avatar")
+    a.add_callback(1.0, "TimerFired", "once")
+    tid = a.add_timer(0.5, "TimerFired", "rep")
+    now[0] = 0.6
+    em.runtime.tick()
+    assert ("TimerFired", "rep") in a.rpc_log
+    a.cancel_timer(tid)
+    a.rpc_log.clear()
+    now[0] = 1.2
+    em.runtime.tick()
+    assert a.rpc_log == [("TimerFired", "once")]
+
+
+def test_timers_cancelled_on_destroy():
+    now = [0.0]
+    em.runtime.now = lambda: now[0]
+    em.runtime.timer_service._now = lambda: now[0]
+    a = em.create_entity_locally("Avatar")
+    a.add_timer(0.5, "TimerFired", "rep")
+    a.destroy()
+    now[0] = 5.0
+    em.runtime.tick()
+    assert ("TimerFired", "rep") not in a.rpc_log
+
+
+# --- spaces + AOI (xzlist backend) ------------------------------------------
+
+
+def _setup_space(dist=100.0):
+    sp = em.create_space_locally(kind=1)
+    sp.enable_aoi(dist)
+    return sp
+
+
+def test_space_enter_leave_aoi_sync():
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(50, 0, 0))
+    assert a.is_interested_in(b) and b.is_interested_in(a)
+    assert a.enter_events == [b] and b.enter_events == [a]
+    # move b out of range
+    b.set_position(Vector3(500, 0, 0))
+    assert not a.is_interested_in(b)
+    assert a.leave_events == [b] and b.leave_events == [a]
+    # move back in range
+    b.set_position(Vector3(80, 0, 0))
+    assert a.is_interested_in(b)
+
+
+def test_entity_destroy_fires_aoi_leave():
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(10, 0, 0))
+    b.destroy()
+    assert a.leave_events == [b]
+    assert not a.is_interested_in(b)
+
+
+def test_enable_aoi_with_entities_rejected():
+    sp = em.create_space_locally(kind=1)
+    a = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    with pytest.raises(RuntimeError):
+        sp.enable_aoi(100)
+
+
+def test_space_destroy_evicts_entities():
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp.destroy()
+    assert a.space is None
+    assert not a.is_destroyed()
+
+
+# --- spaces + AOI (batched engine backend) ----------------------------------
+
+
+def _setup_batched():
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    em.runtime.aoi_backend = "batched"
+    em.runtime.aoi_params = NeighborParams(
+        capacity=64, max_neighbors=16, cell_size=100.0, grid_x=8, grid_z=8,
+        space_slots=4, cell_capacity=16, max_events=512,
+    )
+
+
+def test_batched_aoi_equivalent_behavior():
+    _setup_batched()
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(50, 0, 0))
+    # batched: nothing until tick
+    assert a.enter_events == []
+    em.runtime.tick()
+    assert a.is_interested_in(b) and b.is_interested_in(a)
+    b.set_position(Vector3(500, 0, 0))
+    em.runtime.tick()
+    assert not a.is_interested_in(b)
+    assert a.leave_events == [b]
+
+
+def test_batched_aoi_two_spaces_isolated():
+    _setup_batched()
+    sp1 = _setup_space()
+    sp2 = em.create_space_locally(kind=2)
+    sp2.enable_aoi(100.0)
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp1._enter(a, Vector3(0, 0, 0))
+    sp2._enter(b, Vector3(0, 0, 0))
+    em.runtime.tick()
+    assert not a.is_interested_in(b)
+    assert not b.is_interested_in(a)
+
+
+def test_batched_aoi_destroy_delivers_leaves():
+    _setup_batched()
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(10, 0, 0))
+    em.runtime.tick()
+    assert a.is_interested_in(b)
+    b.destroy()
+    em.runtime.tick()
+    assert a.leave_events == [b]
+    assert not a.is_interested_in(b)
+
+
+# --- migration data round-trip (migarte_test.go:18-49) ----------------------
+
+
+def test_migrate_data_roundtrip():
+    now = [0.0]
+    em.runtime.now = lambda: now[0]
+    em.runtime.timer_service._now = lambda: now[0]
+    sp = _setup_space()
+    a = em.create_entity_locally(
+        "Avatar", attrs={"name": "bob", "hp": 7, "secret": "x", "bag": {"gold": 3}}
+    )
+    sp._enter(a, Vector3(1, 2, 3))
+    a.yaw = 45.0
+    a.add_timer(10.0, "TimerFired", "migrated")
+    a.set_client_syncing(True)
+    a.client = GameClient("C" * 16, 2, a.id)
+
+    data = a.get_migrate_data()
+    # simulate wire: msgpack round-trip
+    from goworld_tpu.netutil import pack_msg, unpack_msg
+
+    data = unpack_msg(pack_msg(data))
+
+    a._destroy(is_migrate=True)
+    assert em.get_entity(a.id) is None
+
+    a2 = em.restore_entity(a.id, data, is_migrate=True)
+    assert a2.attrs.to_dict()["name"] == "bob"
+    assert a2.attrs.to_dict()["bag"] == {"gold": 3}
+    assert a2.position.as_tuple() == (1.0, 2.0, 3.0)
+    assert a2.yaw == 45.0
+    assert a2.client.clientid == "C" * 16
+    assert a2.client.gateid == 2
+    assert a2._syncing_from_client is True
+    assert a2.space is sp
+    # timer survived
+    now[0] = 10.5
+    em.runtime.tick()
+    assert ("TimerFired", "migrated") in a2.rpc_log
+
+
+def test_migrate_no_on_destroy_hook():
+    called = []
+    a = em.create_entity_locally("Avatar")
+    a.on_destroy = lambda: called.append(1)  # type: ignore[method-assign]
+    a._destroy(is_migrate=True)
+    assert called == []
+
+
+def test_migrate_out_releases_client_ownership():
+    a = em.create_entity_locally("Avatar")
+    a.set_client(GameClient("C" * 16, 1, a.id))
+    assert em.get_client_owner("C" * 16) is a
+    a.get_migrate_data()
+    a._destroy(is_migrate=True)
+    assert em.get_client_owner("C" * 16) is None
+
+
+def test_restored_repeating_timer_keeps_remaining_time():
+    now = [0.0]
+    em.runtime.now = lambda: now[0]
+    em.runtime.timer_service._now = lambda: now[0]
+    a = em.create_entity_locally("Avatar")
+    a.add_timer(300.0, "TimerFired", "slow")
+    now[0] = 299.0  # 1s before the next fire
+    data = a.get_migrate_data()
+    assert data["timers"][0][0] == pytest.approx(1.0)  # remaining
+    a._destroy(is_migrate=True)
+    a2 = em.restore_entity(a.id, data, is_migrate=True)
+    now[0] = 300.5  # only 1.5s later — must fire (not 300s later)
+    em.runtime.tick()
+    assert ("TimerFired", "slow") in a2.rpc_log
+    # and it keeps repeating at the full interval afterwards
+    a2.rpc_log.clear()
+    now[0] = 600.5
+    em.runtime.tick()
+    assert ("TimerFired", "slow") in a2.rpc_log
+
+
+# --- freeze / restore (EntityManager.go:554-656) ----------------------------
+
+
+def test_freeze_restore_roundtrip():
+    ns = em.create_nil_space(1)
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar", attrs={"name": "z", "hp": 1})
+    sp._enter(a, Vector3(5, 0, 5))
+    frozen = em.freeze_entities(1)
+
+    from goworld_tpu.netutil import pack_msg, unpack_msg
+
+    frozen = unpack_msg(pack_msg(frozen))
+
+    ids = (ns.id, sp.id, a.id)
+    em.cleanup_for_tests()
+    em.register_space(MySpace)
+    em.register_entity(Avatar)
+    em.register_entity(Monster)
+
+    em.restore_freezed_entities(frozen)
+    ns2, sp2, a2 = em.get_entity(ids[0]), em.get_space(ids[1]), em.get_entity(ids[2])
+    assert ns2 is not None and sp2 is not None and a2 is not None
+    assert a2.space is sp2
+    assert a2.attrs.get_str("name") == "z"
+    assert sp2.aoi_mgr is not None  # _EnableAOI attr restored the manager
+
+
+def test_freeze_requires_nil_space():
+    with pytest.raises(RuntimeError):
+        em.freeze_entities(1)
+
+
+# --- sync info collection ----------------------------------------------------
+
+
+def test_collect_entity_sync_infos():
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(10, 0, 0))
+    b.client = GameClient("B" * 16, 3, b.id)
+    a.set_position(Vector3(1.0, 0.0, 1.0))
+    infos = em.collect_entity_sync_infos()
+    assert 3 in infos
+    buf = bytes(infos[3])
+    assert len(buf) == 16 + 32  # clientid + record
+    assert buf[:16] == b"B" * 16
+    # second collection is empty (flags cleared)
+    assert em.collect_entity_sync_infos() == {}

@@ -1,0 +1,132 @@
+"""Codec + framing round-trip tests (reference: engine/netutil tests,
+MsgPacker_test.go, netutil_test.go)."""
+
+import asyncio
+
+import pytest
+
+from goworld_tpu.common import gen_entity_id
+from goworld_tpu.netutil import (
+    ConnectionClosed,
+    Packet,
+    PacketConnection,
+    connect_tcp,
+    pack_msg,
+    serve_tcp_forever,
+    unpack_msg,
+)
+from goworld_tpu.proto import GoWorldConnection, MsgType
+from goworld_tpu.proto.conn import pack_sync_record, unpack_sync_records
+
+
+def test_packet_scalar_roundtrip():
+    p = Packet()
+    p.append_byte(7).append_bool(True).append_uint16(65535)
+    p.append_uint32(4_000_000_000).append_uint64(2**60)
+    p.append_float32(1.5).append_float64(3.141592653589793)
+    assert p.read_byte() == 7
+    assert p.read_bool() is True
+    assert p.read_uint16() == 65535
+    assert p.read_uint32() == 4_000_000_000
+    assert p.read_uint64() == 2**60
+    assert p.read_float32() == 1.5
+    assert p.read_float64() == 3.141592653589793
+    assert p.unread_len() == 0
+
+
+def test_packet_str_id_data_args():
+    eid = gen_entity_id()
+    p = Packet()
+    p.append_varstr("héllo wörld")
+    p.append_entity_id(eid)
+    p.append_data({"a": 1, "b": [1, 2, 3], "c": {"x": None}})
+    p.append_args(("Login", 42, {"k": "v"}))
+    assert p.read_varstr() == "héllo wörld"
+    assert p.read_entity_id() == eid
+    assert p.read_data() == {"a": 1, "b": [1, 2, 3], "c": {"x": None}}
+    assert p.read_args() == ["Login", 42, {"k": "v"}]
+
+
+def test_packet_read_overflow():
+    p = Packet()
+    p.append_uint16(1)
+    p.read_uint16()
+    with pytest.raises(IndexError):
+        p.read_uint32()
+
+
+def test_msgpacker_roundtrip():
+    obj = {"name": "avatar", "lv": 3, "items": [1, "sword", {"dmg": 9.5}]}
+    assert unpack_msg(pack_msg(obj)) == obj
+
+
+def test_sync_record_roundtrip():
+    eid = gen_entity_id()
+    rec = pack_sync_record(eid, 1.0, 2.0, 3.0, 90.0)
+    assert len(rec) == 32
+    out = unpack_sync_records(rec + rec)
+    assert len(out) == 2
+    assert out[0] == (eid, 1.0, 2.0, 3.0, 90.0)
+
+
+async def _echo_server_client():
+    received = []
+    done = asyncio.Event()
+
+    async def handler(reader, writer):
+        conn = PacketConnection(reader, writer, flush_interval=0)
+        while True:
+            try:
+                msgtype, pkt = await conn.recv_packet()
+            except ConnectionClosed:
+                break
+            received.append((msgtype, pkt))
+            if msgtype == MsgType.NOTIFY_DESTROY_ENTITY:
+                done.set()
+
+    server = await serve_tcp_forever("127.0.0.1", 0, handler)
+    port = server.sockets[0].getsockname()[1]
+
+    reader, writer = await connect_tcp("127.0.0.1", port)
+    conn = GoWorldConnection(PacketConnection(reader, writer, flush_interval=0))
+    eid = gen_entity_id()
+    conn.send_call_entity_method(eid, "Hello", ("world", 1))
+    conn.send_notify_destroy_entity(eid)
+    await conn.conn.drain()
+    await asyncio.wait_for(done.wait(), timeout=5)
+    conn.close()
+    server.close()
+    await server.wait_closed()
+    return eid, received
+
+
+def test_framed_transport_end_to_end():
+    eid, received = asyncio.run(_echo_server_client())
+    assert len(received) == 2
+    msgtype, pkt = received[0]
+    assert msgtype == MsgType.CALL_ENTITY_METHOD
+    assert pkt.read_entity_id() == eid
+    assert pkt.read_varstr() == "Hello"
+    assert pkt.read_args() == ["world", 1]
+    assert received[1][0] == MsgType.NOTIFY_DESTROY_ENTITY
+
+
+async def _oversized():
+    async def handler(reader, writer):
+        await asyncio.sleep(10)
+
+    server = await serve_tcp_forever("127.0.0.1", 0, handler)
+    port = server.sockets[0].getsockname()[1]
+    reader, writer = await connect_tcp("127.0.0.1", port)
+    conn = PacketConnection(reader, writer, flush_interval=0)
+    try:
+        with pytest.raises(ValueError):
+            conn.send_packet(1, Packet(b"x" * (26 * 1024 * 1024)))
+    finally:
+        conn.close()
+        server.close()
+        await server.wait_closed()
+
+
+def test_oversized_packet_rejected():
+    asyncio.run(_oversized())
