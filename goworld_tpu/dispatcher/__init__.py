@@ -1,0 +1,10 @@
+"""Dispatcher process: the star-topology packet router.
+
+Reference parity: ``components/dispatcher`` (SURVEY.md §2.2) — every game and
+gate connects to every dispatcher; all cross-process traffic transits a
+dispatcher chosen by EntityID hash, which gives per-entity FIFO ordering.
+"""
+
+from goworld_tpu.dispatcher.service import DispatcherService
+
+__all__ = ["DispatcherService"]

@@ -1,0 +1,568 @@
+"""The dispatcher message loop: entity-table routing with blocking queues.
+
+Reference parity: ``components/dispatcher/DispatcherService.go`` —
+
+- ``entityDispatchInfos: {EntityID → (gameid, blockUntil, pendingQueue)}``
+  (:28-32,184): written on NOTIFY_CREATE_ENTITY / REAL_MIGRATE / SET_GAME_ID,
+  erased on NOTIFY_DESTROY_ENTITY and game-down cleanup (:643-661,627-640).
+- Blocking semantics (:34-80): per-entity blockUntil + bounded pending queue
+  during load/migrate; per-game bounded queue while a game is frozen (:82-169).
+- Load-balanced choose-game = CPU-min-heap for anywhere-creates (:529-542);
+  round-robin over non-banned games for boot entities (:545-555).
+- Client→server position syncs are aggregated per target game and flushed per
+  5 ms tick (:786-824).
+- Deployment-ready barrier when desired counts connect (:446-476).
+- kvreg replication (:734-748); freeze handshake (:478-494); reconnect
+  reconciliation rejecting entities whose home moved (:376-398).
+
+Concurrency model mirrors the reference: per-connection recv tasks feed one
+logic queue drained by a single task — no locks in routing logic.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import time
+from typing import Deque, Optional
+
+from goworld_tpu import consts
+from goworld_tpu.dispatcher.lbc import LBCHeap
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
+from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
+from goworld_tpu.proto.msgtypes import MsgType, is_gate_redirect
+from goworld_tpu.utils import gwlog
+
+
+class _EntityDispatchInfo:
+    """Routing record for one entity (DispatcherService.go:28-80)."""
+
+    __slots__ = ("gameid", "block_until", "pending")
+
+    def __init__(self, gameid: int = 0) -> None:
+        self.gameid = gameid
+        self.block_until = 0.0
+        self.pending: Deque[tuple[int, Packet]] = collections.deque()
+
+    def blocked(self, now: float) -> bool:
+        return self.block_until > now
+
+    def block(self, now: float, duration: float) -> None:
+        self.block_until = now + duration
+
+    def unblock(self) -> None:
+        self.block_until = 0.0
+
+    def push_pending(self, msgtype: int, packet: Packet) -> bool:
+        if len(self.pending) >= consts.ENTITY_PENDING_PACKET_QUEUE_MAX_LEN:
+            return False
+        self.pending.append((msgtype, packet))
+        return True
+
+
+class _GameInfo:
+    """Per-game connection state (DispatcherService.go:82-169,180-182)."""
+
+    def __init__(self, gameid: int) -> None:
+        self.gameid = gameid
+        self.proxy: Optional[GoWorldConnection] = None
+        self.is_banned_boot = False
+        self.block_until = 0.0  # frozen / reconnect window
+        self.pending: Deque[tuple[int, Packet]] = collections.deque()
+
+    @property
+    def connected(self) -> bool:
+        return self.proxy is not None and not self.proxy.closed
+
+    def blocked(self, now: float) -> bool:
+        return self.block_until > now
+
+    def dispatch(self, msgtype: int, packet: Packet, now: float) -> None:
+        if self.connected and not self.blocked(now):
+            self.proxy.send(msgtype, packet)
+        elif self.blocked(now):
+            if len(self.pending) < consts.GAME_PENDING_PACKET_QUEUE_MAX_LEN:
+                self.pending.append((msgtype, packet))
+        # else: game is gone and not frozen — drop (reference handleGameDown)
+
+    def unblock_and_flush(self) -> None:
+        self.block_until = 0.0
+        if self.proxy is None:
+            return
+        while self.pending:
+            msgtype, packet = self.pending.popleft()
+            self.proxy.send(msgtype, packet)
+
+
+class DispatcherService:
+    """One dispatcher process. Run with :meth:`start`, stop with :meth:`stop`."""
+
+    def __init__(self, dispid: int, desired_games: int = 1, desired_gates: int = 1) -> None:
+        self.dispid = dispid
+        self.desired_games = desired_games
+        self.desired_gates = desired_gates
+        self.entities: dict[str, _EntityDispatchInfo] = {}
+        self.games: dict[int, _GameInfo] = {}
+        self.gates: dict[int, GoWorldConnection] = {}
+        self.kvreg: dict[str, str] = {}
+        self.deployment_ready = False
+        self._boot_rr = 0
+        self._lbc = LBCHeap()
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=consts.DISPATCHER_MESSAGE_QUEUE_LEN)
+        self._tasks: list[asyncio.Task] = []
+        # position-sync aggregation: gameid → bytearray of 32 B records
+        self._pending_syncs: dict[int, bytearray] = {}
+        # sender identity, populated at handshake (reference stores the id on
+        # the connection proxy itself)
+        self._proxy_games: dict[GoWorldConnection, int] = {}
+        self._proxy_gates: dict[GoWorldConnection, int] = {}
+        self.port: int = 0
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+        self._server = await asyncio.start_server(self._on_conn, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self._tasks.append(asyncio.get_running_loop().create_task(self._logic_loop()))
+        self._tasks.append(asyncio.get_running_loop().create_task(self._tick_loop()))
+        gwlog.infof("dispatcher %d listening on %s:%d", self.dispid, host, self.port)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for gi in self.games.values():
+            if gi.proxy is not None:
+                gi.proxy.close()
+        for gp in self.gates.values():
+            gp.close()
+
+    # --- connection handling -------------------------------------------------
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        proxy = GoWorldConnection(PacketConnection(reader, writer))
+        try:
+            while True:
+                msgtype, packet = await proxy.recv()
+                await self._queue.put((proxy, msgtype, packet))
+        except ConnectionClosed:
+            await self._queue.put((proxy, -1, None))  # disconnect sentinel
+        finally:
+            proxy.close()
+
+    async def _logic_loop(self) -> None:
+        while True:
+            proxy, msgtype, packet = await self._queue.get()
+            try:
+                if msgtype == -1:
+                    self._handle_disconnect(proxy)
+                else:
+                    self._handle(proxy, msgtype, packet)
+            except Exception:
+                gwlog.trace_error("dispatcher %d: error handling msgtype %s", self.dispid, msgtype)
+
+    async def _tick_loop(self) -> None:
+        while True:
+            await asyncio.sleep(consts.DISPATCHER_SERVICE_TICK_INTERVAL)
+            self._send_pending_syncs()
+            self._sweep_dead_frozen_games()
+
+    def _sweep_dead_frozen_games(self) -> None:
+        """A game that disconnected while frozen and never came back: once its
+        freeze window lapses, clean it up like any dead game (the reference
+        only buffers for the freeze timeout, DispatcherService.go:82-169)."""
+        now = self._now()
+        for gameid, gi in list(self.games.items()):
+            if gi.proxy is None and gi.block_until and not gi.blocked(now):
+                gi.block_until = 0.0
+                gi.pending.clear()
+                self._handle_game_down(gameid)
+
+    # --- dispatch helpers ----------------------------------------------------
+
+    def _now(self) -> float:
+        return time.monotonic()
+
+    def _game(self, gameid: int) -> _GameInfo:
+        gi = self.games.get(gameid)
+        if gi is None:
+            gi = self.games[gameid] = _GameInfo(gameid)
+        return gi
+
+    def _entity(self, eid: str) -> _EntityDispatchInfo:
+        info = self.entities.get(eid)
+        if info is None:
+            info = self.entities[eid] = _EntityDispatchInfo()
+        return info
+
+    def _gameid_of(self, proxy: GoWorldConnection) -> int:
+        return self._proxy_games.get(proxy, 0)
+
+    def _gateid_of(self, proxy: GoWorldConnection) -> int:
+        return self._proxy_gates.get(proxy, 0)
+
+    def _dispatch_to_entity(self, eid: str, msgtype: int, packet: Packet) -> None:
+        """Route a packet by the entity table, honoring blocks
+        (DispatcherService.go:34-80,826-844)."""
+        now = self._now()
+        info = self.entities.get(eid)
+        if info is None or info.gameid == 0:
+            gwlog.warnf("dispatcher %d: drop %s for unknown entity %s", self.dispid, msgtype, eid)
+            return
+        if info.blocked(now):
+            if not info.push_pending(msgtype, packet):
+                gwlog.warnf("dispatcher %d: pending queue overflow for %s", self.dispid, eid)
+            return
+        self._game(info.gameid).dispatch(msgtype, packet, now)
+
+    def _flush_entity_pending(self, info: _EntityDispatchInfo) -> None:
+        now = self._now()
+        info.unblock()
+        while info.pending:
+            msgtype, packet = info.pending.popleft()
+            self._game(info.gameid).dispatch(msgtype, packet, now)
+
+    def _broadcast_games(self, msgtype: int, packet: Packet, except_game: int = 0) -> None:
+        now = self._now()
+        for gid, gi in self.games.items():
+            if gid != except_game:
+                gi.dispatch(msgtype, packet, now)
+
+    def _broadcast_gates(self, msgtype: int, packet: Packet) -> None:
+        for gp in self.gates.values():
+            gp.send(msgtype, packet)
+
+    # --- message handling ----------------------------------------------------
+
+    def _handle(self, proxy: GoWorldConnection, msgtype: int, packet: Packet) -> None:
+        if is_gate_redirect(msgtype):
+            # Payload starts [u16 gateid][clientid...]; route on gateid
+            # (DispatcherService.go:841-844).
+            gateid = packet.read_uint16()
+            packet.set_read_pos(0)
+            gp = self.gates.get(gateid)
+            if gp is not None:
+                gp.send(msgtype, packet)
+            return
+        if msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
+            gateid = packet.read_uint16()
+            packet.set_read_pos(0)
+            gp = self.gates.get(gateid)
+            if gp is not None:
+                gp.send(msgtype, packet)
+            return
+        if msgtype == MsgType.CALL_FILTERED_CLIENTS:
+            self._broadcast_gates(msgtype, packet)
+            return
+
+        handler = self._HANDLERS.get(msgtype)
+        if handler is None:
+            gwlog.warnf("dispatcher %d: unhandled msgtype %s", self.dispid, msgtype)
+            return
+        handler(self, proxy, packet)
+
+    # --- handshakes ----------------------------------------------------------
+
+    def _handle_set_game_id(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        gameid = packet.read_uint16()
+        is_reconnect = packet.read_bool()
+        is_restore = packet.read_bool()
+        is_ban_boot = packet.read_bool()
+        entity_ids = packet.read_data()
+        gi = self._game(gameid)
+        gi.proxy = proxy
+        gi.is_banned_boot = is_ban_boot
+        self._proxy_games[proxy] = gameid
+        self._lbc.update(gameid, 0.0)
+
+        # Reconnect reconciliation: reject entities homed elsewhere
+        # (DispatcherService.go:376-398).
+        rejected: list[str] = []
+        for eid in entity_ids:
+            info = self.entities.get(eid)
+            if info is not None and info.gameid not in (0, gameid):
+                rejected.append(eid)
+            else:
+                self._entity(eid).gameid = gameid
+        proxy.send_set_game_id_ack(
+            online_games=sorted(
+                gid for gid, g in self.games.items() if g.connected
+            ),
+            rejected_entity_ids=rejected,
+            kvreg_map=dict(self.kvreg),
+            deployment_ready=self.deployment_ready,
+        )
+        notify = Packet()
+        notify.append_uint16(gameid)
+        self._broadcast_games(MsgType.NOTIFY_GAME_CONNECTED, notify, except_game=gameid)
+        gi.unblock_and_flush()
+        self._check_deployment_ready()
+        gwlog.infof(
+            "dispatcher %d: game %d connected (reconnect=%s restore=%s, %d entities, %d rejected)",
+            self.dispid, gameid, is_reconnect, is_restore, len(entity_ids), len(rejected),
+        )
+
+    def _handle_set_gate_id(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        gateid = packet.read_uint16()
+        self.gates[gateid] = proxy
+        self._proxy_gates[proxy] = gateid
+        self._check_deployment_ready()
+        gwlog.infof("dispatcher %d: gate %d connected", self.dispid, gateid)
+
+    def _check_deployment_ready(self) -> None:
+        """Readiness barrier (DispatcherService.go:446-476)."""
+        if self.deployment_ready:
+            return
+        n_games = sum(1 for g in self.games.values() if g.connected)
+        if n_games >= self.desired_games and len(self.gates) >= self.desired_gates:
+            self.deployment_ready = True
+            p = Packet()
+            self._broadcast_games(MsgType.NOTIFY_DEPLOYMENT_READY, p)
+            gwlog.infof("dispatcher %d: deployment ready (%d games, %d gates)",
+                        self.dispid, n_games, len(self.gates))
+
+    # --- entity table ---------------------------------------------------------
+
+    def _handle_notify_create_entity(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        gameid = self._gameid_of(proxy)
+        info = self._entity(eid)
+        info.gameid = gameid
+        self._flush_entity_pending(info)
+
+    def _handle_notify_destroy_entity(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        self.entities.pop(eid, None)
+
+    # --- client lifecycle -----------------------------------------------------
+
+    def _handle_notify_client_connected(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Gate announced a fresh client; choose a boot game round-robin over
+        non-banned games (DispatcherService.go:545-555,663-667)."""
+        gameid = self._choose_game_for_boot()
+        if gameid == 0:
+            gwlog.warnf("dispatcher %d: no game available for boot entity", self.dispid)
+            return
+        boot_eid = Packet(packet.payload)  # peek boot eid: clientid(16)+u16+eid(16)
+        boot_eid.read_client_id()
+        boot_eid.read_uint16()
+        eid = boot_eid.read_entity_id()
+        info = self._entity(eid)
+        info.gameid = gameid
+        self._game(gameid).dispatch(MsgType.NOTIFY_CLIENT_CONNECTED, packet, self._now())
+
+    def _handle_notify_client_disconnected(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        packet.read_client_id()
+        owner_eid = packet.read_entity_id()
+        packet.set_read_pos(0)
+        self._dispatch_to_entity(owner_eid, MsgType.NOTIFY_CLIENT_DISCONNECTED, packet)
+
+    def _choose_game_for_boot(self) -> int:
+        candidates = sorted(
+            gid for gid, g in self.games.items() if g.connected and not g.is_banned_boot
+        )
+        if not candidates:
+            return 0
+        self._boot_rr = (self._boot_rr + 1) % len(candidates)
+        return candidates[self._boot_rr]
+
+    # --- RPC routing ----------------------------------------------------------
+
+    def _handle_call_entity_method(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        packet.set_read_pos(0)
+        self._dispatch_to_entity(eid, MsgType.CALL_ENTITY_METHOD, packet)
+
+    def _handle_call_entity_method_from_client(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        packet.set_read_pos(0)
+        self._dispatch_to_entity(eid, MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, packet)
+
+    def _handle_call_nil_spaces(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        except_game = packet.read_uint16()
+        packet.set_read_pos(0)
+        self._broadcast_games(MsgType.CALL_NIL_SPACES, packet, except_game=except_game)
+
+    # --- create / load somewhere ----------------------------------------------
+
+    def _handle_create_entity_somewhere(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        gameid = packet.read_uint16()
+        packet.read_varstr()
+        eid = packet.read_entity_id()
+        packet.set_read_pos(0)
+        if gameid == 0:
+            gameid = self._lbc.choose() or self._choose_game_for_boot()
+        if gameid == 0:
+            gwlog.warnf("dispatcher %d: no game for CREATE_ENTITY_SOMEWHERE", self.dispid)
+            return
+        self._entity(eid).gameid = gameid
+        self._game(gameid).dispatch(MsgType.CREATE_ENTITY_SOMEWHERE, packet, self._now())
+
+    def _handle_load_entity_somewhere(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        gameid = packet.read_uint16()
+        packet.read_varstr()
+        eid = packet.read_entity_id()
+        packet.set_read_pos(0)
+        info = self.entities.get(eid)
+        if info is not None and info.gameid != 0:
+            return  # already loaded somewhere; calls will route there
+        if gameid == 0:
+            gameid = self._lbc.choose() or self._choose_game_for_boot()
+        if gameid == 0:
+            return
+        info = self._entity(eid)
+        info.gameid = gameid
+        # Block RPCs while the entity loads (consts.go load timeout).
+        info.block(self._now(), consts.DISPATCHER_LOAD_TIMEOUT)
+        self._game(gameid).dispatch(MsgType.LOAD_ENTITY_SOMEWHERE, packet, self._now())
+
+    # --- migration (DispatcherService.go:850-907) -----------------------------
+
+    def _handle_query_space_gameid_for_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        spaceid = packet.read_entity_id()
+        eid = packet.read_entity_id()
+        space_info = self.entities.get(spaceid)
+        gameid = space_info.gameid if space_info is not None else 0
+        # Ack goes back to the entity's current game (the requester).
+        proxy.send_query_space_gameid_for_migrate_ack(spaceid, eid, gameid)
+
+    def _handle_migrate_request(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        spaceid = packet.read_entity_id()
+        space_gameid = packet.read_uint16()
+        info = self._entity(eid)
+        info.block(self._now(), consts.DISPATCHER_MIGRATE_TIMEOUT)
+        proxy.send_migrate_request_ack(eid, spaceid, space_gameid)
+
+    def _handle_real_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        target_game = packet.read_uint16()
+        packet.set_read_pos(0)
+        info = self._entity(eid)
+        info.gameid = target_game
+        self._game(target_game).dispatch(MsgType.REAL_MIGRATE, packet, self._now())
+        self._flush_entity_pending(info)
+
+    def _handle_cancel_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        eid = packet.read_entity_id()
+        info = self.entities.get(eid)
+        if info is not None:
+            self._flush_entity_pending(info)
+
+    # --- position sync aggregation (DispatcherService.go:786-824) -------------
+
+    def _handle_sync_position_yaw_from_client(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        data = packet.payload
+        for off in range(0, len(data), SYNC_RECORD_SIZE):
+            record = data[off : off + SYNC_RECORD_SIZE]
+            eid = record[:16].decode("ascii")
+            info = self.entities.get(eid)
+            if info is None or info.gameid == 0:
+                continue
+            self._pending_syncs.setdefault(info.gameid, bytearray()).extend(record)
+
+    def _send_pending_syncs(self) -> None:
+        if not self._pending_syncs:
+            return
+        now = self._now()
+        for gameid, buf in self._pending_syncs.items():
+            self._game(gameid).dispatch(
+                MsgType.SYNC_POSITION_YAW_FROM_CLIENT, Packet(bytes(buf)), now
+            )
+        self._pending_syncs.clear()
+
+    # --- kvreg (DispatcherService.go:734-748) ---------------------------------
+
+    def _handle_kvreg_register(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        key = packet.read_varstr()
+        value = packet.read_varstr()
+        force = packet.read_bool()
+        packet.set_read_pos(0)
+        if not force and key in self.kvreg:
+            return  # first registration wins unless forced
+        self.kvreg[key] = value
+        self._broadcast_games(MsgType.KVREG_REGISTER, packet)
+
+    # --- load balance / freeze ------------------------------------------------
+
+    def _handle_game_lbc_info(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        cpu = packet.read_float32()
+        gameid = self._gameid_of(proxy)
+        if gameid:
+            self._lbc.update(gameid, cpu)
+
+    def _handle_start_freeze_game(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Buffer the game's packets for the freeze window then ack
+        (DispatcherService.go:478-494)."""
+        gameid = self._gameid_of(proxy)
+        if not gameid:
+            return
+        gi = self._game(gameid)
+        gi.block_until = self._now() + consts.DISPATCHER_FREEZE_GAME_TIMEOUT
+        proxy.send_start_freeze_game_ack()
+
+    # --- disconnects ----------------------------------------------------------
+
+    def _handle_disconnect(self, proxy: GoWorldConnection) -> None:
+        gameid = self._proxy_games.pop(proxy, 0)
+        if gameid:
+            gi = self.games[gameid]
+            if gi.proxy is not proxy:
+                return  # stale disconnect: the game already reconnected
+            gi.proxy = None
+            if gi.blocked(self._now()):
+                gwlog.infof("dispatcher %d: game %d down while frozen; buffering", self.dispid, gameid)
+                return
+            self._handle_game_down(gameid)
+            return
+        gateid = self._proxy_gates.pop(proxy, 0)
+        if gateid and self.gates.get(gateid) is proxy:
+            self.gates.pop(gateid, None)
+            p = Packet()
+            p.append_uint16(gateid)
+            self._broadcast_games(MsgType.NOTIFY_GATE_DISCONNECTED, p)
+            gwlog.infof("dispatcher %d: gate %d disconnected", self.dispid, gateid)
+
+    def _handle_game_down(self, gameid: int) -> None:
+        """Unplanned game death: drop its routing entries, tell the others
+        (DispatcherService.go:592-640)."""
+        self._lbc.remove(gameid)
+        dead = [eid for eid, info in self.entities.items() if info.gameid == gameid]
+        for eid in dead:
+            del self.entities[eid]
+        p = Packet()
+        p.append_uint16(gameid)
+        self._broadcast_games(MsgType.NOTIFY_GAME_DISCONNECTED, p, except_game=gameid)
+        gwlog.infof("dispatcher %d: game %d down, %d entities dropped", self.dispid, gameid, len(dead))
+
+    _HANDLERS = {
+        MsgType.SET_GAME_ID: _handle_set_game_id,
+        MsgType.SET_GATE_ID: _handle_set_gate_id,
+        MsgType.NOTIFY_CREATE_ENTITY: _handle_notify_create_entity,
+        MsgType.NOTIFY_DESTROY_ENTITY: _handle_notify_destroy_entity,
+        MsgType.NOTIFY_CLIENT_CONNECTED: _handle_notify_client_connected,
+        MsgType.NOTIFY_CLIENT_DISCONNECTED: _handle_notify_client_disconnected,
+        MsgType.CALL_ENTITY_METHOD: _handle_call_entity_method,
+        MsgType.CALL_ENTITY_METHOD_FROM_CLIENT: _handle_call_entity_method_from_client,
+        MsgType.CALL_NIL_SPACES: _handle_call_nil_spaces,
+        MsgType.CREATE_ENTITY_SOMEWHERE: _handle_create_entity_somewhere,
+        MsgType.LOAD_ENTITY_SOMEWHERE: _handle_load_entity_somewhere,
+        MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE: _handle_query_space_gameid_for_migrate,
+        MsgType.MIGRATE_REQUEST: _handle_migrate_request,
+        MsgType.REAL_MIGRATE: _handle_real_migrate,
+        MsgType.CANCEL_MIGRATE: _handle_cancel_migrate,
+        MsgType.SYNC_POSITION_YAW_FROM_CLIENT: _handle_sync_position_yaw_from_client,
+        MsgType.KVREG_REGISTER: _handle_kvreg_register,
+        MsgType.GAME_LBC_INFO: _handle_game_lbc_info,
+        MsgType.START_FREEZE_GAME: _handle_start_freeze_game,
+    }

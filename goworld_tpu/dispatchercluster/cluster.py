@@ -1,0 +1,140 @@
+"""Real TCP cluster client: one managed connection per dispatcher.
+
+Reference parity: ``engine/dispatchercluster/dispatcherclient/DispatcherConnMgr.go``
+— each game/gate process keeps one auto-reconnecting connection per
+dispatcher; on (re)connect it re-sends the handshake (SET_GAME_ID carrying
+the live entity list, or SET_GATE_ID), then pumps received packets into the
+process's logic queue via the delegate (:66-88,123-147). Reconnect backoff is
+1 s (consts RECONNECT_INTERVAL).
+
+While a connection is down, sends fall back to a buffering stub that drops
+packets (the reference drops to dead dispatchers too; state re-syncs on the
+reconnect handshake).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, Optional, Sequence
+
+from goworld_tpu import consts
+from goworld_tpu.dispatchercluster import DispatcherClusterBase, _NULL_SENDER
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
+from goworld_tpu.proto.conn import GoWorldConnection
+from goworld_tpu.utils import gwlog
+
+# Delegate signature: (dispatcher_index, msgtype, packet) — must be fast/non-blocking.
+PacketHandler = Callable[[int, int, Packet], None]
+# Handshake factory: given the fresh GoWorldConnection, performs the hello.
+Handshaker = Callable[[GoWorldConnection], None]
+
+
+class DispatcherConnMgr:
+    """Managed connection to one dispatcher with auto-reconnect."""
+
+    def __init__(
+        self,
+        index: int,
+        addr: tuple[str, int],
+        handshake: Handshaker,
+        on_packet: PacketHandler,
+        on_disconnect: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self.index = index
+        self.addr = addr
+        self._handshake = handshake
+        self._on_packet = on_packet
+        self._on_disconnect = on_disconnect
+        self.proxy: Optional[GoWorldConnection] = None
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = False
+        self._connected_event = asyncio.Event()
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(self._run())
+
+    async def wait_connected(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self._connected_event.wait(), timeout)
+
+    async def _run(self) -> None:
+        """Connect → handshake → recv pump; repeat forever with backoff
+        (DispatcherConnMgr.go:66-147)."""
+        while not self._stopped:
+            try:
+                reader, writer = await asyncio.open_connection(*self.addr)
+            except OSError:
+                await asyncio.sleep(consts.RECONNECT_INTERVAL)
+                continue
+            proxy = GoWorldConnection(PacketConnection(reader, writer))
+            self.proxy = proxy
+            try:
+                self._handshake(proxy)
+                self._connected_event.set()
+                while True:
+                    msgtype, packet = await proxy.recv()
+                    self._on_packet(self.index, msgtype, packet)
+            except ConnectionClosed:
+                pass
+            except Exception:
+                gwlog.trace_error("dispatcher conn %d: recv pump error", self.index)
+            finally:
+                self.proxy = None
+                self._connected_event.clear()
+                proxy.close()
+                if self._on_disconnect is not None and not self._stopped:
+                    self._on_disconnect(self.index)
+            if not self._stopped:
+                gwlog.warnf("dispatcher conn %d lost; reconnecting", self.index)
+                await asyncio.sleep(consts.RECONNECT_INTERVAL)
+
+    async def stop(self) -> None:
+        self._stopped = True
+        if self.proxy is not None:
+            self.proxy.close()
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+
+class ClusterClient(DispatcherClusterBase):
+    """The process-wide dispatcher fabric client (dispatchercluster.go:18-37)."""
+
+    def __init__(
+        self,
+        addrs: Sequence[tuple[str, int]],
+        handshake: Handshaker,
+        on_packet: PacketHandler,
+        on_disconnect: Optional[Callable[[int], None]] = None,
+    ) -> None:
+        self._mgrs = [
+            DispatcherConnMgr(i, addr, handshake, on_packet, on_disconnect)
+            for i, addr in enumerate(addrs)
+        ]
+
+    def start(self) -> None:
+        for m in self._mgrs:
+            m.start()
+
+    async def wait_connected(self, timeout: float = 10.0) -> None:
+        await asyncio.gather(*(m.wait_connected(timeout) for m in self._mgrs))
+
+    async def stop(self) -> None:
+        await asyncio.gather(*(m.stop() for m in self._mgrs))
+
+    # --- DispatcherClusterBase ----------------------------------------------
+
+    def select(self, idx: int):
+        proxy = self._mgrs[idx].proxy
+        return proxy if proxy is not None else _NULL_SENDER
+
+    def count(self) -> int:
+        return len(self._mgrs)
+
+    def flush_all(self) -> None:
+        for m in self._mgrs:
+            if m.proxy is not None:
+                m.proxy.flush()

@@ -1,0 +1,230 @@
+"""Dispatcher routing tests: two fake games + one fake gate in-process.
+
+Mirrors the reference's testing approach for the dispatcher (SURVEY.md §4.3):
+multi-process behavior exercised over real sockets on localhost.
+"""
+
+import asyncio
+
+from goworld_tpu.common import gen_client_id, gen_entity_id
+from goworld_tpu.dispatcher import DispatcherService
+from goworld_tpu.dispatchercluster.cluster import ClusterClient
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.proto.msgtypes import MsgType
+
+
+class FakePeer:
+    """A game or gate endpoint: records every packet it receives."""
+
+    def __init__(self):
+        self.received = []
+        self.event = asyncio.Event()
+
+    def on_packet(self, index, msgtype, packet):
+        self.received.append((msgtype, packet))
+        self.event.set()
+
+    async def expect(self, msgtype, timeout=5.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            for i, (mt, pkt) in enumerate(self.received):
+                if mt == msgtype:
+                    del self.received[i]
+                    return pkt
+            remaining = deadline - asyncio.get_running_loop().time()
+            assert remaining > 0, f"timed out waiting for {msgtype}"
+            self.event.clear()
+            try:
+                await asyncio.wait_for(self.event.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+
+def make_game_cluster(addr, gameid, peer, entity_ids=()):
+    def handshake(proxy):
+        proxy.send_set_game_id(gameid, False, False, False, list(entity_ids))
+
+    return ClusterClient([addr], handshake, peer.on_packet)
+
+
+def make_gate_cluster(addr, gateid, peer):
+    def handshake(proxy):
+        proxy.send_set_gate_id(gateid)
+
+    return ClusterClient([addr], handshake, peer.on_packet)
+
+
+async def _cluster(desired_games=2, desired_gates=1):
+    disp = DispatcherService(1, desired_games=desired_games, desired_gates=desired_gates)
+    await disp.start()
+    addr = ("127.0.0.1", disp.port)
+
+    game1, game2, gate1 = FakePeer(), FakePeer(), FakePeer()
+    c1 = make_game_cluster(addr, 1, game1)
+    c2 = make_game_cluster(addr, 2, game2)
+    cg = make_gate_cluster(addr, 1, gate1)
+    for c in (c1, c2, cg):
+        c.start()
+        await c.wait_connected()
+    # Let the dispatcher's logic loop drain all handshakes before tests send
+    # traffic (the dispatcher drops packets for unregistered peers, as the
+    # reference does).
+    while not disp.deployment_ready:
+        await asyncio.sleep(0.01)
+    await asyncio.sleep(0.05)
+    return disp, (c1, game1), (c2, game2), (cg, gate1)
+
+
+async def _teardown(disp, *clusters):
+    for c in clusters:
+        await c.stop()
+    await disp.stop()
+
+
+def test_handshake_ack_and_deployment_ready():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        pkt = await game1.expect(MsgType.SET_GAME_ID_ACK)
+        ack = pkt.read_data()
+        assert 1 in ack["online_games"]
+        # Barrier: 2 games + 1 gate connected → ready broadcast to games.
+        await game1.expect(MsgType.NOTIFY_DEPLOYMENT_READY)
+        await game2.expect(MsgType.NOTIFY_DEPLOYMENT_READY)
+        assert disp.deployment_ready
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_entity_routing_and_blocking():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        eid = gen_entity_id()
+        # Game 1 owns the entity.
+        c1.select(0).send_notify_create_entity(eid)
+        # Route a call from game 2 → must arrive at game 1.
+        c2.select(0).send_call_entity_method(eid, "Hello", (42,))
+        pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD)
+        assert pkt.read_entity_id() == eid
+        assert pkt.read_varstr() == "Hello"
+        assert pkt.read_args() == [42]
+
+        # Migrate: MIGRATE_REQUEST blocks the entity; calls are buffered.
+        c1.select(0).send_migrate_request(eid, gen_entity_id(), 2)
+        await game1.expect(MsgType.MIGRATE_REQUEST_ACK)
+        c2.select(0).send_call_entity_method(eid, "WhileBlocked", ())
+        await asyncio.sleep(0.05)
+        assert not any(mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game1.received)
+        # REAL_MIGRATE to game 2 → table flips, buffered call flushes to game 2.
+        c1.select(0).send_real_migrate(eid, 2, {"type": "T", "attrs": {}})
+        await game2.expect(MsgType.REAL_MIGRATE)
+        pkt = await game2.expect(MsgType.CALL_ENTITY_METHOD)
+        assert pkt.read_entity_id() == eid
+        assert pkt.read_varstr() == "WhileBlocked"
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_gate_redirect_and_filtered_broadcast():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        eid, cid = gen_entity_id(), gen_client_id()
+        # Redirect-range message routes to gate 1 by prefix.
+        c1.select(0).send_call_entity_method_on_client(1, cid, eid, "Ping", ())
+        pkt = await gate1.expect(MsgType.CALL_ENTITY_METHOD_ON_CLIENT)
+        assert pkt.read_uint16() == 1
+        assert pkt.read_client_id() == cid
+        # Gate-handled broadcast reaches all gates.
+        from goworld_tpu.proto.msgtypes import FilterOp
+
+        c1.select(0).send_call_filtered_client_proxies(FilterOp.EQ, "lv", "3", "M", ())
+        await gate1.expect(MsgType.CALL_FILTERED_CLIENTS)
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_client_connect_chooses_boot_game():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        cid, boot_eid = gen_client_id(), gen_entity_id()
+        cg.select(0).send_notify_client_connected(cid, 1, boot_eid)
+        # One of the two games gets the boot notify.
+        done = asyncio.gather(
+            game1.expect(MsgType.NOTIFY_CLIENT_CONNECTED, timeout=2),
+            game2.expect(MsgType.NOTIFY_CLIENT_CONNECTED, timeout=2),
+            return_exceptions=True,
+        )
+        results = await done
+        oks = [r for r in results if isinstance(r, Packet)]
+        assert len(oks) == 1
+        # Entity table now routes the boot entity.
+        assert disp.entities[boot_eid].gameid in (1, 2)
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_position_sync_aggregation():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        e1, e2 = gen_entity_id(), gen_entity_id()
+        c1.select(0).send_notify_create_entity(e1)
+        c2.select(0).send_notify_create_entity(e2)
+        await asyncio.sleep(0.05)
+        from goworld_tpu.proto.conn import pack_sync_record
+
+        records = pack_sync_record(e1, 1, 2, 3, 0.5) + pack_sync_record(e2, 4, 5, 6, 0.7)
+        cg.select(0).send_sync_position_yaw_from_client(records)
+        # Tick loop regroups per target game.
+        p1 = await game1.expect(MsgType.SYNC_POSITION_YAW_FROM_CLIENT)
+        p2 = await game2.expect(MsgType.SYNC_POSITION_YAW_FROM_CLIENT)
+        from goworld_tpu.proto.conn import unpack_sync_records
+
+        assert unpack_sync_records(p1.payload)[0][0] == e1
+        assert unpack_sync_records(p2.payload)[0][0] == e2
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_kvreg_replication():
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        c1.select(0).send_kvreg_register("Service/1", "game1", False)
+        pkt = await game2.expect(MsgType.KVREG_REGISTER)
+        assert pkt.read_varstr() == "Service/1"
+        assert pkt.read_varstr() == "game1"
+        assert disp.kvreg["Service/1"] == "game1"
+        # Non-forced duplicate is ignored.
+        c2.select(0).send_kvreg_register("Service/1", "game2", False)
+        await asyncio.sleep(0.05)
+        assert disp.kvreg["Service/1"] == "game1"
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_reconnect_rejects_moved_entities():
+    async def run():
+        disp = DispatcherService(1, desired_games=2, desired_gates=0)
+        await disp.start()
+        addr = ("127.0.0.1", disp.port)
+        eid = gen_entity_id()
+        game1, game2 = FakePeer(), FakePeer()
+        c1 = make_game_cluster(addr, 1, game1)
+        c1.start()
+        await c1.wait_connected()
+        c1.select(0).send_notify_create_entity(eid)
+        await asyncio.sleep(0.05)
+        # Game 2 claims the same entity in its handshake → rejected.
+        c2 = make_game_cluster(addr, 2, game2, entity_ids=[eid])
+        c2.start()
+        await c2.wait_connected()
+        pkt = await game2.expect(MsgType.SET_GAME_ID_ACK)
+        ack = pkt.read_data()
+        assert ack["rejected"] == [eid]
+        await _teardown(disp, c1, c2)
+
+    asyncio.run(run())
