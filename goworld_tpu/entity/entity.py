@@ -94,7 +94,6 @@ class Entity:
         self._sync_info_flag = 0
         self._syncing_from_client = False
         self._save_timer = None
-        self._migrating = False
         self._enter_space_request: tuple | None = None  # (spaceid, pos, time)
 
     # --- identity ----------------------------------------------------------
@@ -516,9 +515,11 @@ class Entity:
         if space is not None:
             entity_manager.runtime.post(lambda: self._enter_local_space(space, pos))
             return
-        # Cross-game: ask the dispatcher which game owns the space.
+        # Cross-game: ask the dispatcher which game owns the space. Routed by
+        # the SPACE id — its dispatch record lives on hash(spaceid)'s
+        # dispatcher (reference SelectByEntityID(spaceID), Entity.go:1006-1012).
         self._enter_space_request = (spaceid, pos, entity_manager.runtime.now())
-        dispatchercluster.select_by_entity_id(self.id).send_query_space_gameid_for_migrate(
+        dispatchercluster.select_by_entity_id(spaceid).send_query_space_gameid_for_migrate(
             spaceid, self.id
         )
 
@@ -536,6 +537,73 @@ class Entity:
             return
         self._enter_space_request = None
         dispatchercluster.select_by_entity_id(self.id).send_cancel_migrate(self.id)
+
+    def _enter_space_request_valid(self, spaceid: str) -> bool:
+        """Validity checks on migration acks (Entity.go:1026-1058): entity
+        destroyed, request superseded, or request timed out → cancel."""
+        from goworld_tpu import consts
+        from goworld_tpu.entity import entity_manager
+
+        req = self._enter_space_request
+        if req is None:
+            return False
+        rspaceid, _, t0 = req
+        if rspaceid != spaceid:
+            # Stale ack for a superseded request — ignore it; the current
+            # request stays live (reference returns on SpaceID mismatch).
+            return False
+        if self._destroyed:
+            self.cancel_enter_space()
+            return False
+        if entity_manager.runtime.now() - t0 > consts.DISPATCHER_MIGRATE_TIMEOUT:
+            gwlog.warnf("%s: enter space %s timed out", self, spaceid)
+            self.cancel_enter_space()
+            return False
+        return True
+
+    def on_query_space_gameid_ack(self, spaceid: str, gameid: int) -> None:
+        """Step 2 of cross-game EnterSpace (Entity.go:1026-1058): the
+        dispatcher told us which game owns the target space."""
+        from goworld_tpu.entity import entity_manager
+
+        if not self._enter_space_request_valid(spaceid):
+            return
+        if gameid == 0:
+            gwlog.warnf("%s: space %s not found anywhere", self, spaceid)
+            self.cancel_enter_space()
+            return
+        if gameid == entity_manager.runtime.gameid:
+            # The space appeared locally since we asked — fast path after all.
+            space = entity_manager.get_space(spaceid)
+            if space is None:
+                gwlog.warnf("%s: space %s reported local but not found", self, spaceid)
+                self.cancel_enter_space()
+                return
+            _, pos, _ = self._enter_space_request
+            self._enter_space_request = None
+            entity_manager.runtime.post(lambda: self._enter_local_space(space, pos))
+            return
+        dispatchercluster.select_by_entity_id(self.id).send_migrate_request(
+            self.id, spaceid, gameid
+        )
+
+    def on_migrate_request_ack(self, spaceid: str, space_gameid: int) -> None:
+        """Step 3: dispatcher blocked our RPC stream; pack and really migrate
+        (Entity.go:1092-1101)."""
+        from goworld_tpu.entity import entity_manager
+
+        if not self._enter_space_request_valid(spaceid):
+            return
+        _, pos, _ = self._enter_space_request
+        self._enter_space_request = None
+        data = self.get_migrate_data()
+        # Rebuild into the *target* space at the requested position.
+        data["space_id"] = spaceid
+        data["pos"] = [pos.x, pos.y, pos.z]
+        sender = dispatchercluster.select_by_entity_id(self.id)
+        gwutils.run_panicless(self.on_migrate_out)
+        self._destroy(is_migrate=True)
+        sender.send_real_migrate(self.id, space_gameid, data)
 
     def get_migrate_data(self) -> dict:
         """Everything needed to rebuild the entity elsewhere

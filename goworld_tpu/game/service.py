@@ -1,0 +1,393 @@
+"""GameService: packet handling + tick loop + terminate/freeze paths.
+
+Reference parity: ``components/game/GameService.go`` — the main loop
+(:76-187) selects {packet queue | 5 ms ticker}; ~20 message handlers
+(:92-157); terminate saves + destroys all entities (:194-213); freeze packs
+every entity to ``game<N>_freezed.dat`` (:217-266, restore.go:12-34).
+``components/game/game.go`` — boot sequence (:66-136) and signal handling
+(:138-194). ``lbc/gamelbc.go:17-39`` — CPU% reports to every dispatcher.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import time
+from typing import Optional
+
+from goworld_tpu import consts, dispatchercluster, kvdb, kvreg, storage
+from goworld_tpu.dispatchercluster.cluster import ClusterClient
+from goworld_tpu.entity import entity_manager
+from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.proto.conn import unpack_sync_records
+from goworld_tpu.proto.msgtypes import MsgType
+from goworld_tpu.utils import async_jobs, gwlog, gwutils, post
+
+# run states (GameService.go rsRunning/rsTerminating/rsFreezing...)
+RS_RUNNING = 0
+RS_TERMINATING = 1
+RS_FREEZING = 2
+RS_TERMINATED = 3
+RS_FREEZED = 4
+
+
+def freeze_filename(gameid: int) -> str:
+    return f"game{gameid}_freezed.dat"
+
+
+class GameService:
+    """One game process. Construct, then ``await service.run_async()``."""
+
+    def __init__(self, gameid: int, cfg=None, restore: bool = False) -> None:
+        from goworld_tpu.config import get as get_config
+
+        self.gameid = gameid
+        self.cfg = cfg or get_config()
+        self.restore = restore
+        self.run_state = RS_RUNNING
+        self.online_games: set[int] = set()
+        self.deployment_ready = False
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self.cluster: Optional[ClusterClient] = None
+        self._freeze_acks = 0
+        self._stop_event = asyncio.Event()
+        self.exit_code: Optional[int] = None
+        self._last_sync_collect = 0.0
+        game_cfg = self.cfg.games.get(gameid)
+        self.boot_entity = game_cfg.boot_entity if game_cfg else ""
+        self.position_sync_interval = (
+            game_cfg.position_sync_interval if game_cfg else consts.POSITION_SYNC_INTERVAL
+        )
+
+    # --- boot (game.go:66-136) ---------------------------------------------
+
+    async def run_async(self) -> int:
+        """Full process lifecycle; returns the exit code (0 normal, 2 freeze —
+        the CLI restarts freezed games with -restore)."""
+        rt = entity_manager.runtime
+        rt.gameid = self.gameid
+        game_cfg = self.cfg.games.get(self.gameid)
+        if game_cfg is not None:
+            rt.save_interval = game_cfg.save_interval
+            rt.position_sync_interval = game_cfg.position_sync_interval
+        if self.cfg.aoi.backend != "auto":
+            rt.aoi_backend = "xzlist" if self.cfg.aoi.backend == "xzlist" else "batched"
+        if not storage.initialized():
+            storage.initialize(self.cfg.storage)
+        rt.storage = storage.SyncStorageAdapter()
+        if not kvdb.initialized():
+            kvdb.initialize(self.cfg.kvdb)
+
+        if self.restore:
+            self._restore_freezed_entities()
+        elif entity_manager.get_nil_space() is None:
+            entity_manager.create_nil_space(self.gameid)
+
+        addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
+        self.cluster = ClusterClient(
+            addrs, self._handshake, self._on_packet, self._on_dispatcher_disconnect
+        )
+        dispatchercluster.set_cluster(self.cluster)
+        self.cluster.start()
+
+        self._install_signal_handlers()
+        lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
+        gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
+        try:
+            await self._main_loop()
+        finally:
+            lbc_task.cancel()
+            await self.cluster.stop()
+            dispatchercluster.set_cluster(None)
+        return self.exit_code or 0
+
+    def _handshake(self, proxy) -> None:
+        proxy.send_set_game_id(
+            self.gameid,
+            is_reconnect=self.deployment_ready,
+            is_restore=self.restore,
+            is_ban_boot_entity=not self.boot_entity,
+            entity_ids=list(entity_manager.entities().keys()),
+        )
+
+    def _on_packet(self, index: int, msgtype: int, packet: Packet) -> None:
+        self._queue.put_nowait((msgtype, packet))
+
+    def _on_dispatcher_disconnect(self, index: int) -> None:
+        gwlog.warnf("game %d: dispatcher %d disconnected", self.gameid, index)
+
+    def _install_signal_handlers(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, self.terminate)
+            loop.add_signal_handler(signal.SIGHUP, self.start_freeze)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread (tests) or unsupported platform
+
+    # --- main loop (GameService.go:76-187) -----------------------------------
+
+    async def _main_loop(self) -> None:
+        tick = consts.GAME_SERVICE_TICK_INTERVAL
+        rt = entity_manager.runtime
+        while True:
+            try:
+                msgtype, packet = await asyncio.wait_for(self._queue.get(), timeout=tick)
+                self._handle_packet(msgtype, packet)
+                # Drain whatever else arrived without waiting.
+                while True:
+                    try:
+                        msgtype, packet = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
+                    self._handle_packet(msgtype, packet)
+            except asyncio.TimeoutError:
+                pass
+            rt.timer_service.tick()
+            if rt.aoi_service is not None:
+                rt.aoi_service.tick()
+            post.tick()
+            now = time.monotonic()
+            if now - self._last_sync_collect >= self.position_sync_interval:
+                self._last_sync_collect = now
+                self._send_entity_sync_infos()
+            if self.run_state == RS_TERMINATING:
+                self._do_terminate()
+                return
+            if self.run_state == RS_FREEZING and self._freeze_acks >= len(self.cfg.dispatchers):
+                self._do_freeze()
+                return
+
+    def _send_entity_sync_infos(self) -> None:
+        """Push batched position syncs, one packet per gate (§3.3)."""
+        per_gate = entity_manager.collect_entity_sync_infos()
+        for gateid, buf in per_gate.items():
+            dispatchercluster.select_by_gate_id(gateid).send_sync_position_yaw_on_clients(
+                gateid, bytes(buf)
+            )
+
+    # --- packet handlers (GameService.go:92-157) ------------------------------
+
+    def _handle_packet(self, msgtype: int, packet: Packet) -> None:
+        try:
+            self._dispatch_packet(msgtype, packet)
+        except Exception:
+            gwlog.trace_error("game %d: error handling msgtype %s", self.gameid, msgtype)
+
+    def _dispatch_packet(self, msgtype: int, packet: Packet) -> None:
+        if msgtype == MsgType.CALL_ENTITY_METHOD:
+            eid = packet.read_entity_id()
+            method = packet.read_varstr()
+            args = tuple(packet.read_args())
+            entity_manager.handle_call(eid, method, args, None)
+        elif msgtype == MsgType.CALL_ENTITY_METHOD_FROM_CLIENT:
+            eid = packet.read_entity_id()
+            method = packet.read_varstr()
+            args = tuple(packet.read_args())
+            clientid = packet.read_client_id()
+            entity_manager.handle_call(eid, method, args, clientid)
+        elif msgtype == MsgType.SYNC_POSITION_YAW_FROM_CLIENT:
+            for eid, x, y, z, yaw in unpack_sync_records(packet.payload):
+                e = entity_manager.get_entity(eid)
+                if e is not None:
+                    e.on_sync_position_yaw_from_client(x, y, z, yaw)
+        elif msgtype == MsgType.NOTIFY_CLIENT_CONNECTED:
+            clientid = packet.read_client_id()
+            gateid = packet.read_uint16()
+            boot_eid = packet.read_entity_id()
+            self._handle_client_connected(clientid, gateid, boot_eid)
+        elif msgtype == MsgType.NOTIFY_CLIENT_DISCONNECTED:
+            clientid = packet.read_client_id()
+            packet.read_entity_id()
+            owner = entity_manager.get_client_owner(clientid)
+            if owner is not None:
+                owner.notify_client_disconnected()
+        elif msgtype == MsgType.CREATE_ENTITY_SOMEWHERE:
+            packet.read_uint16()
+            typename = packet.read_varstr()
+            eid = packet.read_entity_id()
+            attrs = packet.read_data()
+            self._handle_create_entity_somewhere(typename, eid, attrs)
+        elif msgtype == MsgType.LOAD_ENTITY_SOMEWHERE:
+            packet.read_uint16()
+            typename = packet.read_varstr()
+            eid = packet.read_entity_id()
+            entity_manager.load_entity_locally(typename, eid)
+        elif msgtype == MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK:
+            spaceid = packet.read_entity_id()
+            eid = packet.read_entity_id()
+            gameid = packet.read_uint16()
+            e = entity_manager.get_entity(eid)
+            if e is not None:
+                e.on_query_space_gameid_ack(spaceid, gameid)
+        elif msgtype == MsgType.MIGRATE_REQUEST_ACK:
+            eid = packet.read_entity_id()
+            spaceid = packet.read_entity_id()
+            space_gameid = packet.read_uint16()
+            e = entity_manager.get_entity(eid)
+            if e is not None:
+                e.on_migrate_request_ack(spaceid, space_gameid)
+        elif msgtype == MsgType.REAL_MIGRATE:
+            eid = packet.read_entity_id()
+            packet.read_uint16()
+            data = packet.read_data()
+            entity_manager.restore_entity(eid, data, is_migrate=True)
+        elif msgtype == MsgType.CALL_NIL_SPACES:
+            packet.read_uint16()
+            method = packet.read_varstr()
+            args = tuple(packet.read_args())
+            ns = entity_manager.get_nil_space()
+            if ns is not None:
+                ns.on_call_from_remote(method, args, None)
+        elif msgtype == MsgType.SET_GAME_ID_ACK:
+            ack = packet.read_data()
+            self._handle_set_game_id_ack(ack)
+        elif msgtype == MsgType.NOTIFY_GAME_CONNECTED:
+            self.online_games.add(packet.read_uint16())
+        elif msgtype == MsgType.NOTIFY_GAME_DISCONNECTED:
+            self.online_games.discard(packet.read_uint16())
+        elif msgtype == MsgType.NOTIFY_GATE_DISCONNECTED:
+            entity_manager.on_gate_disconnected(packet.read_uint16())
+        elif msgtype == MsgType.NOTIFY_DEPLOYMENT_READY:
+            self._on_deployment_ready()
+        elif msgtype == MsgType.KVREG_REGISTER:
+            key = packet.read_varstr()
+            value = packet.read_varstr()
+            kvreg.on_registered(key, value)
+        elif msgtype == MsgType.START_FREEZE_GAME_ACK:
+            self._freeze_acks += 1
+        else:
+            gwlog.warnf("game %d: unhandled msgtype %s", self.gameid, msgtype)
+
+    def _handle_client_connected(self, clientid: str, gateid: int, boot_eid: str) -> None:
+        """Create the boot entity and bind the fresh client
+        (GameService.go:413-422)."""
+        if not self.boot_entity:
+            gwlog.errorf("game %d: client connected but no boot entity configured", self.gameid)
+            return
+        e = entity_manager.create_entity_locally(self.boot_entity, eid=boot_eid)
+        e.set_client(GameClient(clientid, gateid, e.id))
+
+    def _handle_create_entity_somewhere(self, typename: str, eid: str, attrs: dict) -> None:
+        kind = attrs.pop("_kind", None)
+        desc = entity_manager.get_entity_type_desc(typename)
+        if desc.is_space and kind is not None:
+            entity_manager.create_space_locally(int(kind), eid=eid, attrs=attrs or None)
+        else:
+            entity_manager.create_entity_locally(typename, eid=eid, attrs=attrs or None)
+
+    def _handle_set_game_id_ack(self, ack: dict) -> None:
+        """Reconnect reconciliation + kvreg replay (GameService.go:341-377)."""
+        self.online_games = set(ack.get("online_games", []))
+        for eid in ack.get("rejected", []):
+            e = entity_manager.get_entity(eid)
+            if e is not None:
+                gwlog.warnf("game %d: destroying rejected entity %s", self.gameid, e)
+                e.destroy()
+        kvreg.replay(ack.get("kvreg", {}))
+        if ack.get("ready"):
+            self._on_deployment_ready()
+
+    def _on_deployment_ready(self) -> None:
+        if self.deployment_ready:
+            return
+        self.deployment_ready = True
+        gwlog.infof("game %d: deployment ready", self.gameid)
+        entity_manager.on_game_ready()
+
+    # --- terminate (GameService.go:194-213) -----------------------------------
+
+    def terminate(self) -> None:
+        if self.run_state == RS_RUNNING:
+            self.run_state = RS_TERMINATING
+
+    def _do_terminate(self) -> None:
+        gwlog.infof("game %d terminating: saving and destroying all entities", self.gameid)
+        for e in list(entity_manager.entities().values()):
+            if e.is_persistent():
+                gwutils.run_panicless(e.save)
+        for e in list(entity_manager.entities().values()):
+            if not e.is_space_entity():
+                gwutils.run_panicless(e.destroy)
+        for s in list(entity_manager.entities().values()):
+            gwutils.run_panicless(s.destroy)
+        storage.wait_clear()
+        post.tick()
+        self.run_state = RS_TERMINATED
+        self.exit_code = 0
+
+    # --- freeze (GameService.go:217-310, game.go:163-188) ---------------------
+
+    def start_freeze(self) -> None:
+        """SIGHUP entry: ask every dispatcher to buffer our packets."""
+        if self.run_state != RS_RUNNING:
+            return
+        gwlog.infof("game %d freezing: notifying %d dispatchers", self.gameid, len(self.cfg.dispatchers))
+        self._freeze_acks = 0
+        self.run_state = RS_FREEZING
+        for sender in dispatchercluster.select_all():
+            sender.send_start_freeze_game()
+
+    def _do_freeze(self) -> None:
+        async_jobs.wait_clear()
+        post.tick()
+        data = entity_manager.freeze_entities(self.gameid)
+        path = freeze_filename(self.gameid)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(data, f)
+        os.replace(tmp, path)
+        gwlog.infof("game %d freezed to %s (%d spaces, %d entities)",
+                    self.gameid, path, len(data["spaces"]), len(data["entities"]))
+        self.run_state = RS_FREEZED
+        self.exit_code = 2  # CLI restarts with -restore
+
+    def _restore_freezed_entities(self) -> None:
+        """restore.go:12-34: read the freeze file and rebuild in 3 passes."""
+        path = freeze_filename(self.gameid)
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+        entity_manager.restore_freezed_entities(data)
+        os.remove(path)
+        gwlog.infof("game %d restored %d spaces + %d entities from %s",
+                    self.gameid, len(data["spaces"]), len(data["entities"]), path)
+
+    # --- load reporting (lbc/gamelbc.go:17-39) --------------------------------
+
+    async def _lbc_loop(self) -> None:
+        last_cpu = time.process_time()
+        last_wall = time.monotonic()
+        while True:
+            await asyncio.sleep(1.0)
+            cpu, wall = time.process_time(), time.monotonic()
+            pct = 100.0 * (cpu - last_cpu) / max(1e-9, wall - last_wall)
+            last_cpu, last_wall = cpu, wall
+            for sender in dispatchercluster.select_all():
+                sender.send_game_lbc_info(pct)
+
+
+def run(gameid: int | None = None, restore: bool | None = None) -> int:
+    """Process entry point: parse args (game.go:52-61), run the service."""
+    import argparse
+
+    from goworld_tpu.config import get as get_config, set_config_file
+
+    parser = argparse.ArgumentParser(description="goworld_tpu game process")
+    parser.add_argument("-gid", type=int, default=gameid or 1)
+    parser.add_argument("-configfile", type=str, default="")
+    parser.add_argument("-log", type=str, default="")
+    parser.add_argument("-restore", action="store_true", default=bool(restore))
+    args, _ = parser.parse_known_args()
+    if args.configfile:
+        set_config_file(args.configfile)
+    cfg = get_config()
+    game_cfg = cfg.games.get(args.gid)
+    gwlog.setup(
+        level=(args.log or (game_cfg.log_level if game_cfg else "info")),
+        logfile=(game_cfg.log_file if game_cfg else None) or None,
+    )
+    gwlog.set_source(f"game{args.gid}")
+    svc = GameService(args.gid, cfg, restore=args.restore)
+    return asyncio.run(svc.run_async())

@@ -1,0 +1,60 @@
+"""SQLite KVDB backend: ordered keys make GetRange a btree scan.
+
+Fills the reference's ``kvdb_mysql``/``kvdb_mongodb`` slot (kvdb_types.go:4-25)
+with a serverless local store.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+from typing import Optional
+
+
+class SQLiteKVDB:
+    def __init__(self, directory: str, filename: str = "kvdb.sqlite") -> None:
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, filename)
+        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
+            )
+            self._conn.commit()
+
+    def get(self, key: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+        return row[0] if row else None
+
+    def put(self, key: str, val: str) -> None:
+        with self._lock:
+            self._conn.execute(
+                "INSERT INTO kv (k, v) VALUES (?, ?)"
+                " ON CONFLICT(k) DO UPDATE SET v = excluded.v",
+                (key, val),
+            )
+            self._conn.commit()
+
+    def get_or_put(self, key: str, val: str) -> Optional[str]:
+        with self._lock:
+            row = self._conn.execute("SELECT v FROM kv WHERE k = ?", (key,)).fetchone()
+            if row is not None:
+                return row[0]
+            self._conn.execute("INSERT INTO kv (k, v) VALUES (?, ?)", (key, val))
+            self._conn.commit()
+            return None
+
+    def get_range(self, begin: str, end: str) -> list[tuple[str, str]]:
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT k, v FROM kv WHERE k >= ? AND k < ? ORDER BY k",
+                (begin, end),
+            ).fetchall()
+        return [(k, v) for k, v in rows]
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,113 @@
+"""Entity persistence: one ordered op queue + one storage worker.
+
+Reference parity: ``engine/storage/storage.go:23-286`` — all storage
+operations go through a single serial queue drained by one worker
+(storageRoutine), so saves/loads for one entity never race; saves retry
+forever (:165-286); completion callbacks are posted back to the main loop.
+Backend SPI mirrors ``storage_common.go:6-13``: write/read/exists/list.
+
+Backends: filesystem (one JSON file per entity, the reference's de-facto
+"fake DB" for local runs, filesystem.go:22-121) and sqlite (stdlib; the
+TPU-native stand-in for the reference's mysql backend).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+from goworld_tpu.utils import async_jobs, gwlog
+
+_GROUP = "storage"
+_SAVE_RETRY_INTERVAL = 1.0
+
+_backend = None
+
+
+def initialize(storage_config) -> None:
+    """Create the backend from a StorageConfig (read_config.go [storage])."""
+    global _backend
+    _backend = make_backend(storage_config.type, storage_config)
+
+
+def make_backend(kind: str, cfg):
+    if kind == "filesystem":
+        from goworld_tpu.storage.filesystem import FilesystemEntityStorage
+
+        return FilesystemEntityStorage(cfg.directory)
+    if kind == "sqlite":
+        from goworld_tpu.storage.sqlite import SQLiteEntityStorage
+
+        return SQLiteEntityStorage(cfg.directory)
+    raise ValueError(f"unknown storage type {kind!r} (available: filesystem, sqlite)")
+
+
+def set_backend(backend) -> None:
+    global _backend
+    _backend = backend
+
+
+def get_backend():
+    return _backend
+
+
+def initialized() -> bool:
+    return _backend is not None
+
+
+# --- async API (storage.go:66-130) ------------------------------------------
+
+
+def save(typename: str, eid: str, data: dict, callback: Optional[Callable] = None) -> None:
+    """Queue a save; retries forever on error (storageRoutine :197-240)."""
+
+    def routine():
+        while True:
+            try:
+                _backend.write(typename, eid, data)
+                return None
+            except Exception as e:  # noqa: BLE001
+                gwlog.errorf("storage: save %s.%s failed (%s); retrying", typename, eid, e)
+                time.sleep(_SAVE_RETRY_INTERVAL)
+
+    async_jobs.append_job(_GROUP, routine, _wrap(callback))
+
+
+def load(typename: str, eid: str, callback: Callable) -> None:
+    async_jobs.append_job(_GROUP, lambda: _backend.read(typename, eid), _wrap(callback))
+
+
+def exists(typename: str, eid: str, callback: Callable) -> None:
+    async_jobs.append_job(_GROUP, lambda: _backend.exists(typename, eid), _wrap(callback))
+
+
+def list_entity_ids(typename: str, callback: Callable) -> None:
+    async_jobs.append_job(_GROUP, lambda: _backend.list_entity_ids(typename), _wrap(callback))
+
+
+def _wrap(callback):
+    if callback is None:
+        return None
+    return lambda result, err: callback(result, err)
+
+
+def wait_clear(timeout: float = 30.0) -> bool:
+    """Drain the op queue (terminate/freeze path, storage.go:118-121)."""
+    return async_jobs.wait_clear(timeout)
+
+
+class SyncStorageAdapter:
+    """Synchronous facade bound to the module backend; plugs into
+    ``entity_manager.Runtime.storage`` for in-process use and tests."""
+
+    def save(self, typename: str, eid: str, data: dict) -> None:
+        if _backend is not None:
+            _backend.write(typename, eid, data)
+
+    def load(self, typename: str, eid: str) -> Optional[dict]:
+        if _backend is None:
+            return None
+        return _backend.read(typename, eid)
+
+    def exists(self, typename: str, eid: str) -> bool:
+        return _backend is not None and _backend.exists(typename, eid)

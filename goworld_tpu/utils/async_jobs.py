@@ -43,7 +43,9 @@ class _Group:
                 err = e
                 gwlog.errorf("async %s: job failed: %s\n%s", self.name, e, traceback.format_exc())
             if callback is not None:
-                post.post(lambda r=result, e=err: callback(r, e))
+                # Bind callback as a default too: the loop rebinds the local
+                # on the next iteration before posted lambdas run.
+                post.post(lambda r=result, e=err, cb=callback: cb(r, e))
             with self.cond:
                 self.pending -= 1
                 if self.pending == 0:

@@ -1,0 +1,93 @@
+"""Backend contract suites for storage and kvdb.
+
+Mirrors the reference's approach of running one shared Set/Find suite over
+every backend (kvdb_backend_test.go:19-115, SURVEY.md §4.1).
+"""
+
+import pytest
+
+from goworld_tpu import kvdb, storage
+from goworld_tpu.config.read_config import KVDBConfig, StorageConfig
+from goworld_tpu.utils import post
+
+
+@pytest.fixture(params=["filesystem", "sqlite"])
+def entity_backend(request, tmp_path):
+    cfg = StorageConfig(type=request.param, directory=str(tmp_path / "es"))
+    backend = storage.make_backend(request.param, cfg)
+    yield backend
+    backend.close()
+
+
+@pytest.fixture(params=["filesystem", "sqlite"])
+def kv_backend(request, tmp_path):
+    cfg = KVDBConfig(type=request.param, directory=str(tmp_path / "kv"))
+    backend = kvdb.make_backend(request.param, cfg)
+    yield backend
+    backend.close()
+
+
+def test_entity_storage_contract(entity_backend):
+    b = entity_backend
+    assert b.read("Avatar", "a" * 16) is None
+    assert not b.exists("Avatar", "a" * 16)
+    data = {"name": "hero", "level": 3, "items": [1, 2], "nested": {"hp": 7.5}}
+    b.write("Avatar", "a" * 16, data)
+    assert b.read("Avatar", "a" * 16) == data
+    assert b.exists("Avatar", "a" * 16)
+    # Overwrite
+    b.write("Avatar", "a" * 16, {"name": "hero2"})
+    assert b.read("Avatar", "a" * 16) == {"name": "hero2"}
+    # Listing is per-type and sorted
+    b.write("Avatar", "b" * 16, {})
+    b.write("Monster", "c" * 16, {})
+    assert b.list_entity_ids("Avatar") == ["a" * 16, "b" * 16]
+    assert b.list_entity_ids("Monster") == ["c" * 16]
+
+
+def test_kvdb_contract(kv_backend):
+    b = kv_backend
+    assert b.get("missing") is None
+    b.put("k1", "v1")
+    assert b.get("k1") == "v1"
+    b.put("k1", "v2")
+    assert b.get("k1") == "v2"
+    # get_or_put claims only when absent (the login primitive)
+    assert b.get_or_put("k1", "other") == "v2"
+    assert b.get_or_put("fresh", "mine") is None
+    assert b.get("fresh") == "mine"
+    # range [begin, end) sorted
+    b.put("r/a", "1")
+    b.put("r/b", "2")
+    b.put("r/c", "3")
+    assert b.get_range("r/a", "r/c") == [("r/a", "1"), ("r/b", "2")]
+
+
+def test_async_storage_api(tmp_path):
+    storage.initialize(StorageConfig(type="sqlite", directory=str(tmp_path)))
+    results = []
+    storage.save("Avatar", "e" * 16, {"x": 1}, lambda r, err: results.append(("save", err)))
+    storage.load("Avatar", "e" * 16, lambda r, err: results.append(("load", r)))
+    storage.exists("Avatar", "e" * 16, lambda r, err: results.append(("exists", r)))
+    storage.list_entity_ids("Avatar", lambda r, err: results.append(("list", r)))
+    assert storage.wait_clear(10)
+    post.tick()
+    assert results == [
+        ("save", None),
+        ("load", {"x": 1}),
+        ("exists", True),
+        ("list", ["e" * 16]),
+    ]
+    storage.set_backend(None)
+
+
+def test_async_kvdb_api(tmp_path):
+    kvdb.initialize(KVDBConfig(type="filesystem", directory=str(tmp_path)))
+    results = []
+    kvdb.put("user1", "avatar9", lambda r, err: results.append("put"))
+    kvdb.get("user1", lambda r, err: results.append(r))
+    kvdb.get_or_put("user1", "x", lambda r, err: results.append(r))
+    assert kvdb.wait_clear(10)
+    post.tick()
+    assert results == ["put", "avatar9", "avatar9"]
+    kvdb.set_backend(None)
