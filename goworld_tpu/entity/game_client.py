@@ -25,6 +25,9 @@ class GameClient:
     # --- entity mirror lifecycle ------------------------------------------
 
     def send_create_entity(self, entity, is_player: bool) -> None:
+        # Own client sees Client+AllClients attrs; other clients (AOI
+        # neighbors) see AllClients attrs only (Entity.go:814-917).
+        attrs = entity.client_attrs() if is_player else entity.all_client_attrs()
         pos = entity.position
         self._sender().send_create_entity_on_client(
             self.gateid,
@@ -32,7 +35,7 @@ class GameClient:
             is_player,
             entity.id,
             entity.typename,
-            entity.client_attrs(),
+            attrs,
             pos.x,
             pos.y,
             pos.z,

@@ -1,0 +1,373 @@
+"""GateService: client sockets, boot flow, filter broadcast, sync batching.
+
+Reference parity: ``components/gate/GateService.go`` —
+
+- One recv task per client connection feeding a single logic loop (no locks
+  in logic, :427-448).
+- The gate (not the game) generates the boot EntityID and announces the fresh
+  client to a dispatcher selected by that id (:213-218).
+- Client→server position syncs are coalesced per dispatcher and flushed every
+  ``position_sync_interval`` (:398-425); server→client syncs arrive batched
+  per gate and are de-multiplexed per clientid (:346-371).
+- Redirect-range packets (game→client) carry a [u16 gateid][clientid] prefix
+  which the gate strips before forwarding; is-player CREATE_ENTITY_ON_CLIENT
+  packets are sniffed to track each proxy's owner entity (:262-293).
+- Filter-prop trees per key serve CALL_FILTERED_CLIENTS with 6 comparison
+  ops (FilterTree.go:12-102).
+- Heartbeat timeouts kill client proxies (:201-211); losing a dispatcher
+  connection makes the gate exit on purpose (gate.go:138-143).
+
+TLS is supported natively via asyncio's ssl support (the reference wraps
+conns with crypto/tls, gate.go:97-118).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+import time
+from typing import Optional
+
+from goworld_tpu import consts
+from goworld_tpu.common import gen_client_id, gen_entity_id, hash_entity_id
+from goworld_tpu.config import GateConfig, GoWorldConfig
+from goworld_tpu.dispatchercluster.cluster import ClusterClient
+from goworld_tpu.gate.filter_tree import FilterTree
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
+from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
+from goworld_tpu.proto.msgtypes import FilterOp, MsgType, is_gate_redirect
+from goworld_tpu.utils import gwlog
+
+_CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
+
+
+class ClientProxy:
+    """Server-side handle of one connected client (ClientProxy.go:39-52)."""
+
+    __slots__ = ("clientid", "conn", "owner_eid", "heartbeat_time", "filter_props")
+
+    def __init__(self, conn: GoWorldConnection) -> None:
+        self.clientid = gen_client_id()
+        self.conn = conn
+        self.owner_eid: str = ""
+        self.heartbeat_time = time.monotonic()
+        self.filter_props: dict[str, str] = {}
+
+    def send(self, msgtype: int, payload: bytes) -> None:
+        self.conn.send_packet_raw(msgtype, payload)
+
+    def close(self) -> None:
+        self.conn.close()
+
+    def __repr__(self) -> str:
+        return f"ClientProxy<{self.clientid}|owner={self.owner_eid or '-'}>"
+
+
+class GateService:
+    """One gate process. Construct, then ``await service.run_async()``."""
+
+    def __init__(self, gateid: int, cfg: Optional[GoWorldConfig] = None) -> None:
+        from goworld_tpu.config import get as get_config
+
+        self.gateid = gateid
+        self.cfg = cfg or get_config()
+        self.gate_cfg: GateConfig = self.cfg.gates.get(gateid) or GateConfig()
+        self.clients: dict[str, ClientProxy] = {}
+        self.filter_trees: dict[str, FilterTree] = {}
+        self.cluster: Optional[ClusterClient] = None
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._tasks: list[asyncio.Task] = []
+        self._stopped = asyncio.Event()
+        # client→server sync coalescing: dispatcher index → 32 B records
+        self._pending_syncs: dict[int, bytearray] = {}
+        self.port: int = 0
+        self.exit_code: Optional[int] = None
+
+    # --- lifecycle (gate.go:57-101) ----------------------------------------
+
+    async def run_async(self) -> int:
+        await self.start()
+        await self._stopped.wait()
+        await self.stop()
+        return self.exit_code or 0
+
+    async def start(self) -> None:
+        addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
+        self.cluster = ClusterClient(
+            addrs, self._handshake, self._on_dispatcher_packet, self._on_dispatcher_disconnect
+        )
+        self.cluster.start()
+
+        ssl_ctx = self._make_ssl_context()
+        self._server = await asyncio.start_server(
+            self._serve_client, self.gate_cfg.host, self.gate_cfg.port, ssl=ssl_ctx
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        loop = asyncio.get_running_loop()
+        self._tasks.append(loop.create_task(self._logic_loop()))
+        self._tasks.append(loop.create_task(self._tick_loop()))
+        gwlog.infof("gate %d listening on %s:%d (tls=%s)",
+                    self.gateid, self.gate_cfg.host, self.port, ssl_ctx is not None)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+        for cp in list(self.clients.values()):
+            cp.close()
+        self.clients.clear()
+        if self.cluster is not None:
+            await self.cluster.stop()
+
+    def terminate(self) -> None:
+        self.exit_code = 0
+        self._stopped.set()
+
+    def _make_ssl_context(self) -> Optional[ssl.SSLContext]:
+        if not self.gate_cfg.encrypt_connection:
+            return None
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.load_cert_chain(self.gate_cfg.rsa_cert, self.gate_cfg.rsa_key)
+        return ctx
+
+    def _handshake(self, proxy: GoWorldConnection) -> None:
+        proxy.send_set_gate_id(self.gateid)
+
+    def _on_dispatcher_disconnect(self, index: int) -> None:
+        # The reference gate exits when its dispatcher connection dies
+        # (gate.go:138-143); the supervisor restarts it.
+        gwlog.errorf("gate %d: dispatcher %d disconnected, quitting", self.gateid, index)
+        self.exit_code = 1
+        self._stopped.set()
+
+    # --- client connections (GateService.go:125-199) ------------------------
+
+    async def _serve_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        conn = GoWorldConnection(PacketConnection(reader, writer))
+        cp = ClientProxy(conn)
+        self._queue.put_nowait(("connect", cp, 0, None))
+        try:
+            while True:
+                msgtype, packet = await conn.recv()
+                self._queue.put_nowait(("packet", cp, msgtype, packet))
+        except ConnectionClosed:
+            pass
+        finally:
+            conn.close()
+            self._queue.put_nowait(("disconnect", cp, 0, None))
+
+    async def _logic_loop(self) -> None:
+        while True:
+            kind, cp, msgtype, packet = await self._queue.get()
+            try:
+                if kind == "packet":
+                    self._handle_client_packet(cp, msgtype, packet)
+                elif kind == "connect":
+                    self._on_new_client(cp)
+                elif kind == "disconnect":
+                    self._on_client_gone(cp)
+                elif kind == "dispatcher":
+                    self._handle_dispatcher_packet(msgtype, packet)
+            except Exception:
+                gwlog.trace_error("gate %d: error handling %s/%s", self.gateid, kind, msgtype)
+
+    async def _tick_loop(self) -> None:
+        last_flush = time.monotonic()
+        while True:
+            await asyncio.sleep(consts.GATE_SERVICE_TICK_INTERVAL)
+            now = time.monotonic()
+            if now - last_flush >= self.gate_cfg.position_sync_interval:
+                last_flush = now
+                self._flush_pending_syncs()
+            self._sweep_heartbeats(now)
+
+    def _select_by_eid(self, eid: str):
+        """Entity-id-hash dispatcher selection over the gate's OWN cluster —
+        never the process-global one, which belongs to the game side."""
+        assert self.cluster is not None
+        return self.cluster.select(hash_entity_id(eid) % self.cluster.count())
+
+    def _on_new_client(self, cp: ClientProxy) -> None:
+        """Register the proxy and announce it with a fresh boot-entity id
+        (GateService.go:213-218)."""
+        self.clients[cp.clientid] = cp
+        boot_eid = gen_entity_id()
+        self._select_by_eid(boot_eid).send_notify_client_connected(
+            cp.clientid, self.gateid, boot_eid
+        )
+        gwlog.debugf("gate %d: client %s connected, boot entity %s", self.gateid, cp.clientid, boot_eid)
+
+    def _on_client_gone(self, cp: ClientProxy) -> None:
+        if self.clients.pop(cp.clientid, None) is None:
+            return  # already removed (heartbeat kill)
+        self._clear_filter_props(cp)
+        if cp.owner_eid:
+            self._select_by_eid(cp.owner_eid).send_notify_client_disconnected(
+                cp.clientid, cp.owner_eid
+            )
+
+    def _sweep_heartbeats(self, now: float) -> None:
+        timeout = self.gate_cfg.heartbeat_timeout
+        if timeout <= 0:
+            return
+        for cp in list(self.clients.values()):
+            if now - cp.heartbeat_time > timeout:
+                gwlog.warnf("gate %d: client %s heartbeat timeout", self.gateid, cp.clientid)
+                cp.close()  # recv task will enqueue the disconnect
+
+    # --- client → server (GateService.go:245-248,398-425) -------------------
+
+    def _handle_client_packet(self, cp: ClientProxy, msgtype: int, packet: Packet) -> None:
+        cp.heartbeat_time = time.monotonic()
+        if msgtype == MsgType.HEARTBEAT_FROM_CLIENT:
+            return
+        if msgtype == MsgType.SYNC_POSITION_YAW_FROM_CLIENT:
+            record = packet.payload[:SYNC_RECORD_SIZE]
+            eid = record[:16].decode("ascii")
+            idx = hash_entity_id(eid) % max(1, self.cluster.count() if self.cluster else 1)
+            self._pending_syncs.setdefault(idx, bytearray()).extend(record)
+            return
+        if msgtype == MsgType.CALL_ENTITY_METHOD_FROM_CLIENT:
+            eid = packet.read_entity_id()
+            packet.set_read_pos(0)
+            packet.append_client_id(cp.clientid)
+            self._select_by_eid(eid).send(MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, packet)
+            return
+        gwlog.warnf("gate %d: unexpected client msgtype %s", self.gateid, msgtype)
+
+    def _flush_pending_syncs(self) -> None:
+        if not self._pending_syncs or self.cluster is None:
+            return
+        for idx, buf in self._pending_syncs.items():
+            self.cluster.select(idx).send_sync_position_yaw_from_client(bytes(buf))
+        self._pending_syncs.clear()
+
+    # --- dispatcher → gate ---------------------------------------------------
+
+    def _on_dispatcher_packet(self, index: int, msgtype: int, packet: Packet) -> None:
+        self._queue.put_nowait(("dispatcher", None, msgtype, packet))
+
+    def _handle_dispatcher_packet(self, msgtype: int, packet: Packet) -> None:
+        if is_gate_redirect(msgtype):
+            self._handle_redirect(msgtype, packet)
+        elif msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
+            self._handle_sync_on_clients(packet)
+        elif msgtype == MsgType.CALL_FILTERED_CLIENTS:
+            self._handle_call_filtered_clients(packet)
+        else:
+            gwlog.warnf("gate %d: unhandled dispatcher msgtype %s", self.gateid, msgtype)
+
+    def _handle_redirect(self, msgtype: int, packet: Packet) -> None:
+        """Strip the [u16 gateid][clientid] prefix and forward to the client;
+        sniff is-player creates for owner tracking (GateService.go:262-293)."""
+        packet.read_uint16()  # gateid (it is ours; dispatcher routed on it)
+        clientid = packet.read_client_id()
+        cp = self.clients.get(clientid)
+        if msgtype == MsgType.SET_CLIENTPROXY_FILTER_PROP:
+            if cp is not None:
+                self._set_filter_prop(cp, packet.read_varstr(), packet.read_varstr())
+            return
+        if msgtype == MsgType.CLEAR_CLIENTPROXY_FILTER_PROPS:
+            if cp is not None:
+                self._clear_filter_props(cp)
+            return
+        if cp is None:
+            return  # client already gone; drop quietly (reference behavior)
+        rest = packet.read_rest()
+        if msgtype == MsgType.CREATE_ENTITY_ON_CLIENT:
+            is_player = rest[0] != 0
+            if is_player:
+                cp.owner_eid = rest[1:17].decode("ascii")
+        cp.send(msgtype, rest)
+
+    def _handle_sync_on_clients(self, packet: Packet) -> None:
+        """De-multiplex [clientid + 32 B record] blocks per client
+        (GateService.go:346-371)."""
+        packet.read_uint16()  # gateid
+        data = packet.read_rest()  # raw [clientid + record] blocks
+        per_client: dict[str, bytearray] = {}
+        for off in range(0, len(data), _CLIENT_BLOCK_SIZE):
+            block = data[off : off + _CLIENT_BLOCK_SIZE]
+            clientid = block[:16].decode("ascii")
+            per_client.setdefault(clientid, bytearray()).extend(block[16:])
+        for clientid, records in per_client.items():
+            cp = self.clients.get(clientid)
+            if cp is not None:
+                cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, bytes(records))
+
+    # --- filter props (FilterTree.go, GateService.go:300-344) ----------------
+
+    def _set_filter_prop(self, cp: ClientProxy, key: str, val: str) -> None:
+        old = cp.filter_props.get(key)
+        tree = self.filter_trees.get(key)
+        if tree is None:
+            tree = self.filter_trees[key] = FilterTree()
+        if old is not None:
+            tree.remove(old, cp.clientid)
+        cp.filter_props[key] = val
+        tree.insert(val, cp.clientid)
+
+    def _clear_filter_props(self, cp: ClientProxy) -> None:
+        for key, val in cp.filter_props.items():
+            tree = self.filter_trees.get(key)
+            if tree is not None:
+                tree.remove(val, cp.clientid)
+        cp.filter_props.clear()
+
+    def _handle_call_filtered_clients(self, packet: Packet) -> None:
+        op = FilterOp(packet.read_byte())
+        key = packet.read_varstr()
+        val = packet.read_varstr()
+        payload = packet.read_rest()  # [method][args] forwarded verbatim
+        tree = self.filter_trees.get(key)
+        if tree is None:
+            return
+        for clientid in list(tree.visit(op, val)):
+            cp = self.clients.get(clientid)
+            if cp is not None:
+                cp.send(MsgType.CALL_FILTERED_CLIENTS, payload)
+
+
+def run(gateid: int | None = None) -> int:
+    """Process entry point (gate.go:46-55)."""
+    import argparse
+
+    from goworld_tpu.config import get as get_config, set_config_file
+
+    parser = argparse.ArgumentParser(description="goworld_tpu gate process")
+    parser.add_argument("-gid", type=int, default=gateid or 1)
+    parser.add_argument("-configfile", type=str, default="")
+    parser.add_argument("-log", type=str, default="")
+    args, _ = parser.parse_known_args()
+    if args.configfile:
+        set_config_file(args.configfile)
+    cfg = get_config()
+    gate_cfg = cfg.gates.get(args.gid)
+    gwlog.setup(
+        level=(args.log or (gate_cfg.log_level if gate_cfg else "info")),
+        logfile=(gate_cfg.log_file if gate_cfg else None) or None,
+    )
+    gwlog.set_source(f"gate{args.gid}")
+    svc = GateService(args.gid, cfg)
+
+    async def main() -> int:
+        import signal
+
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, svc.terminate)
+        except (NotImplementedError, RuntimeError):
+            pass
+        return await svc.run_async()
+
+    return asyncio.run(main())

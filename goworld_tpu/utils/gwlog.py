@@ -95,10 +95,14 @@ def errorf(fmt: str, *args) -> None:
 
 
 def trace_error(fmt: str, *args) -> None:
-    """Error + current stack, like gwlog.TraceError (gwlog.go)."""
+    """Error + stack, like gwlog.TraceError (gwlog.go). Inside an ``except``
+    block the active exception traceback is logged; otherwise the call stack."""
     _ensure()
     msg = fmt % args if args else fmt
-    _logger.error("%s\n%s", msg, "".join(traceback.format_stack()))
+    if sys.exc_info()[0] is not None:
+        _logger.error("%s\n%s", msg, traceback.format_exc())
+    else:
+        _logger.error("%s\n%s", msg, "".join(traceback.format_stack()))
 
 
 def panicf(fmt: str, *args) -> None:

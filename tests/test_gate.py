@@ -1,0 +1,311 @@
+"""Gate end-to-end: dispatcher + game + REAL gate + protocol bot clients over
+localhost TCP — the reference's localhost-cluster test approach (SURVEY.md
+§4.3, .travis.yml:22-34) scaled down to pytest.
+
+Covers the full §3.2/§3.3 call stacks: client connect → boot entity →
+client RPC → AOI create-on-neighbor-clients → position sync fan-out →
+filtered broadcast → disconnect detach.
+"""
+
+import asyncio
+
+import pytest
+
+from goworld_tpu.client import ClientBot
+from goworld_tpu.config.read_config import (
+    DeploymentConfig,
+    DispatcherConfig,
+    GameConfig,
+    GateConfig,
+    GoWorldConfig,
+    KVDBConfig,
+    StorageConfig,
+)
+from goworld_tpu.dispatcher import DispatcherService
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.game import GameService
+from goworld_tpu.gate import GateService
+from goworld_tpu.gate.filter_tree import FilterTree
+from goworld_tpu.proto.msgtypes import FilterOp
+from goworld_tpu.utils import post
+
+
+# --- filter tree unit coverage (FilterTree.go:12-102) ------------------------
+
+
+def test_filter_tree_ops():
+    t = FilterTree()
+    for val, cid in [("b", "c1"), ("b", "c2"), ("a", "c3"), ("c", "c4")]:
+        t.insert(val, cid)
+    assert sorted(t.visit(FilterOp.EQ, "b")) == ["c1", "c2"]
+    assert sorted(t.visit(FilterOp.NE, "b")) == ["c3", "c4"]
+    assert sorted(t.visit(FilterOp.LT, "b")) == ["c3"]
+    assert sorted(t.visit(FilterOp.LTE, "b")) == ["c1", "c2", "c3"]
+    assert sorted(t.visit(FilterOp.GT, "b")) == ["c4"]
+    assert sorted(t.visit(FilterOp.GTE, "b")) == ["c1", "c2", "c4"]
+    assert t.remove("b", "c1")
+    assert not t.remove("b", "c1")
+    assert sorted(t.visit(FilterOp.EQ, "b")) == ["c2"]
+
+
+# --- e2e stack ---------------------------------------------------------------
+
+
+class GAvatar(Entity):
+    """Boot entity for gate tests: AOI-visible avatar with mixed attrs."""
+
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True, 100.0)
+        desc.define_attr("name", "AllClients")
+        desc.define_attr("secret", "Client")
+
+    def on_client_connected(self):
+        self.attrs.set("name", "anon")
+        self.attrs.set("secret", "s3cret")
+        self.set_client_syncing(True)
+
+    def SetName_Client(self, name):
+        self.attrs.set("name", name)
+
+    def EnterArena_Client(self):
+        space = ArenaHolder.arena
+        if space is not None:
+            x = 10.0 * (len(space.entities) + 1)
+            self.enter_space(space.id, Vector3(x, 0.0, 50.0))
+
+    def SetChannel_Client(self, channel):
+        self.set_filter_prop("channel", channel)
+
+    def Shout_Client(self, channel, text):
+        self.call_filtered_clients("channel", "=", channel, "OnShout", text)
+
+    def Echo_Client(self, text):
+        self.call_client("OnEcho", text)
+
+
+class GSpace(Space):
+    def on_space_created(self):
+        if self.kind == 1:
+            self.enable_aoi(100.0)
+            ArenaHolder.arena = self
+
+
+class ArenaHolder:
+    arena = None
+
+
+@pytest.fixture
+def clean_entities(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    em.cleanup_for_tests()
+    ArenaHolder.arena = None
+    from goworld_tpu import kvdb, kvreg, storage
+
+    kvreg.clear_for_tests()
+    yield
+    storage.set_backend(None)
+    kvdb.set_backend(None)
+    em.cleanup_for_tests()
+    post.clear()
+
+
+def make_cfg(disp_port: int, tmp_path) -> GoWorldConfig:
+    cfg = GoWorldConfig()
+    cfg.deployment = DeploymentConfig(desired_games=1, desired_gates=1, desired_dispatchers=1)
+    cfg.dispatchers = {1: DispatcherConfig(port=disp_port)}
+    cfg.games = {1: GameConfig(boot_entity="GAvatar", save_interval=0.0,
+                               position_sync_interval=0.02)}
+    cfg.gates = {1: GateConfig(port=0, position_sync_interval=0.02,
+                               heartbeat_timeout=30.0)}
+    cfg.storage = StorageConfig(type="filesystem", directory=str(tmp_path / "es"))
+    cfg.kvdb = KVDBConfig(type="filesystem", directory=str(tmp_path / "kv"))
+    return cfg
+
+
+async def start_stack(tmp_path):
+    disp = DispatcherService(1, desired_games=1, desired_gates=1)
+    await disp.start()
+    cfg = make_cfg(disp.port, tmp_path)
+    em.register_space(GSpace)
+    em.register_entity(GAvatar)
+    game = GameService(1, cfg, restore=False)
+    game_task = asyncio.get_running_loop().create_task(game.run_async())
+    gate = GateService(1, cfg)
+    await gate.start()
+    for _ in range(500):
+        if game.deployment_ready:
+            break
+        await asyncio.sleep(0.01)
+    assert game.deployment_ready
+    # Arena space created by the game on readiness via user-style code.
+    em.create_space_locally(1)
+    assert ArenaHolder.arena is not None
+    return disp, game, game_task, gate
+
+
+async def stop_stack(disp, game, game_task, gate, bots=()):
+    for b in bots:
+        await b.close()
+    await gate.stop()
+    game.terminate()
+    await asyncio.wait_for(game_task, timeout=10)
+    await disp.stop()
+
+
+async def connect_bot(gate, name="bot", strict=True) -> ClientBot:
+    bot = ClientBot(name=name, strict=strict, heartbeat_interval=1.0)
+    await bot.connect("127.0.0.1", gate.port)
+    await bot.wait_player(timeout=10)
+    return bot
+
+
+async def wait_for(cond, timeout=10.0):
+    for _ in range(int(timeout / 0.01)):
+        if cond():
+            return True
+        await asyncio.sleep(0.01)
+    return cond()
+
+
+def test_boot_rpc_and_attrs(clean_entities, tmp_path):
+    async def run():
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        bot = await connect_bot(gate)
+        player = bot.player
+        assert player.typename == "GAvatar"
+        # Own client sees both Client and AllClients attrs.
+        assert await wait_for(lambda: player.attrs.get("secret") == "s3cret")
+        assert player.attrs.get("name") == "anon"
+        # Client→server RPC → attr change streams back.
+        player.call_server("SetName_Client", "alice")
+        assert await wait_for(lambda: player.attrs.get("name") == "alice")
+        # Server→own-client RPC.
+        echoes = []
+        bot.rpc_handlers[(None, "OnEcho")] = lambda e, text: echoes.append(text)
+        player.call_server("Echo_Client", "hello")
+        assert await wait_for(lambda: echoes == ["hello"])
+        await stop_stack(disp, game, game_task, gate, [bot])
+
+    asyncio.run(run())
+
+
+def test_aoi_neighbors_and_position_sync(clean_entities, tmp_path):
+    async def run():
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        bot1 = await connect_bot(gate, "bot1")
+        bot2 = await connect_bot(gate, "bot2")
+        bot1.player.call_server("EnterArena_Client")
+        bot2.player.call_server("EnterArena_Client")
+        # Each bot sees the other's avatar appear via AOI (enter distance 100;
+        # spawn xs are 10 and 20).
+        assert await wait_for(lambda: len(bot1.entities_of_type("GAvatar")) == 2)
+        assert await wait_for(lambda: len(bot2.entities_of_type("GAvatar")) == 2)
+        other_on_1 = next(e for e in bot1.entities_of_type("GAvatar") if not e.is_player)
+        assert other_on_1.id == bot2.player.id
+        # Neighbor mirror shows AllClients attrs but NOT Client-only attrs.
+        assert other_on_1.attrs.get("name") == "anon"
+        assert "secret" not in other_on_1.attrs
+        # Client-authoritative movement propagates: bot2 moves, bot1 sees it.
+        bot2.player.sync_position(25.0, 0.0, 55.0, 1.5)
+        assert await wait_for(lambda: abs(other_on_1.x - 25.0) < 1e-3)
+        assert abs(other_on_1.yaw - 1.5) < 1e-3
+        # Server-side entity adopted the client position.
+        e2 = em.get_entity(bot2.player.id)
+        assert abs(e2.position.x - 25.0) < 1e-3
+        # bot2 walks out of AOI range → bot1 gets a destroy.
+        bot2.player.sync_position(500.0, 0.0, 55.0, 0.0)
+        assert await wait_for(lambda: len(bot1.entities_of_type("GAvatar")) == 1)
+        await stop_stack(disp, game, game_task, gate, [bot1, bot2])
+
+    asyncio.run(run())
+
+
+def test_filtered_broadcast(clean_entities, tmp_path):
+    async def run():
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        bots = [await connect_bot(gate, f"bot{i}") for i in range(3)]
+        shouts = {i: [] for i in range(3)}
+        for i, b in enumerate(bots):
+            b.rpc_handlers[(None, "OnShout")] = (
+                lambda e, text, i=i: shouts[i].append(text)
+            )
+        bots[0].player.call_server("SetChannel_Client", "world")
+        bots[1].player.call_server("SetChannel_Client", "world")
+        bots[2].player.call_server("SetChannel_Client", "prof")
+        # Wait for filter props to land in the gate's trees.
+        assert await wait_for(lambda: len(gate.filter_trees.get("channel", ())) == 3)
+        bots[0].player.call_server("Shout_Client", "world", "hi world")
+        assert await wait_for(lambda: shouts[0] == ["hi world"] and shouts[1] == ["hi world"])
+        await asyncio.sleep(0.1)
+        assert shouts[2] == []
+        await stop_stack(disp, game, game_task, gate, bots)
+
+    asyncio.run(run())
+
+
+def test_client_disconnect_detaches_entity(clean_entities, tmp_path):
+    async def run():
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        bot = await connect_bot(gate)
+        eid = bot.player.id
+        await bot.close()
+        assert await wait_for(
+            lambda: em.get_entity(eid) is not None and em.get_entity(eid).client is None
+        )
+        assert await wait_for(lambda: len(gate.clients) == 0)
+        await stop_stack(disp, game, game_task, gate)
+
+    asyncio.run(run())
+
+
+def test_heartbeat_timeout_kills_client(clean_entities, tmp_path):
+    async def run():
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        gate.gate_cfg.heartbeat_timeout = 0.3
+        bot = ClientBot(name="dead", strict=False, heartbeat_interval=999.0)
+        await bot.connect("127.0.0.1", gate.port)
+        await bot.wait_player(timeout=10)
+        assert await wait_for(lambda: len(gate.clients) == 0, timeout=5.0)
+        await stop_stack(disp, game, game_task, gate, [bot])
+
+    asyncio.run(run())
+
+
+def test_gate_tls(clean_entities, tmp_path):
+    async def run():
+        # Self-signed cert for localhost (the reference ships rsa.key/rsa.crt).
+        import subprocess
+
+        key, crt = str(tmp_path / "k.pem"), str(tmp_path / "c.pem")
+        subprocess.run(
+            ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+             "-keyout", key, "-out", crt, "-days", "1", "-subj", "/CN=localhost"],
+            check=True, capture_output=True,
+        )
+        disp = DispatcherService(1, desired_games=1, desired_gates=1)
+        await disp.start()
+        cfg = make_cfg(disp.port, tmp_path)
+        cfg.gates[1].encrypt_connection = True
+        cfg.gates[1].rsa_key = key
+        cfg.gates[1].rsa_cert = crt
+        em.register_space(GSpace)
+        em.register_entity(GAvatar)
+        game = GameService(1, cfg, restore=False)
+        game_task = asyncio.get_running_loop().create_task(game.run_async())
+        gate = GateService(1, cfg)
+        await gate.start()
+        for _ in range(500):
+            if game.deployment_ready:
+                break
+            await asyncio.sleep(0.01)
+        bot = ClientBot(name="tlsbot", strict=True, tls=True)
+        await bot.connect("127.0.0.1", gate.port)
+        player = await bot.wait_player(timeout=10)
+        assert player.typename == "GAvatar"
+        await stop_stack(disp, game, game_task, gate, [bot])
+
+    asyncio.run(run())
