@@ -16,4 +16,19 @@ The public facade mirrors the reference's ``goworld.go`` (goworld.go:17-256).
 
 __version__ = "0.1.0"
 
-from goworld_tpu.facade import *  # noqa: F401,F403
+
+def __getattr__(name: str):
+    # Delegate to the lazy facade (goworld.go-style API) without importing
+    # any subsystem eagerly. importlib (not ``from goworld_tpu import``) —
+    # attribute access on the partially-initialized package would recurse.
+    import importlib
+
+    facade = importlib.import_module("goworld_tpu.facade")
+    return getattr(facade, name)
+
+
+def __dir__():
+    import importlib
+
+    facade = importlib.import_module("goworld_tpu.facade")
+    return sorted(set(globals()) | set(facade.__all__))

@@ -7,4 +7,50 @@ dispatcher chosen by EntityID hash, which gives per-entity FIFO ordering.
 
 from goworld_tpu.dispatcher.service import DispatcherService
 
-__all__ = ["DispatcherService"]
+__all__ = ["DispatcherService", "run"]
+
+
+def run(dispid: int | None = None) -> int:
+    """Process entry point (dispatcher.go:32-74)."""
+    import argparse
+    import asyncio
+
+    from goworld_tpu.config import get as get_config, set_config_file
+    from goworld_tpu.utils import gwlog
+
+    parser = argparse.ArgumentParser(description="goworld_tpu dispatcher process")
+    parser.add_argument("-dispid", type=int, default=dispid or 1)
+    parser.add_argument("-configfile", type=str, default="")
+    parser.add_argument("-log", type=str, default="")
+    args, _ = parser.parse_known_args()
+    if args.configfile:
+        set_config_file(args.configfile)
+    cfg = get_config()
+    disp_cfg = cfg.dispatchers.get(args.dispid)
+    gwlog.setup(
+        level=(args.log or (disp_cfg.log_level if disp_cfg else "info")),
+        logfile=(disp_cfg.log_file if disp_cfg else None) or None,
+    )
+    gwlog.set_source(f"dispatcher{args.dispid}")
+
+    async def main() -> int:
+        import signal
+
+        svc = DispatcherService(
+            args.dispid,
+            desired_games=cfg.deployment.desired_games,
+            desired_gates=cfg.deployment.desired_gates,
+        )
+        host, port = (disp_cfg.host, disp_cfg.port) if disp_cfg else ("127.0.0.1", 0)
+        await svc.start(host, port)
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        try:
+            loop.add_signal_handler(signal.SIGTERM, stop.set)
+        except (NotImplementedError, RuntimeError):
+            pass
+        await stop.wait()
+        await svc.stop()
+        return 0
+
+    return asyncio.run(main())

@@ -4,8 +4,8 @@ Reference parity: ``goworld.go:17-256`` — the single module game developers
 import: Run, RegisterEntity/Space/Service, CreateSpace*/CreateEntity*/
 LoadEntity*, Call/CallService*/CallNilSpaces, KVDB helpers, timers, crontab.
 
-This module grows as subsystems land; symbols are re-exported lazily so that
-importing ``goworld_tpu`` never drags in networking or JAX until used.
+Symbols are re-exported lazily so that importing ``goworld_tpu`` never drags
+in networking or JAX until used.
 """
 
 from __future__ import annotations
@@ -17,16 +17,73 @@ from goworld_tpu.common import (  # noqa: F401
     gen_fixed_entity_id,
 )
 
+# goworld.go symbol → (module, attr). Names follow the reference's facade
+# (snake_cased); each maps to the subsystem that implements it.
+_LAZY: dict[str, tuple[str, str]] = {
+    # process entry points (goworld.Run → game.Run, goworld.go:34)
+    "run": ("goworld_tpu.game", "run"),
+    "run_gate": ("goworld_tpu.gate", "run"),
+    "run_dispatcher": ("goworld_tpu.dispatcher", "run"),
+    # types
+    "Entity": ("goworld_tpu.entity.entity", "Entity"),
+    "Space": ("goworld_tpu.entity.space", "Space"),
+    "Vector3": ("goworld_tpu.entity.vector", "Vector3"),
+    "GameClient": ("goworld_tpu.entity.game_client", "GameClient"),
+    # registration (goworld.go:44-76)
+    "register_entity": ("goworld_tpu.entity.entity_manager", "register_entity"),
+    "register_space": ("goworld_tpu.entity.entity_manager", "register_space"),
+    "register_service": ("goworld_tpu.service", "register_service"),
+    # entity / space creation (goworld.go:78-140)
+    "create_entity_locally": ("goworld_tpu.entity.entity_manager", "create_entity_locally"),
+    "create_entity_somewhere": ("goworld_tpu.entity.entity_manager", "create_entity_somewhere"),
+    "create_space_locally": ("goworld_tpu.entity.entity_manager", "create_space_locally"),
+    "create_space_somewhere": ("goworld_tpu.entity.entity_manager", "create_space_somewhere"),
+    "load_entity_locally": ("goworld_tpu.entity.entity_manager", "load_entity_locally"),
+    "load_entity_somewhere": ("goworld_tpu.entity.entity_manager", "load_entity_somewhere"),
+    "get_entity": ("goworld_tpu.entity.entity_manager", "get_entity"),
+    "get_space": ("goworld_tpu.entity.entity_manager", "get_space"),
+    "get_nil_space": ("goworld_tpu.entity.entity_manager", "get_nil_space"),
+    "get_nil_space_id": ("goworld_tpu.entity.entity_manager", "get_nil_space_id"),
+    "get_entities_by_type": ("goworld_tpu.entity.entity_manager", "get_entities_by_type"),
+    # RPC (goworld.go:142-178)
+    "call_entity": ("goworld_tpu.entity.entity_manager", "call_entity"),
+    "call_nil_spaces": ("goworld_tpu.entity.entity_manager", "call_nil_spaces"),
+    "call_service_any": ("goworld_tpu.service", "call_service_any"),
+    "call_service_all": ("goworld_tpu.service", "call_service_all"),
+    "call_service_shard_index": ("goworld_tpu.service", "call_service_shard_index"),
+    "call_service_shard_key": ("goworld_tpu.service", "call_service_shard_key"),
+    "get_service_entity_id": ("goworld_tpu.service", "get_service_entity_id"),
+    "get_service_shard_count": ("goworld_tpu.service", "get_service_shard_count"),
+    "check_service_entities_ready": ("goworld_tpu.service", "check_service_entities_ready"),
+    # kvdb (goworld.go:200-232)
+    "kvdb_get": ("goworld_tpu.kvdb", "get"),
+    "kvdb_put": ("goworld_tpu.kvdb", "put"),
+    "kvdb_get_or_put": ("goworld_tpu.kvdb", "get_or_put"),
+    "kvdb_get_range": ("goworld_tpu.kvdb", "get_range"),
+    # kvreg
+    "kvreg_register": ("goworld_tpu.kvreg", "register"),
+    "kvreg_get": ("goworld_tpu.kvreg", "get"),
+    # storage
+    "list_entity_ids": ("goworld_tpu.storage", "list_entity_ids"),
+    "entity_storage_exists": ("goworld_tpu.storage", "exists"),
+    # scheduling (goworld.go:236-256)
+    "post": ("goworld_tpu.utils.post", "post"),
+    "register_crontab": ("goworld_tpu.utils.crontab", "register"),
+    # config
+    "get_config": ("goworld_tpu.config", "get"),
+    "set_config_file": ("goworld_tpu.config", "set_config_file"),
+}
+
 __all__ = [
     "EntityID",
     "ClientID",
     "gen_entity_id",
     "gen_fixed_entity_id",
+    *_LAZY,
 ]
 
 
 def __getattr__(name: str):
-    # Lazy exports wired up as subsystems are implemented.
     if name in _LAZY:
         module, attr = _LAZY[name]
         import importlib
@@ -34,6 +91,3 @@ def __getattr__(name: str):
         mod = importlib.import_module(module)
         return getattr(mod, attr)
     raise AttributeError(f"module 'goworld_tpu' has no attribute {name!r}")
-
-
-_LAZY: dict[str, tuple[str, str]] = {}

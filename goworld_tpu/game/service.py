@@ -24,7 +24,7 @@ from goworld_tpu.entity.game_client import GameClient
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.proto.conn import unpack_sync_records
 from goworld_tpu.proto.msgtypes import MsgType
-from goworld_tpu.utils import async_jobs, gwlog, gwutils, post
+from goworld_tpu.utils import async_jobs, crontab, gwlog, gwutils, post
 
 # run states (GameService.go rsRunning/rsTerminating/rsFreezing...)
 RS_RUNNING = 0
@@ -93,6 +93,10 @@ class GameService:
         dispatchercluster.set_cluster(self.cluster)
         self.cluster.start()
 
+        from goworld_tpu import service as service_mod
+
+        service_mod.setup(self.gameid)  # service.go:78-81
+
         self._install_signal_handlers()
         lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
         gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
@@ -148,6 +152,7 @@ class GameService:
             rt.timer_service.tick()
             if rt.aoi_service is not None:
                 rt.aoi_service.tick()
+            crontab.check()
             post.tick()
             now = time.monotonic()
             if now - self._last_sync_collect >= self.position_sync_interval:
@@ -296,6 +301,9 @@ class GameService:
         self.deployment_ready = True
         gwlog.infof("game %d: deployment ready", self.gameid)
         entity_manager.on_game_ready()
+        from goworld_tpu import service as service_mod
+
+        service_mod.on_deployment_ready()
 
     # --- terminate (GameService.go:194-213) -----------------------------------
 

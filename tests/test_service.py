@@ -1,0 +1,111 @@
+"""Service entities: kvreg-driven shard registration, reconcile, call routing
+(reference engine/service/service.go via SURVEY.md §2.1).
+
+Single-game stack: the lone game claims every shard, creates the service
+entities, and publishes their ids; call_service_* then routes by shard.
+Multi-game registration racing is resolved by the dispatcher's first-write-
+wins kvreg semantics, covered in test_dispatcher/kvreg tests.
+"""
+
+import asyncio
+
+import pytest
+
+from goworld_tpu import service
+from goworld_tpu.dispatcher import DispatcherService
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.space import Space
+from goworld_tpu.game import GameService
+from goworld_tpu.utils import post
+from tests.test_game_service import make_cfg
+from tests.test_dispatcher import FakePeer, make_gate_cluster
+
+
+class MailService(Entity):
+    received = []
+
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.define_attr("box", "Persistent")
+
+    def Deliver(self, to, text):
+        MailService.received.append((self.id, to, text))
+
+
+class SSpace(Space):
+    pass
+
+
+@pytest.fixture
+def clean(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    em.cleanup_for_tests()
+    service.clear_for_tests()
+    MailService.received = []
+    from goworld_tpu import kvdb, kvreg, storage
+
+    kvreg.clear_for_tests()
+    yield
+    storage.set_backend(None)
+    kvdb.set_backend(None)
+    em.cleanup_for_tests()
+    service.clear_for_tests()
+    post.clear()
+
+
+async def wait_for(cond, timeout=10.0):
+    for _ in range(int(timeout / 0.01)):
+        if cond():
+            return True
+        await asyncio.sleep(0.01)
+    return cond()
+
+
+def test_service_shards_register_and_route(clean, tmp_path):
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=1)
+        await disp.start()
+        cfg = make_cfg(disp.port, tmp_path, boot="")
+        em.register_space(SSpace)
+        service.register_service(MailService, shard_count=3)
+        svc = GameService(1, cfg, restore=False)
+        task = asyncio.get_running_loop().create_task(svc.run_async())
+        gate_peer = FakePeer()
+        cg = make_gate_cluster(("127.0.0.1", disp.port), 1, cg_peer := gate_peer)
+        cg.start()
+        assert await wait_for(lambda: svc.deployment_ready)
+
+        # Reconcile: claim 3 shards → create 3 entities → publish EntityIDs.
+        assert await wait_for(
+            lambda: service.check_service_entities_ready("MailService"), timeout=15
+        )
+        assert len(em.get_entities_by_type("MailService")) == 3
+        assert service.get_service_shard_count("MailService") == 3
+
+        # Shard-key routing is deterministic.
+        service.call_service_shard_key("MailService", "alice", "Deliver", "alice", "hi")
+        idx = service.shard_by_key("alice", 3)
+        expect_eid = service.get_service_entity_id("MailService", idx)
+        assert await wait_for(lambda: MailService.received != [])
+        assert MailService.received[-1] == (expect_eid, "alice", "hi")
+
+        # call-all reaches every shard.
+        MailService.received = []
+        service.call_service_all("MailService", "Deliver", "bob", "yo")
+        assert await wait_for(lambda: len(MailService.received) == 3)
+        assert {r[0] for r in MailService.received} == set(
+            service.get_service_entity_id("MailService", i) for i in range(3)
+        )
+
+        # call-any reaches exactly one shard.
+        MailService.received = []
+        service.call_service_any("MailService", "Deliver", "eve", "one")
+        assert await wait_for(lambda: len(MailService.received) == 1)
+
+        svc.terminate()
+        await asyncio.wait_for(task, timeout=10)
+        await cg.stop()
+        await disp.stop()
+
+    asyncio.run(run())
