@@ -1,0 +1,1 @@
+"""Extensions built on the public facade (reference ``ext/``)."""

@@ -1,0 +1,85 @@
+"""Pubsub extension: exact + wildcard subscriptions, publish fan-out,
+unsubscribe-all, freeze/restore round trip (ext/pubsub parity)."""
+
+import pytest
+
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.ext.pubsub import PublishSubscribeService
+from goworld_tpu.utils import post
+
+
+class Listener(Entity):
+    log = []
+
+    def OnPublish(self, subject, content):
+        Listener.log.append((self.id, subject, content))
+
+
+@pytest.fixture
+def pss():
+    em.cleanup_for_tests()
+    Listener.log = []
+    em.register_entity(Listener)
+    em.register_entity(PublishSubscribeService)
+    svc = em.create_entity_locally("PublishSubscribeService")
+    yield svc
+    em.cleanup_for_tests()
+    post.clear()
+
+
+def test_exact_and_wildcard_publish(pss):
+    a = em.create_entity_locally("Listener")
+    b = em.create_entity_locally("Listener")
+    c = em.create_entity_locally("Listener")
+    pss.Subscribe(a.id, "apple.1")
+    pss.Subscribe(b.id, "apple.*")
+    pss.Subscribe(c.id, "banana")
+    pss.Publish("apple.1", "x")
+    got = {(eid, s) for eid, s, _ in Listener.log}
+    assert got == {(a.id, "apple.1"), (b.id, "apple.1")}
+    Listener.log = []
+    pss.Publish("apple.", "y")  # wildcard matches zero chars too
+    assert {eid for eid, _, _ in Listener.log} == {b.id}
+    Listener.log = []
+    pss.Publish("banana", "z")
+    assert {eid for eid, _, _ in Listener.log} == {c.id}
+
+
+def test_unsubscribe_and_unsubscribe_all(pss):
+    a = em.create_entity_locally("Listener")
+    pss.Subscribe(a.id, "t.1")
+    pss.Subscribe(a.id, "t.*")
+    pss.Unsubscribe(a.id, "t.1")
+    pss.Publish("t.1", "m")
+    assert len(Listener.log) == 1  # wildcard still live
+    Listener.log = []
+    pss.UnsubscribeAll(a.id)
+    pss.Publish("t.1", "m")
+    assert Listener.log == []
+
+
+def test_reject_bad_wildcard(pss):
+    a = em.create_entity_locally("Listener")
+    pss.Subscribe(a.id, "ba*na")  # '*' not at end → rejected
+    pss.Publish("bana", "m")
+    pss.Publish("ba", "m")
+    assert Listener.log == []
+
+
+def test_freeze_restore_round_trip(pss):
+    a = em.create_entity_locally("Listener")
+    b = em.create_entity_locally("Listener")
+    pss.Subscribe(a.id, "news.sports")
+    pss.Subscribe(b.id, "news.*")
+    pss.on_freeze()
+    # Simulate restore into a fresh service entity: copy the frozen attrs.
+    frozen = {
+        "subscribers": pss.attrs.get("subscribers").to_dict(),
+        "wildcardSubscribers": pss.attrs.get("wildcardSubscribers").to_dict(),
+    }
+    svc2 = em.create_entity_locally("PublishSubscribeService", attrs=frozen)
+    svc2.on_restored()
+    svc2.Publish("news.sports", "goal")
+    got = {eid for eid, _, _ in Listener.log}
+    assert got == {a.id, b.id}
