@@ -1,0 +1,12 @@
+"""Example game servers mirroring the reference's examples/ tree.
+
+Each subpackage is a complete server: it registers its entity types against
+the goworld_tpu facade and exposes ``main()`` (the reference's per-example
+``main()`` calling goworld.Run()).
+
+- ``test_game`` — full-feature test server (reference examples/test_game)
+- ``unity_demo`` — combat demo with monster AI (reference examples/unity_demo)
+- ``chatroom_demo`` — chat via filter props, no spaces (reference
+  examples/chatroom_demo)
+- ``nil_game`` — minimal empty game (reference examples/nil_game)
+"""

@@ -1,0 +1,5 @@
+"""Chatroom demo built without spaces (reference examples/chatroom_demo)."""
+
+from examples.chatroom_demo.server import main, register
+
+__all__ = ["main", "register"]

@@ -1,0 +1,5 @@
+"""Minimal empty game (reference examples/nil_game)."""
+
+from examples.nil_game.server import main, register
+
+__all__ = ["main", "register"]

@@ -1,0 +1,5 @@
+"""Full-feature test server (reference examples/test_game)."""
+
+from examples.test_game.server import main, register
+
+__all__ = ["main", "register"]

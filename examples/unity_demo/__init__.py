@@ -1,0 +1,5 @@
+"""Unity-facing combat demo (reference examples/unity_demo)."""
+
+from examples.unity_demo.server import main, register
+
+__all__ = ["main", "register"]

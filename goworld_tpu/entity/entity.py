@@ -475,6 +475,14 @@ class Entity:
 
     # --- position / movement (Entity.go:430-440,1189-1205) -----------------
 
+    def distance_to(self, other: "Entity") -> float:
+        """Distance to another entity (Entity.go DistanceTo)."""
+        return self.position.distance_to(other.position)
+
+    def face_to(self, other: "Entity") -> None:
+        """Turn to face another entity (Entity.go FaceTo)."""
+        self.set_yaw((other.position - self.position).dir_to_yaw())
+
     def set_position(self, pos: Vector3) -> None:
         self._set_position_yaw(pos, self.yaw)
 

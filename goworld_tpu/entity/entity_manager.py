@@ -46,6 +46,7 @@ class Runtime:
         self.aoi_service = None  # BatchAOIService, lazily created
         self.aoi_params = None  # NeighborParams override
         self.storage = None  # object with .save/.load/.exists (storage module)
+        self.game_service = None  # the running GameService, if any
 
     def post(self, cb) -> None:
         post_mod.post(cb)
@@ -227,6 +228,27 @@ def get_nil_space_id(gameid: int) -> str:
 
 def get_nil_space() -> Optional[Space]:
     return _spaces.get(get_nil_space_id(runtime.gameid))
+
+
+def get_game_id() -> int:
+    """This game process's id (goworld.GetGameID)."""
+    return runtime.gameid
+
+
+def get_online_games() -> set[int]:
+    """Ids of the games currently connected to the cluster
+    (goworld.GetOnlineGames, fed by NOTIFY_GAME_CONNECTED/DISCONNECTED).
+    Embedded/test runtimes without a GameService know only themselves."""
+    gs = runtime.game_service
+    games = {runtime.gameid}
+    if gs is not None:
+        games |= set(gs.online_games)
+    return games
+
+
+def now() -> float:
+    """Monotonic engine time (drives timers and service bookkeeping)."""
+    return runtime.now()
 
 
 def create_entity_somewhere(typename: str, attrs: dict | None = None, gameid: int = 0) -> str:

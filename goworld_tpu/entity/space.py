@@ -129,5 +129,9 @@ class Space(Entity):
     def get_entity_count(self) -> int:
         return len(self.entities)
 
+    def count_entities(self, typename: str) -> int:
+        """Number of entities of one type in this space (Space.go CountEntities)."""
+        return sum(1 for e in self.entities if e.typename == typename)
+
     def __repr__(self) -> str:
         return f"Space<{self.typename}|{self.id}|kind={self.kind}>"

@@ -74,10 +74,14 @@ class PublishSubscribeService(Entity):
             subs = self._exact.get(subject)
             if subs is not None:
                 subs.discard(subscriber)
+                if not subs:
+                    del self._exact[subject]
         for prefix in self._by_entity_wild.pop(subscriber, set()):
             subs = self._wildcard.get(prefix)
             if subs is not None:
                 subs.discard(subscriber)
+                if not subs:
+                    del self._wildcard[prefix]
 
     # --- internals -----------------------------------------------------------
 
@@ -104,9 +108,13 @@ class PublishSubscribeService(Entity):
         subs = table.get(subject)
         if subs is not None:
             subs.discard(eid)
+            if not subs:  # drop emptied subjects: subject churn must not leak
+                del table[subject]
         owned = index.get(eid)
         if owned is not None:
             owned.discard(subject)
+            if not owned:
+                del index[eid]
 
     # --- freeze / restore (PublishSubscribeService.go:221-264) ---------------
 
@@ -154,20 +162,27 @@ def publish(subject: str, content) -> None:
 
 
 def subscribe(subscriber_eid: str, subject: str) -> None:
-    """Shard by the raw subject string, as the reference's example code does
-    (test_game/Avatar.go:54). Note the reference-inherited caveat: with
-    shard_count > 1, a wildcard subscription "foo*" may hash to a different
-    shard than a published subject "foo1" — wildcard workloads should use
-    shard_count 1."""
+    """Exact subjects shard by the subject string (test_game/Avatar.go:54);
+    wildcard subscriptions fan out to EVERY shard so they match publishes of
+    any concrete subject regardless of which shard the publish hashes to.
+    (The reference inherits a miss here: "foo*" hashed to one shard can miss
+    "foo1" published to another; fanning out the rare wildcard subscribe
+    fixes that without changing publish-side routing.)"""
     from goworld_tpu import service
 
-    service.call_service_shard_key(SERVICE_NAME, subject, "Subscribe", subscriber_eid, subject)
+    if subject.endswith("*"):
+        service.call_service_all(SERVICE_NAME, "Subscribe", subscriber_eid, subject)
+    else:
+        service.call_service_shard_key(SERVICE_NAME, subject, "Subscribe", subscriber_eid, subject)
 
 
 def unsubscribe(subscriber_eid: str, subject: str) -> None:
     from goworld_tpu import service
 
-    service.call_service_shard_key(SERVICE_NAME, subject, "Unsubscribe", subscriber_eid, subject)
+    if subject.endswith("*"):
+        service.call_service_all(SERVICE_NAME, "Unsubscribe", subscriber_eid, subject)
+    else:
+        service.call_service_shard_key(SERVICE_NAME, subject, "Unsubscribe", subscriber_eid, subject)
 
 
 def unsubscribe_all(subscriber_eid: str) -> None:

@@ -45,6 +45,9 @@ _LAZY: dict[str, tuple[str, str]] = {
     "get_nil_space": ("goworld_tpu.entity.entity_manager", "get_nil_space"),
     "get_nil_space_id": ("goworld_tpu.entity.entity_manager", "get_nil_space_id"),
     "get_entities_by_type": ("goworld_tpu.entity.entity_manager", "get_entities_by_type"),
+    "get_game_id": ("goworld_tpu.entity.entity_manager", "get_game_id"),
+    "get_online_games": ("goworld_tpu.entity.entity_manager", "get_online_games"),
+    "now": ("goworld_tpu.entity.entity_manager", "now"),
     # RPC (goworld.go:142-178)
     "call_entity": ("goworld_tpu.entity.entity_manager", "call_entity"),
     "call_nil_spaces": ("goworld_tpu.entity.entity_manager", "call_nil_spaces"),

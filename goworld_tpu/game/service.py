@@ -69,6 +69,7 @@ class GameService:
         the CLI restarts freezed games with -restore)."""
         rt = entity_manager.runtime
         rt.gameid = self.gameid
+        rt.game_service = self
         game_cfg = self.cfg.games.get(self.gameid)
         if game_cfg is not None:
             rt.save_interval = game_cfg.save_interval

@@ -329,6 +329,12 @@ class GateService:
         key = packet.read_varstr()
         val = packet.read_varstr()
         payload = packet.read_rest()  # [method][args] forwarded verbatim
+        if key == "":
+            # Empty key = every client on this gate (GateService.go:378-384,
+            # the "world channel" broadcast).
+            for cp in list(self.clients.values()):
+                cp.send(MsgType.CALL_FILTERED_CLIENTS, payload)
+            return
         tree = self.filter_trees.get(key)
         if tree is None:
             return
