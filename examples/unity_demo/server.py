@@ -233,7 +233,7 @@ class Monster(Entity):
             return
         self.moving_to = None
         self.attacking = player
-        self.attrs.set("action", "move")
+        self.attrs.set("action", "attack")
 
     def _attack(self, player: Entity):
         self.call_all_clients("DisplayAttack", player.id)

@@ -342,6 +342,9 @@ class GameService:
     def _do_freeze(self) -> None:
         async_jobs.wait_clear()
         post.tick()
+        aoi = entity_manager.runtime.aoi_service
+        if aoi is not None:
+            aoi.flush()  # no in-flight AOI diffs may survive the freeze
         data = entity_manager.freeze_entities(self.gameid)
         path = freeze_filename(self.gameid)
         tmp = path + ".tmp"

@@ -291,11 +291,14 @@ def test_batched_aoi_equivalent_behavior():
     b = em.create_entity_locally("Avatar")
     sp._enter(a, Vector3(0, 0, 0))
     sp._enter(b, Vector3(50, 0, 0))
-    # batched: nothing until tick
+    # batched + pipelined: tick N dispatches, tick N+1 delivers (diffs are
+    # one tick late by design, batched.py docstring).
     assert a.enter_events == []
+    em.runtime.tick()
     em.runtime.tick()
     assert a.is_interested_in(b) and b.is_interested_in(a)
     b.set_position(Vector3(500, 0, 0))
+    em.runtime.tick()
     em.runtime.tick()
     assert not a.is_interested_in(b)
     assert a.leave_events == [b]
@@ -311,6 +314,7 @@ def test_batched_aoi_two_spaces_isolated():
     sp1._enter(a, Vector3(0, 0, 0))
     sp2._enter(b, Vector3(0, 0, 0))
     em.runtime.tick()
+    em.runtime.tick()
     assert not a.is_interested_in(b)
     assert not b.is_interested_in(a)
 
@@ -323,8 +327,10 @@ def test_batched_aoi_destroy_delivers_leaves():
     sp._enter(a, Vector3(0, 0, 0))
     sp._enter(b, Vector3(10, 0, 0))
     em.runtime.tick()
+    em.runtime.tick()
     assert a.is_interested_in(b)
     b.destroy()
+    em.runtime.tick()
     em.runtime.tick()
     assert a.leave_events == [b]
     assert not a.is_interested_in(b)
@@ -460,3 +466,36 @@ def test_collect_entity_sync_infos():
     assert buf[:16] == b"B" * 16
     # second collection is empty (flags cleared)
     assert em.collect_entity_sync_infos() == {}
+
+
+def test_batched_aoi_slot_reuse_no_aliasing():
+    """A destroyed entity's slot must not be recycled while its leave events
+    are still in the pipeline — a new entity allocated immediately after a
+    destroy must never be mis-attributed the old entity's diffs."""
+    _setup_batched()
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(10, 0, 0))
+    em.runtime.tick()
+    em.runtime.tick()
+    assert a.is_interested_in(b)
+
+    svc = em.runtime.aoi_service
+    free_before = len(svc._free)
+    b.destroy()
+    # Immediately create a replacement far away: it must get a DIFFERENT slot
+    # (b's is quarantined until its leave delivers).
+    c = em.create_entity_locally("Avatar")
+    sp._enter(c, Vector3(5000, 0, 0))
+    assert len(svc._free) == free_before - 1  # c took a fresh slot
+    em.runtime.tick()
+    em.runtime.tick()
+    # a saw exactly b leave; nothing about c.
+    assert a.leave_events == [b]
+    assert not a.is_interested_in(b)
+    assert not a.is_interested_in(c)
+    # After delivery, b's slot has been recycled back to the free list.
+    em.runtime.tick()
+    assert len(svc._free) >= free_before - 1

@@ -228,3 +228,27 @@ def test_determinism():
     a, _, _ = e1.step(pos, active, space, radius)
     b, _, _ = e2.step(pos, active, space, radius)
     assert np.array_equal(a, b)
+
+
+def test_step_async_pipeline_matches_sync():
+    """Depth-2 pipelining (dispatch t+1 before collecting t) must deliver the
+    exact same event stream as synchronous stepping."""
+    eng_sync, eng_pipe = engine(), engine()
+    rng = np.random.default_rng(3)
+    pos, active, space, radius = make_world(256, 220, seed=3)
+    vel = rng.normal(0, 30.0, pos.shape).astype(np.float32)
+
+    sync_stream, pipe_stream = [], []
+    pending = None
+    for t in range(8):
+        enters, leaves, _ = eng_sync.step(pos, active, space, radius)
+        sync_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
+        nxt = eng_pipe.step_async(pos, active, space, radius)
+        if pending is not None:
+            enters, leaves, _ = pending.collect()
+            pipe_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
+        pending = nxt
+        pos = pos + vel
+    enters, leaves, _ = pending.collect()
+    pipe_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
+    assert pipe_stream == sync_stream
