@@ -63,7 +63,7 @@ def main() -> None:
     # Warmup: compile + first-tick full enter storm (~1.9M paged events).
     eng.step(pos, active, space, radius)
 
-    steps = max(1, int(os.environ.get("BENCH_STEPS", "45")))
+    steps = max(2, int(os.environ.get("BENCH_STEPS", "45")))  # >=2: one collect in-loop
     events = 0
     lat = []
     pending = None

@@ -1,0 +1,5 @@
+"""``python -m examples.chatroom_demo`` — game process binary for this server."""
+
+from examples.chatroom_demo.server import main
+
+main()

@@ -1,0 +1,5 @@
+"""``python -m examples.nil_game`` — game process binary for this server."""
+
+from examples.nil_game.server import main
+
+main()

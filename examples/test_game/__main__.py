@@ -1,0 +1,5 @@
+"""``python -m examples.test_game`` — game process binary for this server."""
+
+from examples.test_game.server import main
+
+main()

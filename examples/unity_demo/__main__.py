@@ -1,0 +1,5 @@
+"""``python -m examples.unity_demo`` — game process binary for this server."""
+
+from examples.unity_demo.server import main
+
+main()

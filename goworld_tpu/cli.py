@@ -1,0 +1,322 @@
+"""Ops CLI: build | start | stop | kill | reload | status for a server dir.
+
+Reference parity: ``cmd/goworld`` (SURVEY.md §2.3) — ``build`` compiles the
+server (build.go:9-56; here: byte-compile), ``start`` spawns dispatchers →
+games → gates waiting for each group's supervisor tag in its log
+(start.go:17-126), ``stop`` SIGTERMs gates → games → dispatchers
+(stop.go:11-60), ``reload`` SIGHUP-freezes the games then restarts them with
+``-restore`` under the (possibly rebuilt) code (reload.go:10-33), ``status``
+reports which configured processes are alive (status.go:14-115).
+
+Process bookkeeping is pidfile-based (``<name>.pid`` in the run directory),
+verified against /proc cmdlines so stale pidfiles never kill innocents.
+
+Usage:
+    python -m goworld_tpu.cli start examples.test_game [-configfile goworld.ini]
+    python -m goworld_tpu.cli stop
+    python -m goworld_tpu.cli reload examples.test_game
+    python -m goworld_tpu.cli status
+"""
+
+from __future__ import annotations
+
+import argparse
+import compileall
+import importlib.util
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from goworld_tpu import consts
+from goworld_tpu.config import get as get_config, set_config_file
+
+START_TIMEOUT = 60.0  # per-process tag wait (start.go waits per process)
+STOP_TIMEOUT = 30.0
+FREEZE_TIMEOUT = 30.0  # consts.go FREEZE_TIMEOUT is 10s; allow slack
+
+
+# --- pidfile bookkeeping -----------------------------------------------------
+
+
+def _pidfile(run_dir: str, name: str) -> str:
+    return os.path.join(run_dir, f"{name}.pid")
+
+
+def _logfile(run_dir: str, name: str) -> str:
+    return os.path.join(run_dir, f"{name}.out.log")
+
+
+def _read_pid(run_dir: str, name: str) -> int | None:
+    try:
+        with open(_pidfile(run_dir, name)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _proc_cmdline(pid: int) -> str:
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode(errors="replace")
+    except OSError:
+        return ""
+
+
+def _alive(pid: int | None, expect: str) -> bool:
+    """Alive AND still the process we started (guards stale pidfile reuse)."""
+    if pid is None:
+        return False
+    cmdline = _proc_cmdline(pid)
+    if not cmdline:
+        return False  # dead (or unreadable) — never "matches"
+    # Without a module hint (stop/status without server_module), any python
+    # process from our pidfile counts; a PID reused by a non-python process
+    # does not.
+    return (expect or "python") in cmdline
+
+
+def _process_names(cfg) -> dict[str, list[str]]:
+    return {
+        "dispatcher": [f"dispatcher{i}" for i in sorted(cfg.dispatchers)],
+        "game": [f"game{i}" for i in sorted(cfg.games)],
+        "gate": [f"gate{i}" for i in sorted(cfg.gates)],
+    }
+
+
+def _expect_marker(kind: str, name: str, server_module: str | None) -> str:
+    """Substring of the child cmdline that identifies this process kind."""
+    if kind == "dispatcher":
+        return "goworld_tpu.dispatcher"
+    if kind == "gate":
+        return "goworld_tpu.gate"
+    return server_module or ""
+
+
+# --- spawn + tag wait --------------------------------------------------------
+
+
+def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
+    log_path = _logfile(run_dir, name)
+    logf = open(log_path, "ab")
+    logf.write(f"\n--- spawn {time.strftime('%F %T')}: {' '.join(argv)}\n".encode())
+    logf.flush()
+    proc = subprocess.Popen(
+        argv, stdout=logf, stderr=subprocess.STDOUT, cwd=run_dir,
+        start_new_session=True,  # survives the CLI exiting (daemon-ish)
+    )
+    logf.close()
+    with open(_pidfile(run_dir, name), "w") as f:
+        f.write(str(proc.pid))
+    _wait_tag(run_dir, name, tag, proc)
+
+
+def _wait_tag(run_dir: str, name: str, tag: str, proc=None) -> None:
+    """Scan the child's log for its supervisor tag (start.go:98-126)."""
+    log_path = _logfile(run_dir, name)
+    deadline = time.monotonic() + START_TIMEOUT
+    while time.monotonic() < deadline:
+        try:
+            with open(log_path, "rb") as f:
+                if tag.encode() in f.read():
+                    print(f"  {name}: started ok")
+                    return
+        except OSError:
+            pass
+        if proc is not None and proc.poll() is not None:
+            sys.exit(f"{name} exited with code {proc.returncode}; see {log_path}")
+        time.sleep(0.05)
+    sys.exit(f"timeout waiting for {name} start tag; see {log_path}")
+
+
+def _truncate_log(run_dir: str, name: str) -> None:
+    # Tags are matched by scanning the whole log; stale tags from a previous
+    # run must not satisfy the wait.
+    try:
+        os.truncate(_logfile(run_dir, name), 0)
+    except OSError:
+        pass
+
+
+# --- commands ----------------------------------------------------------------
+
+
+def cmd_build(args) -> int:
+    """Byte-compile the server module tree (parity with `goworld build`)."""
+    spec = importlib.util.find_spec(args.server_module)
+    if spec is None:
+        sys.exit(f"server module {args.server_module!r} not found")
+    targets = spec.submodule_search_locations or [os.path.dirname(spec.origin or "")]
+    ok = all(compileall.compile_dir(t, quiet=1) for t in targets)
+    print(f"build {'ok' if ok else 'FAILED'}: {list(targets)}")
+    return 0 if ok else 1
+
+
+def cmd_start(args) -> int:
+    cfg = get_config()
+    run_dir = os.path.abspath(args.dir)
+    names = _process_names(cfg)
+    configfile = os.path.abspath(args.configfile) if args.configfile else ""
+    cfg_argv = ["-configfile", configfile] if configfile else []
+
+    for name in [n for group in names.values() for n in group]:
+        _truncate_log(run_dir, name)
+
+    print(f"starting {len(names['dispatcher'])} dispatcher(s) ...")
+    for i, name in zip(sorted(cfg.dispatchers), names["dispatcher"]):
+        _spawn(run_dir, name,
+               [sys.executable, "-m", "goworld_tpu.dispatcher", "-dispid", str(i)] + cfg_argv,
+               consts.DISPATCHER_STARTED_TAG)
+    print(f"starting {len(names['game'])} game(s) [{args.server_module}] ...")
+    for i, name in zip(sorted(cfg.games), names["game"]):
+        argv = [sys.executable, "-m", args.server_module, "-gid", str(i)] + cfg_argv
+        if args.restore:
+            argv.append("-restore")
+        _spawn(run_dir, name, argv, consts.GAME_STARTED_TAG)
+    print(f"starting {len(names['gate'])} gate(s) ...")
+    for i, name in zip(sorted(cfg.gates), names["gate"]):
+        _spawn(run_dir, name,
+               [sys.executable, "-m", "goworld_tpu.gate", "-gid", str(i)] + cfg_argv,
+               consts.GATE_STARTED_TAG)
+    print("cluster started")
+    return 0
+
+
+def _stop_group(run_dir: str, kind: str, names: list[str], sig: int,
+                server_module: str | None) -> None:
+    expect = _expect_marker(kind, "", server_module)
+    pids = []
+    for name in names:
+        pid = _read_pid(run_dir, name)
+        if not _alive(pid, expect):
+            print(f"  {name}: not running")
+            continue
+        try:
+            os.kill(pid, sig)
+        except ProcessLookupError:
+            print(f"  {name}: already gone")
+            continue
+        pids.append((name, pid))
+    deadline = time.monotonic() + STOP_TIMEOUT
+    for name, pid in pids:
+        while _alive(pid, expect) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if _alive(pid, expect):
+            print(f"  {name}: did not exit; killing")
+            os.kill(pid, signal.SIGKILL)
+        else:
+            print(f"  {name}: stopped")
+        try:
+            os.unlink(_pidfile(run_dir, name))
+        except OSError:
+            pass
+
+
+def cmd_stop(args, sig: int = signal.SIGTERM) -> int:
+    cfg = get_config()
+    run_dir = os.path.abspath(args.dir)
+    names = _process_names(cfg)
+    # Reference order: gates first (detach clients), then games (save all
+    # entities), then dispatchers (stop.go:11-60).
+    print("stopping gates ...")
+    _stop_group(run_dir, "gate", names["gate"], sig, None)
+    print("stopping games ...")
+    _stop_group(run_dir, "game", names["game"], sig, getattr(args, "server_module", None))
+    print("stopping dispatchers ...")
+    _stop_group(run_dir, "dispatcher", names["dispatcher"], sig, None)
+    return 0
+
+
+def cmd_kill(args) -> int:
+    return cmd_stop(args, sig=signal.SIGKILL)
+
+
+def cmd_reload(args) -> int:
+    """Freeze games (SIGHUP) → wait for exit → restart with -restore.
+
+    Dispatchers buffer the frozen games' packets and gates keep their client
+    sockets, so clients ride through the swap (SURVEY.md §3.5).
+    """
+    cfg = get_config()
+    run_dir = os.path.abspath(args.dir)
+    names = _process_names(cfg)["game"]
+    expect = args.server_module
+    frozen = []
+    for i, name in zip(sorted(cfg.games), names):
+        pid = _read_pid(run_dir, name)
+        if not _alive(pid, expect):
+            print(f"  {name}: not running; skipping")
+            continue
+        try:
+            os.kill(pid, signal.SIGHUP)
+        except ProcessLookupError:
+            print(f"  {name}: already gone; skipping")
+            continue
+        frozen.append((name, pid, i))
+    for name, pid, _ in frozen:
+        deadline = time.monotonic() + FREEZE_TIMEOUT
+        while _alive(pid, expect) and time.monotonic() < deadline:
+            time.sleep(0.05)
+        if _alive(pid, expect):
+            sys.exit(f"{name} did not freeze within {FREEZE_TIMEOUT}s")
+        print(f"  {name}: freezed")
+    configfile = os.path.abspath(args.configfile) if args.configfile else ""
+    cfg_argv = ["-configfile", configfile] if configfile else []
+    for name, _, i in frozen:
+        _truncate_log(run_dir, name)
+        _spawn(run_dir, name,
+               [sys.executable, "-m", args.server_module, "-gid", str(i), "-restore"] + cfg_argv,
+               consts.GAME_STARTED_TAG)
+    print("reload complete")
+    return 0
+
+
+def cmd_status(args) -> int:
+    cfg = get_config()
+    run_dir = os.path.abspath(args.dir)
+    names = _process_names(cfg)
+    total = alive = 0
+    for kind, group in names.items():
+        for name in group:
+            total += 1
+            pid = _read_pid(run_dir, name)
+            up = _alive(pid, _expect_marker(kind, name, getattr(args, "server_module", None) or ""))
+            # Without a server module hint, any live pid from the pidfile
+            # whose cmdline mentions python counts for games.
+            if not up and kind == "game" and pid is not None:
+                up = "python" in _proc_cmdline(pid)
+            alive += bool(up)
+            print(f"  {name}: {'RUNNING pid=' + str(pid) if up else 'not running'}")
+    print(f"{alive}/{total} processes running")
+    return 0 if alive == total else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="goworld_tpu.cli",
+                                     description="goworld_tpu ops CLI (cmd/goworld parity)")
+    parser.add_argument("command",
+                        choices=["build", "start", "stop", "kill", "reload", "status"])
+    parser.add_argument("server_module", nargs="?", default=None,
+                        help="python module of the game server (e.g. examples.test_game)")
+    parser.add_argument("-configfile", default="goworld.ini" if os.path.exists("goworld.ini") else "")
+    parser.add_argument("-dir", default=".", help="run directory (pidfiles + logs)")
+    parser.add_argument("-restore", action="store_true", help="start games with -restore")
+    args = parser.parse_args(argv)
+
+    if args.configfile:
+        set_config_file(os.path.abspath(args.configfile))
+    if args.command in ("build", "start", "reload") and not args.server_module:
+        parser.error(f"{args.command} requires a server module")
+    return {
+        "build": cmd_build,
+        "start": cmd_start,
+        "stop": cmd_stop,
+        "kill": cmd_kill,
+        "reload": cmd_reload,
+        "status": cmd_status,
+    }[args.command](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

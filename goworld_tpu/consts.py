@@ -51,3 +51,11 @@ DEBUG_SPACES = False
 DEBUG_SAVE_LOAD = False
 DEBUG_CLIENTS = False
 DEBUG_MIGRATE = False
+
+# --- supervisor start tags (binutil consts.go:133-137) ----------------------
+# Printed once a process is serving; the CLI start command scans child logs
+# for these to sequence dispatchers -> games -> gates.
+DISPATCHER_STARTED_TAG = "SUPERVISOR: dispatcher started ok"
+GAME_STARTED_TAG = "SUPERVISOR: game started ok"
+GATE_STARTED_TAG = "SUPERVISOR: gate started ok"
+FREEZED_TAG = "SUPERVISOR: game freezed"

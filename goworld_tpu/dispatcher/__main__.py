@@ -1,0 +1,7 @@
+"""``python -m goworld_tpu.dispatcher`` — dispatcher process binary."""
+
+import sys
+
+from goworld_tpu.dispatcher import run
+
+sys.exit(run())

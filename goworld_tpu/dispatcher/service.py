@@ -128,6 +128,7 @@ class DispatcherService:
         self._tasks.append(asyncio.get_running_loop().create_task(self._logic_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(self._tick_loop()))
         gwlog.infof("dispatcher %d listening on %s:%d", self.dispid, host, self.port)
+        gwlog.infof(consts.DISPATCHER_STARTED_TAG)
 
     async def stop(self) -> None:
         for t in self._tasks:

@@ -439,12 +439,20 @@ class Entity:
                 other.client.call(self.id, method, args)
 
     def call_filtered_clients(self, key: str, op: str | FilterOp, val: str, method: str, *args) -> None:
-        """Broadcast to clients by gate-held filter props (Entity.go:1150-1170)."""
+        """Broadcast to clients by gate-held filter props (Entity.go:1150-1170).
+
+        Deviation from the reference: routed through exactly ONE dispatcher
+        (any dispatcher reaches every gate). The reference broadcasts to all
+        dispatchers AND each dispatcher re-broadcasts to all gates
+        (dispatchercluster.go:50-62 + DispatcherService.go:846-848), which
+        delivers D copies per client in a D-dispatcher deployment.
+        """
         ops = {"=": FilterOp.EQ, "!=": FilterOp.NE, "<": FilterOp.LT,
                "<=": FilterOp.LTE, ">": FilterOp.GT, ">=": FilterOp.GTE}
         fop = ops[op] if isinstance(op, str) else op
-        for sender in dispatchercluster.select_all():
-            sender.send_call_filtered_client_proxies(fop, key, val, method, args)
+        dispatchercluster.select_by_entity_id(self.id).send_call_filtered_client_proxies(
+            fop, key, val, method, args
+        )
 
     def set_filter_prop(self, key: str, val: str) -> None:
         if self.client is not None:

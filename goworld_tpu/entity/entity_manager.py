@@ -442,6 +442,8 @@ def restore_entity(eid: str, data: dict, is_migrate: bool) -> Entity:
     _entities[e.id] = e
     if isinstance(e, Space):
         _spaces[e.id] = e
+    else:
+        e.space = get_nil_space()  # default membership, as in _new_entity
     gwutils.run_panicless(e.on_init)
     if isinstance(e, Space):
         e._maybe_restore_aoi()

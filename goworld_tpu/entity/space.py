@@ -94,6 +94,12 @@ class Space(Entity):
     # --- membership (Space.go:188-261) -------------------------------------
 
     def _enter(self, entity: Entity, pos: Vector3) -> None:
+        if self.is_nil():
+            # Entering the nil space is membership by pointer only: no
+            # hooks, no AOI, no entities set (Space.go:197-199).
+            entity.space = self
+            entity.position = pos
+            return
         entity.space = self
         entity.position = pos
         self.entities.add(entity)
@@ -105,10 +111,15 @@ class Space(Entity):
     def _leave(self, entity: Entity) -> None:
         if entity.space is not self:
             return
+        if self.is_nil():
+            return  # leaving the nil space does nothing (Space.go:233-236)
         if self.aoi_mgr is not None and entity.type_desc.use_aoi:
             self.aoi_mgr.leave(entity)
         self.entities.discard(entity)
-        entity.space = None
+        # Back to the default membership (Space.go:240 entity.Space = nilSpace).
+        from goworld_tpu.entity import entity_manager
+
+        entity.space = entity_manager.get_nil_space()
         gwutils.run_panicless(lambda: entity.on_leave_space(self))
         gwutils.run_panicless(lambda: self.on_entity_leave_space(entity))
 

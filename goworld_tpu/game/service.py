@@ -101,6 +101,7 @@ class GameService:
         self._install_signal_handlers()
         lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
         gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
+        gwlog.infof(consts.GAME_STARTED_TAG)
         try:
             await self._main_loop()
         finally:
@@ -340,11 +341,13 @@ class GameService:
             sender.send_start_freeze_game()
 
     def _do_freeze(self) -> None:
-        async_jobs.wait_clear()
-        post.tick()
+        # AOI flush first: its delivered callbacks may post work or queue
+        # storage saves, which the barriers below must then drain.
         aoi = entity_manager.runtime.aoi_service
         if aoi is not None:
             aoi.flush()  # no in-flight AOI diffs may survive the freeze
+        post.tick()
+        async_jobs.wait_clear()
         data = entity_manager.freeze_entities(self.gameid)
         path = freeze_filename(self.gameid)
         tmp = path + ".tmp"
@@ -353,6 +356,7 @@ class GameService:
         os.replace(tmp, path)
         gwlog.infof("game %d freezed to %s (%d spaces, %d entities)",
                     self.gameid, path, len(data["spaces"]), len(data["entities"]))
+        gwlog.infof(consts.FREEZED_TAG)
         self.run_state = RS_FREEZED
         self.exit_code = 2  # CLI restarts with -restore
 

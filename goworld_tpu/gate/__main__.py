@@ -1,0 +1,7 @@
+"""``python -m goworld_tpu.gate`` — gate process binary."""
+
+import sys
+
+from goworld_tpu.gate import run
+
+sys.exit(run())

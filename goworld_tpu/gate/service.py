@@ -110,6 +110,7 @@ class GateService:
         self._tasks.append(loop.create_task(self._tick_loop()))
         gwlog.infof("gate %d listening on %s:%d (tls=%s)",
                     self.gateid, self.gate_cfg.host, self.port, ssl_ctx is not None)
+        gwlog.infof(consts.GATE_STARTED_TAG)
 
     async def stop(self) -> None:
         for t in self._tasks:
