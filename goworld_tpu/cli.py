@@ -204,7 +204,10 @@ def _stop_group(run_dir: str, kind: str, names: list[str], sig: int,
             time.sleep(0.05)
         if _alive(pid, expect):
             print(f"  {name}: did not exit; killing")
-            os.kill(pid, signal.SIGKILL)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
         else:
             print(f"  {name}: stopped")
         try:
@@ -282,10 +285,6 @@ def cmd_status(args) -> int:
             total += 1
             pid = _read_pid(run_dir, name)
             up = _alive(pid, _expect_marker(kind, name, getattr(args, "server_module", None) or ""))
-            # Without a server module hint, any live pid from the pidfile
-            # whose cmdline mentions python counts for games.
-            if not up and kind == "game" and pid is not None:
-                up = "python" in _proc_cmdline(pid)
             alive += bool(up)
             print(f"  {name}: {'RUNNING pid=' + str(pid) if up else 'not running'}")
     print(f"{alive}/{total} processes running")

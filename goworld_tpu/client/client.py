@@ -106,11 +106,13 @@ class ClientBot:
         strict: bool = False,
         heartbeat_interval: float = 5.0,
         tls: bool = False,
+        compress: bool = False,
     ) -> None:
         self.name = name
         self.strict = strict
         self.heartbeat_interval = heartbeat_interval
         self.tls = tls
+        self.compress = compress
         self.conn: Optional[GoWorldConnection] = None
         self.entities: dict[str, ClientEntity] = {}
         self.player: Optional[ClientEntity] = None
@@ -132,7 +134,34 @@ class ClientBot:
             ssl_ctx.check_hostname = False
             ssl_ctx.verify_mode = ssl.CERT_NONE
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
-        self.conn = GoWorldConnection(PacketConnection(reader, writer))
+        pconn = PacketConnection(reader, writer)
+        if self.compress:
+            pconn.enable_compression()
+        self.conn = GoWorldConnection(pconn)
+        self._start_pumps()
+
+    async def connect_ws(self, host: str, port: int) -> None:
+        """Connect over WebSocket (reference bots pick -mode ws,
+        ClientBot.go transport selection)."""
+        import websockets
+
+        from goworld_tpu.netutil.ws_conn import WSPacketConnection
+
+        scheme = "wss" if self.tls else "ws"
+        ssl_ctx = None
+        if self.tls:
+            # Same relaxed context as the TCP path: the gate's cert is
+            # self-signed in dev/test deployments.
+            ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ssl_ctx.check_hostname = False
+            ssl_ctx.verify_mode = ssl.CERT_NONE
+        ws = await websockets.connect(
+            f"{scheme}://{host}:{port}/", max_size=None, ssl=ssl_ctx
+        )
+        self.conn = GoWorldConnection(WSPacketConnection(ws))
+        self._start_pumps()
+
+    def _start_pumps(self) -> None:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._recv_loop()))
         self._tasks.append(loop.create_task(self._heartbeat_loop()))

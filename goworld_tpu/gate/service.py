@@ -17,8 +17,14 @@ Reference parity: ``components/gate/GateService.go`` —
 - Heartbeat timeouts kill client proxies (:201-211); losing a dispatcher
   connection makes the gate exit on purpose (gate.go:138-143).
 
-TLS is supported natively via asyncio's ssl support (the reference wraps
-conns with crypto/tls, gate.go:97-118).
+Transports: TCP (+ optional TLS via asyncio ssl, mirroring the reference's
+crypto/tls wrap, gate.go:97-118), WebSocket when ``ws_addr`` is set
+(gate.go:92-95; netutil/ws_conn.py), and optional per-packet zlib
+compression (the reference uses snappy, ClientProxy.go:42-45 — snappy is
+not in this image). KCP (reliable UDP, GateService.go:134-165 via xtaci/
+kcp-go) is intentionally NOT implemented: no KCP library exists in this
+environment and a from-scratch ARQ stack is out of scope; TCP covers the
+reliability contract and the config rejects kcp-only deployments loudly.
 """
 
 from __future__ import annotations
@@ -83,6 +89,8 @@ class GateService:
         # client→server sync coalescing: dispatcher index → 32 B records
         self._pending_syncs: dict[int, bytearray] = {}
         self.port: int = 0
+        self._ws_server = None
+        self.ws_port: int = 0
         self.exit_code: Optional[int] = None
 
     # --- lifecycle (gate.go:57-101) ----------------------------------------
@@ -105,6 +113,7 @@ class GateService:
             self._serve_client, self.gate_cfg.host, self.gate_cfg.port, ssl=ssl_ctx
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        await self._start_ws_server(ssl_ctx)
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._logic_loop()))
         self._tasks.append(loop.create_task(self._tick_loop()))
@@ -124,6 +133,9 @@ class GateService:
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
+        if self._ws_server is not None:
+            self._ws_server.close()
+            await self._ws_server.wait_closed()
         for cp in list(self.clients.values()):
             cp.close()
         self.clients.clear()
@@ -154,7 +166,34 @@ class GateService:
     # --- client connections (GateService.go:125-199) ------------------------
 
     async def _serve_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        conn = GoWorldConnection(PacketConnection(reader, writer))
+        pconn = PacketConnection(reader, writer)
+        if self.gate_cfg.compress_connection:
+            pconn.enable_compression()
+        await self._pump_client(GoWorldConnection(pconn))
+
+    async def _start_ws_server(self, ssl_ctx) -> None:
+        """Serve WebSocket clients next to TCP when [gateN] ws_addr is set
+        (gate.go:92-95; transport adapter in netutil/ws_conn.py)."""
+        if not self.gate_cfg.ws_addr:
+            return
+        import websockets
+
+        from goworld_tpu.netutil.ws_conn import WSPacketConnection
+
+        host, _, port = self.gate_cfg.ws_addr.rpartition(":")
+
+        async def handler(ws):
+            await self._pump_client(GoWorldConnection(WSPacketConnection(ws)))
+
+        self._ws_server = await websockets.serve(
+            handler, host or "127.0.0.1", int(port), ssl=ssl_ctx, max_size=consts.MAX_PACKET_SIZE
+        )
+        self.ws_port = self._ws_server.sockets[0].getsockname()[1]
+        gwlog.infof("gate %d websocket listening on %s:%d", self.gateid,
+                    host or "127.0.0.1", self.ws_port)
+
+    async def _pump_client(self, conn: GoWorldConnection) -> None:
+        """Per-connection recv pump → single logic queue (any transport)."""
         cp = ClientProxy(conn)
         self._queue.put_nowait(("connect", cp, 0, None))
         try:

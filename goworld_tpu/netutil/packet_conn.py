@@ -6,17 +6,27 @@ message is [u32 LE payload length][payload]; payloads are capped at 25 MiB
 timer to batch small writes (GoWorldConnection.go:437-452); asyncio's
 transport write buffering plus an explicit ``flush_interval`` drain task
 provides the same batching.
+
+Optional per-packet compression (the reference wraps gate↔client conns in
+snappy, ClientProxy.go:42-45; snappy isn't in this image, so zlib): when
+enabled on both ends, payloads over a small threshold are deflated and the
+length prefix's high bit marks them (the bit the reference reserves,
+PAYLOAD_LEN_MASK).
 """
 
 from __future__ import annotations
 
 import asyncio
 import struct
+import zlib
 
 from goworld_tpu import consts
 from goworld_tpu.netutil.packet import Packet
 
 _LEN = struct.Struct("<I")
+
+_COMPRESSED_BIT = 0x80000000
+_COMPRESS_THRESHOLD = 256  # don't deflate tiny packets (heartbeats, syncs)
 
 
 class ConnectionClosed(Exception):
@@ -38,7 +48,13 @@ class PacketConnection:
         self._pending: list[bytes] = []
         self._flush_task: asyncio.Task | None = None
         self._closed = False
+        self._compress = False
         self.dropped = 0  # packets discarded because the conn was closed
+
+    def enable_compression(self) -> None:
+        """Turn on per-packet zlib for SENDS (recv always auto-detects via
+        the length-prefix flag bit, so enabling is one-sided safe)."""
+        self._compress = True
 
     @property
     def peername(self):
@@ -62,7 +78,14 @@ class PacketConnection:
         total = 2 + len(payload)
         if total > consts.MAX_PACKET_SIZE:
             raise ValueError(f"packet too large: {total}")
-        buf = _LEN.pack(total) + struct.pack("<H", msgtype) + payload
+        body = struct.pack("<H", msgtype) + payload
+        flag = 0
+        if self._compress and total >= _COMPRESS_THRESHOLD:
+            deflated = zlib.compress(body, 1)
+            if len(deflated) < len(body):
+                body = deflated
+                flag = _COMPRESSED_BIT
+        buf = _LEN.pack(len(body) | flag) + body
         self._pending.append(buf)
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_running_loop().create_task(
@@ -100,13 +123,27 @@ class PacketConnection:
             header = await self._reader.readexactly(4)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             raise ConnectionClosed("connection closed while reading length")
-        (length,) = _LEN.unpack(header)
+        (raw_len,) = _LEN.unpack(header)
+        compressed = bool(raw_len & _COMPRESSED_BIT)
+        length = raw_len & consts.PAYLOAD_LEN_MASK
         if length < 2 or length > consts.MAX_PACKET_SIZE:
             raise ConnectionClosed(f"bad packet length {length}")
         try:
             body = await self._reader.readexactly(length)
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             raise ConnectionClosed("connection closed while reading body")
+        if compressed:
+            # Bounded inflate: client-controlled data must not be able to
+            # balloon past the packet cap (decompression-bomb guard).
+            try:
+                d = zlib.decompressobj()
+                body = d.decompress(body, consts.MAX_PACKET_SIZE)
+                if d.unconsumed_tail or not d.eof:
+                    raise ConnectionClosed("compressed packet exceeds size cap")
+            except zlib.error as exc:
+                raise ConnectionClosed(f"bad compressed packet: {exc}")
+            if not 2 <= len(body) <= consts.MAX_PACKET_SIZE:
+                raise ConnectionClosed(f"bad decompressed length {len(body)}")
         msgtype = struct.unpack_from("<H", body, 0)[0]
         return msgtype, Packet(body[2:])
 

@@ -1,0 +1,102 @@
+"""WebSocket packet transport.
+
+Reference parity: the gate serves WebSocket clients next to TCP/KCP
+(gate.go:92-95 via golang.org/x/net/websocket; GateService.go:167-172).
+Python-native design: the ``websockets`` library carries one packet per
+binary message — WS frames preserve boundaries, so no length prefix is
+needed; the wire body is [u16 msgtype][payload], identical to the TCP
+framing minus the length word. Compression rides WS permessage-deflate
+(negotiated by the library) instead of the TCP path's explicit zlib flag.
+
+``WSPacketConnection`` presents the same surface as ``PacketConnection``
+so ``GoWorldConnection`` and the gate logic are transport-agnostic. Sends
+are serialized through one writer task per connection, mirroring how the
+TCP path's pending-buffer flush keeps per-connection FIFO order.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+from goworld_tpu import consts
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.packet_conn import ConnectionClosed
+
+
+class WSPacketConnection:
+    """PacketConnection-shaped adapter over a websockets protocol object."""
+
+    def __init__(self, ws) -> None:
+        self._ws = ws
+        self._closed = False
+        self._outq: asyncio.Queue = asyncio.Queue()
+        self._writer_task = asyncio.get_running_loop().create_task(self._writer())
+        self.dropped = 0
+
+    @property
+    def peername(self):
+        try:
+            return self._ws.remote_address
+        except Exception:
+            return None
+
+    def enable_compression(self) -> None:
+        pass  # permessage-deflate is negotiated at the WS handshake
+
+    # --- send --------------------------------------------------------------
+
+    def send_packet(self, msgtype: int, packet: Packet) -> None:
+        if self._closed:
+            self.dropped += 1
+            return
+        body = struct.pack("<H", msgtype) + packet.payload
+        if len(body) > consts.MAX_PACKET_SIZE:
+            raise ValueError(f"packet too large: {len(body)}")
+        self._outq.put_nowait(body)
+
+    async def _writer(self) -> None:
+        """Single writer → per-connection FIFO send order."""
+        try:
+            while True:
+                body = await self._outq.get()
+                await self._ws.send(body)
+        except asyncio.CancelledError:
+            pass
+        except Exception:
+            self._closed = True
+
+    def flush(self) -> None:
+        pass  # the writer task drains continuously
+
+    async def drain(self) -> None:
+        pass  # the writer task drains continuously
+
+    # --- recv --------------------------------------------------------------
+
+    async def recv_packet(self) -> tuple[int, Packet]:
+        try:
+            msg = await self._ws.recv()
+        except Exception:
+            raise ConnectionClosed("websocket closed")
+        if isinstance(msg, str):
+            msg = msg.encode()
+        if len(msg) < 2 or len(msg) > consts.MAX_PACKET_SIZE:
+            raise ConnectionClosed(f"bad ws packet length {len(msg)}")
+        msgtype = struct.unpack_from("<H", msg, 0)[0]
+        return msgtype, Packet(bytes(msg[2:]))
+
+    # --- close -------------------------------------------------------------
+
+    def close(self) -> None:
+        self._closed = True
+        self._writer_task.cancel()
+        try:
+            task = asyncio.get_running_loop().create_task(self._ws.close())
+            task.add_done_callback(lambda t: t.exception())
+        except RuntimeError:
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed

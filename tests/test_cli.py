@@ -66,7 +66,7 @@ def run_dir(tmp_path):
     with open(os.path.join(d, "goworld.ini"), "w") as f:
         f.write(INI.format(dir=d, **ports))
     yield d, ports["gate_port"]
-    cli(d, "kill", "examples.nil_game")
+    cli(d, "kill", "examples.test_game")
 
 
 async def _login_bot(gate_port: int):

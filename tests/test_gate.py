@@ -309,3 +309,73 @@ def test_gate_tls(clean_entities, tmp_path):
         await stop_stack(disp, game, game_task, gate, [bot])
 
     asyncio.run(run())
+
+
+def test_websocket_transport(clean_entities, tmp_path):
+    """WS client next to TCP: boot flow, RPC both ways, attr streaming
+    (gate.go:92-95 WS serving; transport adapter netutil/ws_conn.py)."""
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=1)
+        await disp.start()
+        cfg = make_cfg(disp.port, tmp_path)
+        cfg.gates[1].ws_addr = "127.0.0.1:0"
+        em.register_space(GSpace)
+        em.register_entity(GAvatar)
+        game = GameService(1, cfg, restore=False)
+        game_task = asyncio.get_running_loop().create_task(game.run_async())
+        gate = GateService(1, cfg)
+        await gate.start()
+        for _ in range(500):
+            if game.deployment_ready:
+                break
+            await asyncio.sleep(0.01)
+        assert game.deployment_ready
+        assert gate.ws_port
+
+        bot = ClientBot(name="wsbot", strict=True, heartbeat_interval=1.0)
+        await bot.connect_ws("127.0.0.1", gate.ws_port)
+        player = await bot.wait_player(timeout=10)
+        assert player.typename == "GAvatar"
+        assert await wait_for(lambda: player.attrs.get("secret") == "s3cret")
+        player.call_server("SetName_Client", "ws-alice")
+        assert await wait_for(lambda: player.attrs.get("name") == "ws-alice")
+        echoes = []
+        bot.rpc_handlers[(None, "OnEcho")] = lambda e, text: echoes.append(text)
+        player.call_server("Echo_Client", "over websocket")
+        assert await wait_for(lambda: echoes == ["over websocket"])
+        await stop_stack(disp, game, game_task, gate, [bot])
+
+    asyncio.run(run())
+
+
+def test_compressed_client_connection(clean_entities, tmp_path):
+    """Gate↔client zlib compression (reference: optional snappy,
+    ClientProxy.go:42-45). Both ends enabled; large payloads round-trip."""
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=1)
+        await disp.start()
+        cfg = make_cfg(disp.port, tmp_path)
+        cfg.gates[1].compress_connection = True
+        em.register_space(GSpace)
+        em.register_entity(GAvatar)
+        game = GameService(1, cfg, restore=False)
+        game_task = asyncio.get_running_loop().create_task(game.run_async())
+        gate = GateService(1, cfg)
+        await gate.start()
+        for _ in range(500):
+            if game.deployment_ready:
+                break
+            await asyncio.sleep(0.01)
+
+        bot = ClientBot(name="zbot", strict=True, heartbeat_interval=1.0,
+                        compress=True)
+        await bot.connect("127.0.0.1", gate.port)
+        player = await bot.wait_player(timeout=10)
+        echoes = []
+        bot.rpc_handlers[(None, "OnEcho")] = lambda e, text: echoes.append(text)
+        big = "compressible " * 2000  # well over the 256 B threshold
+        player.call_server("Echo_Client", big)
+        assert await wait_for(lambda: echoes == [big])
+        await stop_stack(disp, game, game_task, gate, [bot])
+
+    asyncio.run(run())

@@ -130,3 +130,43 @@ async def _oversized():
 
 def test_oversized_packet_rejected():
     asyncio.run(_oversized())
+
+
+def test_compressed_framing_roundtrip():
+    """zlib per-packet compression: flag bit set for big payloads, skipped
+    for small ones, and a non-compressing receiver still decodes both
+    (one-sided enable is safe; PAYLOAD_LEN_MASK high bit)."""
+
+    async def run():
+        got = []
+        done = asyncio.Event()
+
+        async def handler(reader, writer):
+            conn = PacketConnection(reader, writer, flush_interval=0)  # plain
+            while True:
+                try:
+                    msgtype, pkt = await conn.recv_packet()
+                except ConnectionClosed:
+                    break
+                got.append(pkt.payload)
+                if len(got) == 2:
+                    done.set()
+
+        server = await serve_tcp_forever("127.0.0.1", 0, handler)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await connect_tcp("127.0.0.1", port)
+        conn = PacketConnection(reader, writer, flush_interval=0)
+        conn.enable_compression()
+        small = b"tiny"
+        big = b"abcd" * 5000
+        conn.send_packet(1, Packet(small))
+        conn.send_packet(2, Packet(big))
+        await conn.drain()
+        await asyncio.wait_for(done.wait(), timeout=5)
+        conn.close()
+        server.close()
+        await server.wait_closed()
+        return got
+
+    got = asyncio.run(run())
+    assert got == [b"tiny", b"abcd" * 5000]
