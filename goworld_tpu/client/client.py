@@ -155,8 +155,10 @@ class ClientBot:
             ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
             ssl_ctx.check_hostname = False
             ssl_ctx.verify_mode = ssl.CERT_NONE
+        from goworld_tpu import consts
+
         ws = await websockets.connect(
-            f"{scheme}://{host}:{port}/", max_size=None, ssl=ssl_ctx
+            f"{scheme}://{host}:{port}/", max_size=consts.MAX_PACKET_SIZE, ssl=ssl_ctx
         )
         self.conn = GoWorldConnection(WSPacketConnection(ws))
         self._start_pumps()

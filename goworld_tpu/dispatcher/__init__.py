@@ -43,6 +43,9 @@ def run(dispid: int | None = None) -> int:
         )
         host, port = (disp_cfg.host, disp_cfg.port) if disp_cfg else ("127.0.0.1", 0)
         await svc.start(host, port)
+        from goworld_tpu.utils.debug_http import setup_http_server
+
+        debug_srv = await setup_http_server(disp_cfg.http_addr if disp_cfg else "")
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         try:
@@ -50,6 +53,8 @@ def run(dispid: int | None = None) -> int:
         except (NotImplementedError, RuntimeError):
             pass
         await stop.wait()
+        if debug_srv is not None:
+            await debug_srv.stop()
         await svc.stop()
         return 0
 

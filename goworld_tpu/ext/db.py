@@ -1,0 +1,214 @@
+"""Async document-DB helpers for game code.
+
+Reference parity: ``ext/db/gwmongo`` + ``ext/db/gwredis`` — thin wrappers
+that run driver calls on a dedicated serial async job group and post
+callbacks back to the game loop (gwmongo.go:31-346, gwredis.go:16-44).
+
+This image ships neither pymongo nor redis, so the production-shaped
+implementation is :class:`DocDB` over sqlite (one table per collection,
+JSON documents, indexable id) — same call shape as gwmongo's DB: every
+method is fire-and-forget with ``callback(result, err)`` marshalled back to
+the main loop via the async job group. ``dial_mongo`` / ``dial_redis``
+detect their drivers and raise a clear error when absent (gated, not
+stubbed silently).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+from typing import Any, Callable, Optional
+
+from goworld_tpu.utils import async_jobs
+
+_ASYNC_JOB_GROUP = "_docdb"
+
+AsyncCallback = Optional[Callable[[Any, Optional[Exception]], None]]
+
+
+class DocDB:
+    """Sqlite-backed document store with gwmongo's async call shape."""
+
+    def __init__(self) -> None:
+        self._conn: sqlite3.Connection | None = None
+        self._path: str | None = None
+
+    # --- connection (gwmongo.go:31-70) --------------------------------------
+
+    def dial(self, path: str, callback: AsyncCallback = None) -> None:
+        def routine():
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            self._conn = sqlite3.connect(path, check_same_thread=False)
+            self._path = path
+            return self
+
+        self._submit(routine, callback)
+
+    def close(self, callback: AsyncCallback = None) -> None:
+        def routine():
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None
+
+        self._submit(routine, callback)
+
+    # --- internals ----------------------------------------------------------
+
+    def _submit(self, routine: Callable, callback: AsyncCallback) -> None:
+        async_jobs.append_job(_ASYNC_JOB_GROUP, routine, callback)
+
+    def _table(self, collection: str) -> str:
+        if not collection.replace("_", "").isalnum():
+            raise ValueError(f"bad collection name {collection!r}")
+        assert self._conn is not None, "not connected (dial first)"
+        self._conn.execute(
+            f"CREATE TABLE IF NOT EXISTS c_{collection} "
+            "(id TEXT PRIMARY KEY, doc TEXT NOT NULL)"
+        )
+        return f"c_{collection}"
+
+    @staticmethod
+    def _matches(doc: dict, query: dict) -> bool:
+        return all(doc.get(k) == v for k, v in query.items())
+
+    def _iter_docs(self, collection: str):
+        t = self._table(collection)
+        for rid, raw in self._conn.execute(f"SELECT id, doc FROM {t}"):
+            yield rid, json.loads(raw)
+
+    # --- queries (gwmongo.go:84-146) ----------------------------------------
+
+    def find_id(self, collection: str, doc_id: str, callback: AsyncCallback) -> None:
+        def routine():
+            t = self._table(collection)
+            row = self._conn.execute(
+                f"SELECT doc FROM {t} WHERE id=?", (doc_id,)
+            ).fetchone()
+            return json.loads(row[0]) if row else None
+
+        self._submit(routine, callback)
+
+    def find_one(self, collection: str, query: dict, callback: AsyncCallback) -> None:
+        def routine():
+            for rid, doc in self._iter_docs(collection):
+                if self._matches(doc, query):
+                    return {"_id": rid, **doc}
+            return None
+
+        self._submit(routine, callback)
+
+    def find_all(self, collection: str, query: dict, callback: AsyncCallback) -> None:
+        def routine():
+            return [{"_id": rid, **doc} for rid, doc in self._iter_docs(collection)
+                    if self._matches(doc, query)]
+
+        self._submit(routine, callback)
+
+    def count(self, collection: str, query: dict, callback: AsyncCallback) -> None:
+        def routine():
+            return sum(1 for _, doc in self._iter_docs(collection)
+                       if self._matches(doc, query))
+
+        self._submit(routine, callback)
+
+    # --- writes (gwmongo.go:148-283) ----------------------------------------
+
+    def insert(self, collection: str, doc_id: str, doc: dict,
+               callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            self._conn.execute(
+                f"INSERT INTO {t} (id, doc) VALUES (?, ?)", (doc_id, json.dumps(doc))
+            )
+            self._conn.commit()
+
+        self._submit(routine, callback)
+
+    def upsert_id(self, collection: str, doc_id: str, doc: dict,
+                  callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            self._conn.execute(
+                f"INSERT INTO {t} (id, doc) VALUES (?, ?) "
+                "ON CONFLICT(id) DO UPDATE SET doc=excluded.doc",
+                (doc_id, json.dumps(doc)),
+            )
+            self._conn.commit()
+
+        self._submit(routine, callback)
+
+    def update_id(self, collection: str, doc_id: str, fields: dict,
+                  callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            row = self._conn.execute(
+                f"SELECT doc FROM {t} WHERE id=?", (doc_id,)
+            ).fetchone()
+            if row is None:
+                raise KeyError(f"{collection}/{doc_id} not found")
+            doc = json.loads(row[0])
+            doc.update(fields)
+            self._conn.execute(
+                f"UPDATE {t} SET doc=? WHERE id=?", (json.dumps(doc), doc_id)
+            )
+            self._conn.commit()
+
+        self._submit(routine, callback)
+
+    def remove_id(self, collection: str, doc_id: str,
+                  callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            n = self._conn.execute(f"DELETE FROM {t} WHERE id=?", (doc_id,)).rowcount
+            self._conn.commit()
+            if n == 0:
+                raise KeyError(f"{collection}/{doc_id} not found")
+
+        self._submit(routine, callback)
+
+    def remove_all(self, collection: str, query: dict,
+                   callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            removed = 0
+            for rid, doc in list(self._iter_docs(collection)):
+                if self._matches(doc, query):
+                    self._conn.execute(f"DELETE FROM {t} WHERE id=?", (rid,))
+                    removed += 1
+            self._conn.commit()
+            return removed
+
+        self._submit(routine, callback)
+
+    def drop_collection(self, collection: str, callback: AsyncCallback = None) -> None:
+        def routine():
+            t = self._table(collection)
+            self._conn.execute(f"DROP TABLE {t}")
+            self._conn.commit()
+
+        self._submit(routine, callback)
+
+
+def dial_mongo(url: str, dbname: str, callback: AsyncCallback = None):
+    """Gated: requires pymongo (not shipped in this image)."""
+    try:
+        import pymongo  # noqa: F401
+    except ImportError as exc:
+        raise RuntimeError(
+            "gwmongo requires pymongo, which is not installed in this "
+            "environment; use goworld_tpu.ext.db.DocDB (sqlite) instead"
+        ) from exc
+    raise NotImplementedError("mongo backend pending a pymongo-equipped image")
+
+
+def dial_redis(url: str, callback: AsyncCallback = None):
+    """Gated: requires redis-py (not shipped in this image)."""
+    try:
+        import redis  # noqa: F401
+    except ImportError as exc:
+        raise RuntimeError(
+            "gwredis requires redis-py, which is not installed in this "
+            "environment; use goworld_tpu.ext.db.DocDB (sqlite) instead"
+        ) from exc
+    raise NotImplementedError("redis backend pending a redis-equipped image")

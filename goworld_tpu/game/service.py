@@ -99,13 +99,27 @@ class GameService:
         service_mod.setup(self.gameid)  # service.go:78-81
 
         self._install_signal_handlers()
-        lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
-        gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
-        gwlog.infof(consts.GAME_STARTED_TAG)
+        from goworld_tpu.utils import gwvar
+        from goworld_tpu.utils.debug_http import setup_http_server
+
+        lbc_task = None
+        debug_srv = None
         try:
+            # Debug HTTP server (binutil.SetupHTTPServer; game.go:107) + gwvar.
+            gwvar.set_var("IsDeploymentReady", lambda: self.deployment_ready)
+            gwvar.set_var("NumEntities", lambda: len(entity_manager.entities()))
+            debug_srv = await setup_http_server(game_cfg.http_addr if game_cfg else "")
+            lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
+            gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
+            gwlog.infof(consts.GAME_STARTED_TAG)
             await self._main_loop()
         finally:
-            lbc_task.cancel()
+            if lbc_task is not None:
+                lbc_task.cancel()
+            if debug_srv is not None:
+                await debug_srv.stop()
+            gwvar.unset("IsDeploymentReady")
+            gwvar.unset("NumEntities")
             await self.cluster.stop()
             dispatchercluster.set_cluster(None)
         return self.exit_code or 0

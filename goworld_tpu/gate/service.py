@@ -91,6 +91,7 @@ class GateService:
         self.port: int = 0
         self._ws_server = None
         self.ws_port: int = 0
+        self._debug_srv = None
         self.exit_code: Optional[int] = None
 
     # --- lifecycle (gate.go:57-101) ----------------------------------------
@@ -114,6 +115,11 @@ class GateService:
         )
         self.port = self._server.sockets[0].getsockname()[1]
         await self._start_ws_server(ssl_ctx)
+        from goworld_tpu.utils import gwvar
+        from goworld_tpu.utils.debug_http import setup_http_server
+
+        gwvar.set_var("NumClients", lambda: len(self.clients))
+        self._debug_srv = await setup_http_server(self.gate_cfg.http_addr)
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._logic_loop()))
         self._tasks.append(loop.create_task(self._tick_loop()))
@@ -136,6 +142,12 @@ class GateService:
         if self._ws_server is not None:
             self._ws_server.close()
             await self._ws_server.wait_closed()
+        if getattr(self, "_debug_srv", None) is not None:
+            await self._debug_srv.stop()
+            self._debug_srv = None
+        from goworld_tpu.utils import gwvar
+
+        gwvar.unset("NumClients")
         for cp in list(self.clients.values()):
             cp.close()
         self.clients.clear()

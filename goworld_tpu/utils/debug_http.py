@@ -1,0 +1,99 @@
+"""Per-process debug HTTP server.
+
+Reference parity: ``engine/binutil/binutil.go:26-47`` — every process embeds
+an always-on HTTP server (pprof + expvar) on the config ``http_addr``.
+Python-native design: a minimal asyncio HTTP/1.1 responder (no external web
+framework in this image) serving:
+
+- ``/healthz``   — 200 "ok" liveness probe
+- ``/vars``      — JSON snapshot of gwvar published variables (expvar parity)
+- ``/opmon``     — JSON dump of operation monitor stats (opmon.go:37-118)
+- ``/stack``     — all-thread stack dump (the practical subset of pprof)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+import traceback
+from typing import Optional
+
+from goworld_tpu.utils import gwlog, gwvar
+
+
+def _dump_stacks() -> str:
+    out = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ---")
+        out.extend(traceback.format_stack(frame))
+    return "\n".join(out)
+
+
+class DebugHTTPServer:
+    def __init__(self, host: str, port: int) -> None:
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._serve, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        gwlog.infof("debug http server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await asyncio.wait_for(reader.readline(), timeout=10)
+            parts = request.decode(errors="replace").split()
+            path = parts[1] if len(parts) >= 2 else "/"
+            # Drain headers.
+            while True:
+                line = await asyncio.wait_for(reader.readline(), timeout=10)
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            status, ctype, body = self._route(path.split("?")[0])
+            head = (
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
+            )
+            writer.write(head.encode() + body)
+            await writer.drain()
+        except (asyncio.TimeoutError, ConnectionResetError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _route(self, path: str) -> tuple[str, str, bytes]:
+        if path == "/healthz":
+            return "200 OK", "text/plain", b"ok"
+        if path == "/vars":
+            return ("200 OK", "application/json",
+                    json.dumps(gwvar.snapshot(), default=str).encode())
+        if path == "/opmon":
+            from goworld_tpu.utils import opmon
+
+            return ("200 OK", "application/json",
+                    json.dumps(opmon.dump(), default=str).encode())
+        if path == "/stack":
+            return "200 OK", "text/plain", _dump_stacks().encode()
+        return "404 Not Found", "text/plain", b"not found"
+
+
+async def setup_http_server(http_addr: str) -> Optional[DebugHTTPServer]:
+    """Start the debug server if ``http_addr`` ("host:port") is configured
+    (binutil.SetupHTTPServer; no-op when unset, like the reference)."""
+    if not http_addr:
+        return None
+    host, _, port = http_addr.rpartition(":")
+    srv = DebugHTTPServer(host or "127.0.0.1", int(port))
+    await srv.start()
+    return srv

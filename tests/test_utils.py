@@ -141,3 +141,110 @@ def test_async_jobs_error_callback():
     assert len(got) == 1
     assert got[0][0] is None
     assert isinstance(got[0][1], RuntimeError)
+
+
+def test_debug_http_server_endpoints():
+    """binutil/gwvar parity: /healthz, /vars (expvar), /opmon, /stack
+    (binutil.go:26-47, gwvar.go:5-29)."""
+    import asyncio
+    import json
+    import urllib.error
+    import urllib.request
+
+    from goworld_tpu.utils import gwvar
+    from goworld_tpu.utils.debug_http import DebugHTTPServer
+
+    async def run():
+        gwvar.set_var("IsDeploymentReady", True)
+        gwvar.set_var("NumEntities", lambda: 42)
+        srv = DebugHTTPServer("127.0.0.1", 0)
+        await srv.start()
+
+        def fetch(path):
+            with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}", timeout=5) as r:
+                return r.status, r.read()
+
+        status, body = await asyncio.to_thread(fetch, "/healthz")
+        assert (status, body) == (200, b"ok")
+        status, body = await asyncio.to_thread(fetch, "/vars")
+        data = json.loads(body)
+        assert data["IsDeploymentReady"] is True
+        assert data["NumEntities"] == 42
+        status, body = await asyncio.to_thread(fetch, "/opmon")
+        assert status == 200 and isinstance(json.loads(body), dict)
+        status, body = await asyncio.to_thread(fetch, "/stack")
+        assert status == 200 and b"thread" in body
+        try:
+            await asyncio.to_thread(fetch, "/nope")
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+        else:
+            raise AssertionError("404 expected")
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_ext_db_docdb_roundtrip(tmp_path):
+    """ext/db async document helpers (gwmongo call shape over sqlite)."""
+    import time as _time
+
+    from goworld_tpu.ext.db import DocDB
+    from goworld_tpu.utils import async_jobs, post
+
+    db = DocDB()
+    results = []
+
+    def cb(label):
+        return lambda res, err: results.append((label, res, err))
+
+    db.dial(str(tmp_path / "doc.db"), cb("dial"))
+    db.insert("avatars", "a1", {"name": "hero", "level": 3}, cb("insert"))
+    db.upsert_id("avatars", "a2", {"name": "mage", "level": 9}, cb("upsert"))
+    db.update_id("avatars", "a1", {"level": 4}, cb("update"))
+    db.find_id("avatars", "a1", cb("find_id"))
+    db.find_one("avatars", {"name": "mage"}, cb("find_one"))
+    db.find_all("avatars", {}, cb("find_all"))
+    db.count("avatars", {"level": 4}, cb("count"))
+    db.remove_id("avatars", "a2", cb("remove"))
+    db.count("avatars", {}, cb("count2"))
+
+    assert async_jobs.wait_clear(10.0)
+    for _ in range(100):
+        post.tick()
+        if len(results) == 10:
+            break
+        _time.sleep(0.01)
+    by = {label: (res, err) for label, res, err in results}
+    assert by["find_id"][0] == {"name": "hero", "level": 4}
+    assert by["find_one"][0]["name"] == "mage"
+    assert len(by["find_all"][0]) == 2
+    assert by["count"][0] == 1
+    assert by["count2"][0] == 1
+    assert all(err is None for _, err in by.values())
+
+
+def test_ext_db_errors_and_gates(tmp_path):
+    import time as _time
+
+    from goworld_tpu.ext.db import DocDB, dial_mongo, dial_redis
+    from goworld_tpu.utils import async_jobs, post
+
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="pymongo"):
+        dial_mongo("mongodb://x", "db")
+    with _pytest.raises(RuntimeError, match="redis"):
+        dial_redis("redis://x")
+
+    db = DocDB()
+    db.dial(str(tmp_path / "doc.db"))
+    errs = []
+    db.update_id("avatars", "missing", {"x": 1}, lambda res, err: errs.append(err))
+    assert async_jobs.wait_clear(10.0)
+    for _ in range(100):
+        post.tick()
+        if errs:
+            break
+        _time.sleep(0.01)
+    assert isinstance(errs[0], KeyError)
