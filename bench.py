@@ -23,6 +23,50 @@ import time
 import numpy as np
 
 
+def bench_boids() -> None:
+    """BENCH_MODE=boids: the fused Pallas flocking kernel (BASELINE config 4:
+    50k agents, AOI + steering in one launch, fully device-resident)."""
+    import jax
+
+    from goworld_tpu.ops.boids import BoidsEngine, BoidsParams
+
+    n = int(os.environ.get("BENCH_N", "51200"))
+    grid = max(8, int(round(64 * (n / 51200.0) ** 0.5 / 8)) * 8)
+    p = BoidsParams(capacity=n, cell_size=100.0, grid_x=grid, grid_z=grid)
+    eng = BoidsEngine(p)
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(0, [p.world_x, p.world_z], (n, 2)).astype(np.float32)
+    vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
+    active = np.ones(n, bool)
+
+    pos, vel, _ = eng.step(pos, vel, active)  # compile
+    jax.block_until_ready(pos)
+    steps = max(2, int(os.environ.get("BENCH_STEPS", "60")))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        # Device-resident chaining: no host copies between ticks.
+        pos, vel, _ = eng.step(pos, vel, active)
+    jax.block_until_ready(pos)
+    t_all = time.perf_counter() - t0
+    dropped = int(eng.last_dropped)
+    ticks_per_sec = steps / t_all
+    updates_per_sec = ticks_per_sec * n
+    baseline = 50_000 * 30  # 50k agents @ 30 Hz
+    print(
+        json.dumps(
+            {
+                "metric": "boids_agent_updates_per_sec_50k",
+                "value": round(updates_per_sec, 1),
+                "unit": "agent-updates/sec",
+                "vs_baseline": round(updates_per_sec / baseline, 3),
+                "agents": n,
+                "ticks_per_sec": round(ticks_per_sec, 2),
+                "cell_overflow_dropped": dropped,
+            }
+        )
+    )
+
+
 def main() -> None:
     if os.environ.get("BENCH_PLATFORM"):
         # The axon TPU plugin ignores JAX_PLATFORMS; force via jax.config
@@ -30,6 +74,9 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+    if os.environ.get("BENCH_MODE") == "boids":
+        bench_boids()
+        return
     from goworld_tpu.ops import NeighborEngine, NeighborParams
 
     n = int(os.environ.get("BENCH_N", "102400"))  # ~100k entities

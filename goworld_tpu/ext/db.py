@@ -33,6 +33,9 @@ class DocDB:
     def __init__(self) -> None:
         self._conn: sqlite3.Connection | None = None
         self._path: str | None = None
+        # Per-instance serial worker: one slow scan on this DB must not
+        # stall operations on an unrelated DocDB.
+        self._group = f"{_ASYNC_JOB_GROUP}:{id(self)}"
 
     # --- connection (gwmongo.go:31-70) --------------------------------------
 
@@ -56,12 +59,13 @@ class DocDB:
     # --- internals ----------------------------------------------------------
 
     def _submit(self, routine: Callable, callback: AsyncCallback) -> None:
-        async_jobs.append_job(_ASYNC_JOB_GROUP, routine, callback)
+        async_jobs.append_job(self._group, routine, callback)
 
     def _table(self, collection: str) -> str:
         if not collection.replace("_", "").isalnum():
             raise ValueError(f"bad collection name {collection!r}")
-        assert self._conn is not None, "not connected (dial first)"
+        if self._conn is None:
+            raise RuntimeError("not connected (dial first)")
         self._conn.execute(
             f"CREATE TABLE IF NOT EXISTS c_{collection} "
             "(id TEXT PRIMARY KEY, doc TEXT NOT NULL)"
