@@ -9,28 +9,218 @@ The measured loop is the production path of BatchAOIService.tick() with its
 pipelined delivery model (diffs land one tick late by design, batched.py):
 every tick dispatches position upload + jitted spatial-hash neighbor/diff
 step and collects the previous tick's packed event buffer — exactly ONE
-blocking device→host read per tick.
+blocking device→host read per tick. ``diff_latency_p99_ms`` is therefore the
+honest end-to-end number: dispatch of tick t → events of tick t on the host
+(one full tick of pipelining + the blocking fetch), measured directly.
+
+Robustness (this file must NEVER die rc!=0 — the driver records whatever the
+one JSON line says): the TPU backend is probed in a SUBPROCESS with a hard
+timeout, because a broken axon tunnel makes backend init hang forever rather
+than raise. Probe failure ⇒ retry with backoff ⇒ fall back to CPU with an
+``error`` field in the JSON so the run still yields diagnostics.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+
+Env knobs: BENCH_MODE=aoi|boids|multispace|all (default all),
+BENCH_PLATFORM=cpu forces CPU (skips probe), BENCH_N / BENCH_STEPS scale the
+headline config, BENCH_TPU_PROBE_TIMEOUT / BENCH_TPU_PROBE_ATTEMPTS tune the
+probe.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
+import traceback
 
 import numpy as np
 
+HEADLINE_BASELINE = 100_000 * 30  # 100k entities @ 30 Hz (BASELINE.md)
+P99_TARGET_MS = 5.0
 
-def bench_boids() -> None:
-    """BENCH_MODE=boids: the fused Pallas flocking kernel (BASELINE config 4:
-    50k agents, AOI + steering in one launch, fully device-resident)."""
+
+# --- backend resolution ------------------------------------------------------
+
+
+def _probe_tpu() -> tuple[bool, str]:
+    """Check in a subprocess whether the TPU backend initializes.
+
+    Round 1 failed here: `Unable to initialize backend 'axon'` in one env and
+    an indefinite HANG in another. A subprocess + kill is the only reliable
+    bound; in-process init can never be cancelled.
+    """
+    timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
+    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "3"))
+    code = (
+        "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform);"
+        "import jax.numpy as jnp;"
+        "x = jnp.ones((128, 128));"
+        "print('COMPUTE_OK', float((x @ x)[0, 0]))"
+    )
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the default (TPU) backend resolve
+    last_err = "unknown"
+    for attempt in range(attempts):
+        if attempt:
+            time.sleep(5.0 * attempt)
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c", code],
+                timeout=timeout,
+                capture_output=True,
+                text=True,
+                env=env,
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"backend init hang: no response in {timeout:.0f}s"
+            continue
+        out = r.stdout or ""
+        if r.returncode == 0 and "COMPUTE_OK" in out:
+            platform = "unknown"
+            for line in out.splitlines():
+                if line.startswith("PLATFORM="):
+                    platform = line.split("=", 1)[1].strip()
+            if platform == "cpu":
+                last_err = "default backend resolved to cpu (no TPU plugin)"
+                continue
+            return True, platform
+        tail = ((r.stderr or "") + out).strip().splitlines()
+        last_err = " | ".join(tail[-3:]) if tail else f"rc={r.returncode}"
+    return False, last_err
+
+
+def _resolve_platform(diag: dict) -> str:
+    """Decide tpu vs cpu; on cpu, force the platform before any jax import
+    (the axon plugin ignores JAX_PLATFORMS, so use jax.config)."""
+    forced = os.environ.get("BENCH_PLATFORM", "")
+    if forced == "cpu":
+        platform = "cpu"
+        diag["platform_forced"] = forced
+    elif forced:
+        platform = "tpu"  # caller asserts a chip; verified against the
+        diag["platform_forced"] = forced  # actual backend in main()
+        os.environ.pop("JAX_PLATFORMS", None)
+    else:
+        ok, info = _probe_tpu()
+        platform = "tpu" if ok else "cpu"
+        if ok:
+            diag["tpu_platform_name"] = info
+            # The probe ran with JAX_PLATFORMS stripped; strip it here too so
+            # the in-process run resolves to the same (TPU) backend.
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            diag["error"] = f"tpu_unavailable: {info}"
+    if platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return platform
+
+
+# --- configs -----------------------------------------------------------------
+
+
+def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
+              label: str = "aoi") -> dict:
+    """The production AOI loop (BatchAOIService path): pipelined step_async +
+    single packed readback per tick. n_spaces>1 = BASELINE config 3 (batched
+    cross-space AOI in one launch)."""
+    from goworld_tpu.ops import NeighborEngine, NeighborParams
+
+    if n is None:
+        n = int(os.environ.get("BENCH_N", "102400"))  # ~100k entities
+    # Density-preserving world sizing: side ∝ sqrt(n) keeps ~6 entities per
+    # 100x100 cell (≈19 AOI neighbors) at every BENCH_N, like the default.
+    grid = max(8, int(round(128 * (n / 102400.0) ** 0.5 / 8)) * 8)
+    params = NeighborParams(
+        capacity=n,
+        max_neighbors=128,
+        cell_size=100.0,
+        grid_x=grid,
+        grid_z=grid,
+        space_slots=space_slots,
+        cell_capacity=64,
+        max_events=131072,
+    )
+    eng = NeighborEngine(params)
+    eng.reset()
+
+    rng = np.random.default_rng(0)
+    # ~6 entities per 100x100 cell over the world → ~19 AOI neighbors each
+    # (AOI distance 100, density like the reference demos, BASELINE.md).
+    world = grid * 100.0
+    pos = rng.uniform(0, world, (n, 2)).astype(np.float32)
+    active = np.ones(n, bool)
+    space = (np.arange(n) % n_spaces).astype(np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    # Random-walk velocities ~ 3 units/tick (entities cross cells regularly).
+    vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
+
+    # Warmup: compile + first-tick full enter storm (~1.9M paged events).
+    eng.step(pos, active, space, radius)
+
+    steps = max(2, int(os.environ.get("BENCH_STEPS", "45")))
+    events = 0
+    collect_lat: list[float] = []
+    diff_lat: list[float] = []  # dispatch of tick t → tick t events on host
+    pending = None
+    pending_dispatch_t = 0.0
+    t_all0 = time.perf_counter()
+    for _ in range(steps):
+        pos += vel
+        np.clip(pos, 0.0, world, out=pos)
+        t_dispatch = time.perf_counter()
+        nxt = eng.step_async(pos, active, space, radius)
+        if pending is not None:
+            t0 = time.perf_counter()
+            enters, leaves, _ = pending.collect()
+            t1 = time.perf_counter()
+            collect_lat.append(t1 - t0)
+            diff_lat.append(t1 - pending_dispatch_t)
+            events += len(enters) + len(leaves)
+        pending, pending_dispatch_t = nxt, t_dispatch
+    t0 = time.perf_counter()
+    enters, leaves, _ = pending.collect()
+    t1 = time.perf_counter()
+    collect_lat.append(t1 - t0)
+    diff_lat.append(t1 - pending_dispatch_t)
+    events += len(enters) + len(leaves)
+    t_all = time.perf_counter() - t_all0
+
+    c_ms = np.array(collect_lat) * 1000.0
+    d_ms = np.array(diff_lat) * 1000.0
+    ticks_per_sec = steps / t_all
+    updates_per_sec = ticks_per_sec * n
+    return {
+        "metric": f"{label}_entity_updates_per_sec",
+        "value": round(updates_per_sec, 1),
+        "unit": "entity-updates/sec",
+        "vs_baseline": round(updates_per_sec / HEADLINE_BASELINE, 3),
+        "entities": n,
+        "spaces": n_spaces,
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "events_per_tick": round(events / steps, 1),
+        "collect_p50_ms": round(float(np.percentile(c_ms, 50)), 3),
+        "collect_p99_ms": round(float(np.percentile(c_ms, 99)), 3),
+        # End-to-end enter/leave-diff delivery latency (dispatch → host),
+        # including the one-tick pipeline lag — compare THIS to the target.
+        "diff_latency_p50_ms": round(float(np.percentile(d_ms, 50)), 3),
+        "diff_latency_p99_ms": round(float(np.percentile(d_ms, 99)), 3),
+        "p99_target_ms": P99_TARGET_MS,
+    }
+
+
+def bench_boids() -> dict:
+    """BASELINE config 4: the fused Pallas flocking kernel (50k agents, AOI +
+    steering in one launch, fully device-resident)."""
     import jax
 
     from goworld_tpu.ops.boids import BoidsEngine, BoidsParams
 
-    n = int(os.environ.get("BENCH_N", "51200"))
+    n = int(os.environ.get("BENCH_BOIDS_N", "51200"))
     grid = max(8, int(round(64 * (n / 51200.0) ** 0.5 / 8)) * 8)
     p = BoidsParams(capacity=n, cell_size=100.0, grid_x=grid, grid_z=grid)
     eng = BoidsEngine(p)
@@ -41,7 +231,7 @@ def bench_boids() -> None:
 
     pos, vel, _ = eng.step(pos, vel, active)  # compile
     jax.block_until_ready(pos)
-    steps = max(2, int(os.environ.get("BENCH_STEPS", "60")))
+    steps = max(2, int(os.environ.get("BENCH_BOIDS_STEPS", "60")))
     t0 = time.perf_counter()
     for _ in range(steps):
         # Device-resident chaining: no host copies between ticks.
@@ -52,106 +242,96 @@ def bench_boids() -> None:
     ticks_per_sec = steps / t_all
     updates_per_sec = ticks_per_sec * n
     baseline = 50_000 * 30  # 50k agents @ 30 Hz
-    print(
-        json.dumps(
-            {
-                "metric": "boids_agent_updates_per_sec_50k",
-                "value": round(updates_per_sec, 1),
-                "unit": "agent-updates/sec",
-                "vs_baseline": round(updates_per_sec / baseline, 3),
-                "agents": n,
-                "ticks_per_sec": round(ticks_per_sec, 2),
-                "cell_overflow_dropped": dropped,
+    return {
+        "metric": "boids_agent_updates_per_sec",
+        "value": round(updates_per_sec, 1),
+        "unit": "agent-updates/sec",
+        "vs_baseline": round(updates_per_sec / baseline, 3),
+        "agents": n,
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "cell_overflow_dropped": dropped,
+    }
+
+
+# --- main --------------------------------------------------------------------
+
+
+def main() -> int:
+    diag: dict = {}
+    platform = _resolve_platform(diag)
+    mode = os.environ.get("BENCH_MODE", "all")
+    result: dict
+    try:
+        if mode == "boids":
+            if platform != "tpu":
+                # Interpret-mode Pallas at 50k agents is a multi-hour hang,
+                # not a benchmark — emit the documented hardware-gated skip.
+                result = {
+                    "metric": "boids_agent_updates_per_sec",
+                    "value": 0.0,
+                    "unit": "agent-updates/sec",
+                    "vs_baseline": 0.0,
+                    "skipped": "requires tpu (pallas kernel)",
+                }
+            else:
+                result = bench_boids()
+        elif mode == "aoi":
+            result = bench_aoi()
+        elif mode == "multispace":
+            result = bench_aoi(space_slots=32, n_spaces=32, label="aoi_32space")
+        else:  # all: headline first, then the other BASELINE configs
+            result = bench_aoi(label="aoi")
+            result["metric"] = "aoi_entity_updates_per_sec_100k"
+            configs: dict = {}
+            try:
+                configs["multispace_32"] = bench_aoi(
+                    space_slots=32, n_spaces=32, label="aoi_32space"
+                )
+            except Exception:
+                configs["multispace_32"] = {
+                    "error": traceback.format_exc(limit=2).splitlines()[-1]
+                }
+            if platform == "tpu":
+                try:
+                    configs["boids_50k"] = bench_boids()
+                except Exception:
+                    configs["boids_50k"] = {
+                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                    }
+            else:
+                # Pallas interpret mode at 50k agents takes hours on CPU —
+                # an explicit hardware-gated skip, not silent truncation.
+                configs["boids_50k"] = {"skipped": "requires tpu (pallas kernel)"}
+            configs["pod_1m"] = {
+                "skipped": "requires multi-chip hardware (see dryrun_multichip)"
             }
-        )
-    )
-
-
-def main() -> None:
-    if os.environ.get("BENCH_PLATFORM"):
-        # The axon TPU plugin ignores JAX_PLATFORMS; force via jax.config
-        # (same workaround as tests/conftest.py) for CPU smoke runs.
+            result["configs"] = configs
+    except Exception:
+        result = {
+            "metric": "aoi_entity_updates_per_sec_100k",
+            "value": 0.0,
+            "unit": "entity-updates/sec",
+            "vs_baseline": 0.0,
+            "error": traceback.format_exc(limit=4),
+        }
+    result["platform"] = platform
+    try:
         import jax
 
-        jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-    if os.environ.get("BENCH_MODE") == "boids":
-        bench_boids()
-        return
-    from goworld_tpu.ops import NeighborEngine, NeighborParams
-
-    n = int(os.environ.get("BENCH_N", "102400"))  # ~100k entities
-    # Density-preserving world sizing: side ∝ sqrt(n) keeps ~6 entities per
-    # 100x100 cell (≈19 AOI neighbors) at every BENCH_N, like the default.
-    grid = max(8, int(round(128 * (n / 102400.0) ** 0.5 / 8)) * 8)
-    params = NeighborParams(
-        capacity=n,
-        max_neighbors=128,
-        cell_size=100.0,
-        grid_x=grid,
-        grid_z=grid,
-        space_slots=4,
-        cell_capacity=64,
-        max_events=131072,
-    )
-    eng = NeighborEngine(params)
-    eng.reset()
-
-    rng = np.random.default_rng(0)
-    # ~6 entities per 100x100 cell over a 12800^2 world → ~19 AOI neighbors
-    # each (AOI distance 100, density like the reference demos, BASELINE.md).
-    world = grid * 100.0
-    pos = rng.uniform(0, world, (n, 2)).astype(np.float32)
-    active = np.ones(n, bool)
-    space = np.zeros(n, np.int32)
-    radius = np.full(n, 100.0, np.float32)
-    # Random-walk velocities ~ 3 units/tick (entities cross cells regularly).
-    vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
-
-    # Warmup: compile + first-tick full enter storm (~1.9M paged events).
-    eng.step(pos, active, space, radius)
-
-    steps = max(2, int(os.environ.get("BENCH_STEPS", "45")))  # >=2: one collect in-loop
-    events = 0
-    lat = []
-    pending = None
-    t_all0 = time.perf_counter()
-    for _ in range(steps):
-        pos += vel
-        np.clip(pos, 0.0, world, out=pos)
-        nxt = eng.step_async(pos, active, space, radius)
-        if pending is not None:
-            t0 = time.perf_counter()
-            enters, leaves, _ = pending.collect()
-            lat.append(time.perf_counter() - t0)
-            events += len(enters) + len(leaves)
-        pending = nxt
-    enters, leaves, _ = pending.collect()
-    events += len(enters) + len(leaves)
-    t_all = time.perf_counter() - t_all0
-
-    lat_ms = np.array(lat) * 1000.0
-    p50 = float(np.percentile(lat_ms, 50))
-    p99 = float(np.percentile(lat_ms, 99))
-    ticks_per_sec = steps / t_all
-    updates_per_sec = ticks_per_sec * n
-    baseline = 100_000 * 30  # 100k entities @ 30 Hz
-    print(
-        json.dumps(
-            {
-                "metric": "aoi_entity_updates_per_sec_100k",
-                "value": round(updates_per_sec, 1),
-                "unit": "entity-updates/sec",
-                "vs_baseline": round(updates_per_sec / baseline, 3),
-                "entities": n,
-                "ticks_per_sec": round(ticks_per_sec, 2),
-                "events_per_tick": round(events / steps, 1),
-                "collect_p50_ms": round(p50, 3),
-                "collect_p99_ms": round(p99, 3),
-                "p99_target_ms": 5.0,
-            }
-        )
-    )
+        # The backend the numbers actually came from — guards against a
+        # forced/probed "tpu" label silently resolving to CPU in-process.
+        result["actual_backend"] = jax.default_backend()
+        if platform == "tpu" and result["actual_backend"] == "cpu":
+            result.setdefault(
+                "error", "platform mismatch: expected tpu, ran on cpu"
+            )
+    except Exception:
+        pass
+    for k, v in diag.items():
+        result.setdefault(k, v)
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

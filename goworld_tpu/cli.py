@@ -8,8 +8,9 @@ games → gates waiting for each group's supervisor tag in its log
 ``-restore`` under the (possibly rebuilt) code (reload.go:10-33), ``status``
 reports which configured processes are alive (status.go:14-115).
 
-Process bookkeeping is pidfile-based (``<name>.pid`` in the run directory),
-verified against /proc cmdlines so stale pidfiles never kill innocents.
+Process bookkeeping is pidfile-based (``<name>.pid`` = "pid starttime" in the
+run directory), verified against the kernel start time in /proc/<pid>/stat so
+a recycled PID belonging to an unrelated process is never signalled.
 
 Usage:
     python -m goworld_tpu.cli start examples.test_game [-configfile goworld.ini]
@@ -48,11 +49,16 @@ def _logfile(run_dir: str, name: str) -> str:
     return os.path.join(run_dir, f"{name}.out.log")
 
 
-def _read_pid(run_dir: str, name: str) -> int | None:
+def _read_pid(run_dir: str, name: str) -> tuple[int, int | None] | None:
+    """Returns (pid, starttime) from the pidfile; starttime is None for
+    legacy single-field pidfiles."""
     try:
         with open(_pidfile(run_dir, name)) as f:
-            return int(f.read().strip())
-    except (OSError, ValueError):
+            fields = f.read().split()
+            pid = int(fields[0])
+            start = int(fields[1]) if len(fields) > 1 else None
+            return pid, start
+    except (OSError, ValueError, IndexError):
         return None
 
 
@@ -64,16 +70,33 @@ def _proc_cmdline(pid: int) -> str:
         return ""
 
 
-def _alive(pid: int | None, expect: str) -> bool:
+def _proc_starttime(pid: int) -> int | None:
+    """Kernel start time (clock ticks since boot, /proc/<pid>/stat field 22).
+    Stable for the process's lifetime and never reused together with the same
+    PID, so (pid, starttime) uniquely identifies the process we spawned."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            stat = f.read().decode(errors="replace")
+        # Field 2 (comm) may contain spaces/parens; fields after the closing
+        # paren are well-formed.
+        rest = stat.rsplit(")", 1)[1].split()
+        return int(rest[19])  # field 22 overall = index 19 after comm
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _alive(pidinfo: tuple[int, int | None] | None, expect: str) -> bool:
     """Alive AND still the process we started (guards stale pidfile reuse)."""
-    if pid is None:
+    if pidinfo is None:
         return False
+    pid, start = pidinfo
     cmdline = _proc_cmdline(pid)
     if not cmdline:
         return False  # dead (or unreadable) — never "matches"
-    # Without a module hint (stop/status without server_module), any python
-    # process from our pidfile counts; a PID reused by a non-python process
-    # does not.
+    if start is not None:
+        # Strong identity: a recycled PID has a different kernel start time.
+        return _proc_starttime(pid) == start
+    # Legacy pidfile without a start time: fall back to the cmdline marker.
     return (expect or "python") in cmdline
 
 
@@ -107,8 +130,9 @@ def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
         start_new_session=True,  # survives the CLI exiting (daemon-ish)
     )
     logf.close()
+    start = _proc_starttime(proc.pid)
     with open(_pidfile(run_dir, name), "w") as f:
-        f.write(str(proc.pid))
+        f.write(str(proc.pid) if start is None else f"{proc.pid} {start}")
     _wait_tag(run_dir, name, tag, proc)
 
 
@@ -193,7 +217,7 @@ def _stop_group(run_dir: str, kind: str, names: list[str], sig: int,
             print(f"  {name}: not running")
             continue
         try:
-            os.kill(pid, sig)
+            os.kill(pid[0], sig)
         except ProcessLookupError:
             print(f"  {name}: already gone")
             continue
@@ -205,7 +229,7 @@ def _stop_group(run_dir: str, kind: str, names: list[str], sig: int,
         if _alive(pid, expect):
             print(f"  {name}: did not exit; killing")
             try:
-                os.kill(pid, signal.SIGKILL)
+                os.kill(pid[0], signal.SIGKILL)
             except ProcessLookupError:
                 pass
         else:
@@ -252,7 +276,7 @@ def cmd_reload(args) -> int:
             print(f"  {name}: not running; skipping")
             continue
         try:
-            os.kill(pid, signal.SIGHUP)
+            os.kill(pid[0], signal.SIGHUP)
         except ProcessLookupError:
             print(f"  {name}: already gone; skipping")
             continue
@@ -286,7 +310,7 @@ def cmd_status(args) -> int:
             pid = _read_pid(run_dir, name)
             up = _alive(pid, _expect_marker(kind, name, getattr(args, "server_module", None) or ""))
             alive += bool(up)
-            print(f"  {name}: {'RUNNING pid=' + str(pid) if up else 'not running'}")
+            print(f"  {name}: {'RUNNING pid=' + str(pid[0]) if up else 'not running'}")
     print(f"{alive}/{total} processes running")
     return 0 if alive == total else 1
 

@@ -118,7 +118,10 @@ class GameService:
                 lbc_task.cancel()
             if debug_srv is not None:
                 await debug_srv.stop()
-            gwvar.unset("IsDeploymentReady")
+            # IsDeploymentReady is guaranteed always-published (gwvar.go:27-29
+            # sets it at init); flip it back to False rather than unsetting so
+            # a co-hosted /vars endpoint keeps serving it after shutdown.
+            gwvar.set_var("IsDeploymentReady", False)
             gwvar.unset("NumEntities")
             await self.cluster.stop()
             dispatchercluster.set_cluster(None)

@@ -27,6 +27,11 @@ from goworld_tpu.netutil.packet_conn import ConnectionClosed
 class WSPacketConnection:
     """PacketConnection-shaped adapter over a websockets protocol object."""
 
+    # A stalled client may never drain its socket; beyond this many queued
+    # packets the connection is evicted rather than growing without bound
+    # (the TCP path gets the same protection from SO_SNDBUF + drop counters).
+    MAX_QUEUED = 4096
+
     def __init__(self, ws) -> None:
         self._ws = ws
         self._closed = False
@@ -53,6 +58,10 @@ class WSPacketConnection:
         body = struct.pack("<H", msgtype) + packet.payload
         if len(body) > consts.MAX_PACKET_SIZE:
             raise ValueError(f"packet too large: {len(body)}")
+        if self._outq.qsize() >= self.MAX_QUEUED:
+            self.dropped += 1
+            self.close()  # stalled client: evict instead of growing forever
+            return
         self._outq.put_nowait(body)
 
     async def _writer(self) -> None:
@@ -64,7 +73,13 @@ class WSPacketConnection:
         except asyncio.CancelledError:
             pass
         except Exception:
-            self._closed = True
+            # Packets already queued will never reach the peer: account for
+            # them as dropped and tear the socket down.
+            self.close()
+        finally:
+            while not self._outq.empty():
+                self._outq.get_nowait()
+                self.dropped += 1
 
     def flush(self) -> None:
         pass  # the writer task drains continuously

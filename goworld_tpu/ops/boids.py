@@ -228,6 +228,10 @@ def _jitted_step(p: BoidsParams, interpret: bool):
 class BoidsEngine:
     """Stateless-per-tick flocking stepper (positions in, positions out)."""
 
+    # Check the overflow counter once per this many ticks. The checked scalar
+    # is a full interval old, so int()-ing it never stalls the pipeline.
+    DROP_CHECK_INTERVAL = 64
+
     def __init__(self, params: BoidsParams, interpret: bool | None = None):
         self.params = params
         if interpret is None:
@@ -237,6 +241,8 @@ class BoidsEngine:
         # (they get zero steering — densest clusters are exactly where this
         # bites, so surface it instead of silently zeroing).
         self.last_dropped = None
+        self._tick = 0
+        self._stale_dropped = None
 
     def step(self, pos, vel, active):
         """One tick; accepts/returns numpy or jax arrays [N,2],[N,2],[N]."""
@@ -246,6 +252,21 @@ class BoidsEngine:
             jnp.asarray(active, jnp.bool_),
         )
         self.last_dropped = dropped  # device scalar; int() it to inspect
+        self._tick += 1
+        if self._tick % self.DROP_CHECK_INTERVAL == 0:
+            if self._stale_dropped is not None:
+                n_dropped = int(self._stale_dropped)
+                if n_dropped:
+                    from goworld_tpu.utils import gwlog
+
+                    gwlog.warnf(
+                        "boids cell overflow: %d active agents exceeded "
+                        "LANES=%d occupants in their grid cell (zero steering, "
+                        "invisible to neighbors); enlarge grid or cell_size",
+                        n_dropped,
+                        LANES,
+                    )
+            self._stale_dropped = dropped
         return pos2, vel2, accel
 
 

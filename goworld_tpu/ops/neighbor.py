@@ -323,8 +323,14 @@ class PendingStep:
         self._collected = False
         try:
             out.copy_to_host_async()
-        except Exception:
+        except NotImplementedError:
             pass  # platforms without async host copies just block in collect()
+        except jax.errors.JaxRuntimeError as err:
+            # Only "unimplemented on this platform" may be deferred to
+            # collect(); a real device-side failure must surface here, not be
+            # misattributed to the later blocking fetch.
+            if "unimplemented" not in str(err).lower():
+                raise
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         """Fetch (enter_pairs, leave_pairs, overflow); one blocking read."""
