@@ -128,21 +128,35 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
     """The production AOI loop (BatchAOIService path): pipelined step_async +
     single packed readback per tick. n_spaces>1 = BASELINE config 3 (batched
     cross-space AOI in one launch)."""
+    import jax
+
     from goworld_tpu.ops import NeighborEngine, NeighborParams
 
     if n is None:
         n = int(os.environ.get("BENCH_N", "102400"))  # ~100k entities
-    # Density-preserving world sizing: side ∝ sqrt(n) keeps ~6 entities per
-    # 100x100 cell (≈19 AOI neighbors) at every BENCH_N, like the default.
-    grid = max(8, int(round(128 * (n / 102400.0) ** 0.5 / 8)) * 8)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        # Pallas path: supercells (radius 100 still fits the 3x3 gather) for
+        # dozens of entities per 128-lane cell — dense cells amortize the
+        # per-cell kernel work over real occupants. The kernel grid scales
+        # with space_slots * gz * gx, so the many-space config trades cell
+        # granularity for slab count.
+        if space_slots > 4:
+            cell, cap = 400.0, 128
+            grid = max(8, int(round(32 * (n / 102400.0) ** 0.5 / 4)) * 4)
+        else:
+            cell, cap = 300.0, 128
+            grid = max(8, int(round(44 * (n / 102400.0) ** 0.5 / 4)) * 4)
+    else:
+        cell, cap = 100.0, 64
+        grid = max(8, int(round(128 * (n / 102400.0) ** 0.5 / 8)) * 8)
     params = NeighborParams(
         capacity=n,
-        max_neighbors=128,
-        cell_size=100.0,
+        cell_size=cell,
         grid_x=grid,
         grid_z=grid,
         space_slots=space_slots,
-        cell_capacity=64,
+        cell_capacity=cap,
         max_events=131072,
     )
     eng = NeighborEngine(params)
@@ -151,7 +165,7 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
     rng = np.random.default_rng(0)
     # ~6 entities per 100x100 cell over the world → ~19 AOI neighbors each
     # (AOI distance 100, density like the reference demos, BASELINE.md).
-    world = grid * 100.0
+    world = grid * cell
     pos = rng.uniform(0, world, (n, 2)).astype(np.float32)
     active = np.ones(n, bool)
     space = (np.arange(n) % n_spaces).astype(np.int32)
@@ -285,6 +299,7 @@ def main() -> int:
             configs: dict = {}
             try:
                 configs["multispace_32"] = bench_aoi(
+                    n=int(os.environ.get("BENCH_N", "102400")),
                     space_slots=32, n_spaces=32, label="aoi_32space"
                 )
             except Exception:

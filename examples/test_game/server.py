@@ -255,11 +255,18 @@ class Avatar(Entity):
         if self.space is not None:
             e.enter_space(self.space.id, self.position)
 
-        def finish():
-            self.call_client("OnTestAOI", e.id)
-            e.destroy()
+        # The batched AOI plane delivers enter diffs one tick late (pipelined
+        # by design, aoi/batched.py); destroying on the next post drain would
+        # reconcile the enter away before the client ever saw the tester.
+        # A short timer keeps the reference probe semantics (create reaches
+        # the client, then the tester disappears) on both AOI backends.
+        self.add_callback(0.2, "FinishTestAOI", e.id)
 
-        goworld.post(finish)
+    def FinishTestAOI(self, tester_id: str):
+        self.call_client("OnTestAOI", tester_id)
+        tester = goworld.get_entity(tester_id)
+        if tester is not None and not tester.is_destroyed():
+            tester.destroy()
 
     # --- AllClients echo (Avatar.go:277-303) ---------------------------------
 

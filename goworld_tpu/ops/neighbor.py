@@ -2,44 +2,75 @@
 
 What the reference does per entity move (Space.go:253-261 → go-aoi
 ``Moved(aoi, x, z)`` → synchronous OnEnterAOI/OnLeaveAOI callbacks), this
-engine does for *all* entities of *all* spaces in one jitted launch per tick:
+engine does for *all* entities of *all* spaces in one launch per tick.
 
-1. **Spatial hash grid build** — entities are binned into grid cells of side
-   ``cell_size`` (= max AOI distance). Static shapes throughout: the grid is a
-   ``[space_slots * grid_z * grid_x, cell_capacity]`` table of entity slots,
-   built with a sort + rank-within-cell + scatter (no data-dependent shapes,
-   XLA-friendly).
-2. **Candidate gather** — each entity reads the 3×3 neighborhood of its cell:
-   ``9 * cell_capacity`` candidate slots. Cell coords wrap modulo the grid
-   (torus); false adjacencies from wrap/space folding are removed by the
-   distance and space-id masks, so correctness never depends on grid extents.
-3. **Neighbor set** — the K lowest-id candidates within radius form the
-   entity's interest set, as a sorted, ``capacity``-padded id list. Sorted
-   fixed-K lists make set-diff a vectorized searchsorted, and make results
-   deterministic (ties cannot occur: ids are unique).
-4. **Diff** — enter = in new set but not old, leave = in old but not new.
-   Diffs are compacted on-device into a ``[max_events, 2]`` pair list so the
-   host readback is O(events), not O(N·K).
+Design (round 2): the engine is **event-native**. The reference's AOI (and
+round 1's engine) materializes per-entity neighbor *sets* and diffs them;
+sets are exactly what a TPU is bad at (variable degree, top-k truncation,
+huge [N, candidates] intermediates). But the *product* the host consumes is
+the enter/leave event stream — so the engine computes events directly as a
+pairwise predicate diff and never materializes a neighbor list at all:
 
-The engine is a pure function of (previous neighbor state, current positions);
-the stateful wrapper just carries the device arrays. Statelessness per tick is
-what keeps freeze/restore and migration semantics intact (SURVEY.md §5.8): on
-restart the host simply re-uploads positions.
+    valid_t(i, j) = av_t(i) ∧ av_t(j) ∧ space_t(i) = space_t(j)
+                    ∧ dist_t(i, j) ≤ radius_t(i) ∧ i ≠ j
+    enter(t) = valid_t ∧ ¬valid_{t-1}        leave(t) = valid_{t-1} ∧ ¬valid_t
 
-Asymmetric interest (per-entity radius) is supported — a superset of the
-reference's single uniform distance per AOIManager (go-aoi limitation noted in
-reference TODO.md:17).
+where ``av`` (active-and-visible) folds grid-capacity drops into validity,
+keeping the event stream *exactly* consistent for host-side incremental sets
+even across drop windows. There is **no max_neighbors truncation**: interest
+sets are the exact geometric sets, a superset of go-aoi semantics (which has
+a single uniform distance, reference TODO.md:17 — per-entity radius is
+supported here).
+
+Enumeration uses two spatial-hash grids per tick, both with **static
+shapes**:
+
+- **enter pass** bins entities by their *current* positions: any pair valid
+  at t is within radius ≤ cell_size, hence inside the 3×3 cell neighborhood.
+- **leave pass** bins by the *previous* positions: any pair valid at t-1 is
+  inside the previous grid's 3×3 neighborhood.
+
+Each pass evaluates both epochs' predicates per pair (positions of both
+ticks ride along as features), so arbitrarily large per-tick movement —
+teleports, cross-game migration (EnterSpace, Entity.go:956-1115) — is exact:
+no movement bound, no stale interest.
+
+Two execution paths with identical semantics:
+
+- **Pallas kernel** (TPU): entities packed into a dense per-cell layout
+  ``[space_slot, gz, gx, F, 128]`` (the boids layout, ops/boids.py); one
+  program per cell DMAs its 3×3 halo block HBM→VMEM, evaluates the pairwise
+  predicates for 128 × 1152 pairs on the VPU, and bit-packs the event mask
+  16-bits-per-word via an MXU matmul — no [N, candidates] float intermediate
+  ever reaches HBM (round 1 shipped ~200 MB × several per tick).
+- **jnp reference** (CPU tests / oracle): the same two-grid pairwise math
+  over gathered candidate id matrices.
+
+The engine is a pure function of (previous tick's inputs, current inputs);
+device state is just the previous (pos, active, space, radius). Stateless-
+per-tick is what keeps freeze/restore and migration semantics intact
+(SURVEY.md §5.8): on restart the host simply re-uploads positions and takes
+one enter storm.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+LANES = 128  # Pallas cell capacity = one TPU lane dimension
+_PACK = 16  # event-mask bits packed per i32 word (exact in f32 matmul)
+_F = 16  # padded feature count (sublane multiple of 8)
+
+# Feature rows in the dense cell layout. Epoch A = the epoch whose positions
+# the grid is binned by; epoch B = the other epoch. The kernel computes
+# valid_A ∧ ¬valid_B, so the same kernel serves both passes with A/B swapped.
+_FX_A, _FZ_A, _FS_A, _FR_A, _FAV_A = 0, 1, 2, 3, 4
+_FX_B, _FZ_B, _FS_B, _FR_B, _FAV_B = 5, 6, 7, 8, 9
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,12 +78,11 @@ class NeighborParams:
     """Static configuration of a neighbor engine (shapes are compiled in)."""
 
     capacity: int = 16384  # max entity slots (N)
-    max_neighbors: int = 128  # K: interest-set capacity per entity
     cell_size: float = 100.0  # grid cell side; must be >= max AOI distance
     grid_x: int = 64  # grid extent in cells (wraps modulo)
     grid_z: int = 64
     space_slots: int = 8  # space-id folding slots for the shared grid
-    cell_capacity: int = 64  # M: max entities stored per grid cell
+    cell_capacity: int = 64  # M: max entities visible per grid cell
     max_events: int = 65536  # enter/leave pairs fetched per host round trip
 
     def __post_init__(self) -> None:
@@ -67,240 +97,421 @@ class NeighborParams:
         return self.space_slots * self.grid_z * self.grid_x
 
 
-class MatrixStepResult(NamedTuple):
-    """Step output with device-resident event matrices (drained in chunks)."""
-
-    neighbors: jax.Array  # i32[N, K]
-    enter_ids: jax.Array  # i32[N, K]: other-id where entered, else sentinel N
-    leave_ids: jax.Array  # i32[N, K]: other-id where left, else sentinel N
-    n_enters: jax.Array  # i32[] total enter events
-    n_leaves: jax.Array  # i32[] total leave events
-    overflow: jax.Array  # i32[] entities whose true neighbor count exceeded K
-    grid_dropped: jax.Array  # i32[] active entities not inserted in the grid
+# --- shared binning ----------------------------------------------------------
 
 
-def _bucket_of(p: NeighborParams, cx: jax.Array, cz: jax.Array, space: jax.Array) -> jax.Array:
-    """Fold (cell_x, cell_z, space_id) into a grid bucket index (torus wrap)."""
-    cxm = jnp.mod(cx, p.grid_x)
-    czm = jnp.mod(cz, p.grid_z)
+def _bins(p: NeighborParams, pos: jax.Array, space: jax.Array):
+    """Wrapped (cell_x, cell_z, space_slot) coordinates per entity."""
+    cx = jnp.mod(jnp.floor(pos[:, 0] / p.cell_size).astype(jnp.int32), p.grid_x)
+    cz = jnp.mod(jnp.floor(pos[:, 1] / p.cell_size).astype(jnp.int32), p.grid_z)
     sm = jnp.mod(space, p.space_slots)
-    return (sm * p.grid_z + czm) * p.grid_x + cxm
+    return cx, cz, sm
 
 
-def _build_grid(
-    p: NeighborParams, bucket: jax.Array, active: jax.Array
-) -> tuple[jax.Array, jax.Array]:
-    """Scatter entity slots into the [num_buckets * M] grid table.
+def _build_table(
+    p: NeighborParams, bucket: jax.Array, active: jax.Array, stride: int
+):
+    """Bin entities into a [num_buckets * stride] slot table.
 
-    Rank-within-bucket is derived from a stable sort: after sorting slots by
-    bucket id, an entity's rank is its position minus the first position of
-    its bucket. Entities beyond ``cell_capacity`` in a cell are dropped from
-    the grid (they still *query*, so they receive neighbors; they are just
-    invisible to others this tick). Returns (grid, dropped_count) so callers
-    can alert operators to size cell_capacity / space_slots properly.
+    Rank-within-bucket is derived from a stable argsort (deterministic).
+    Entities beyond ``min(cell_capacity, stride)`` in a cell are dropped —
+    invisible this tick, with the drop folded into the validity predicate so
+    the event stream stays consistent. Returns
+    (table i32[num_buckets*stride] with sentinel N, slot i32[N] with -1 for
+    dropped/inactive, dropped_count, order, dst) — order/dst let callers
+    scatter per-entity features into the same layout.
     """
     n = p.capacity
-    # Inactive entities sort to the end with an out-of-range bucket.
+    cap = min(p.cell_capacity, stride)
     key = jnp.where(active, bucket, p.num_buckets)
     order = jnp.argsort(key)  # stable
     sorted_key = key[order]
-    first_pos = jnp.searchsorted(sorted_key, sorted_key, side="left")
-    rank = jnp.arange(n, dtype=jnp.int32) - first_pos.astype(jnp.int32)
-    ok = (sorted_key < p.num_buckets) & (rank < p.cell_capacity)
+    first = jnp.searchsorted(sorted_key, sorted_key, side="left")
+    rank = jnp.arange(n, dtype=jnp.int32) - first.astype(jnp.int32)
+    ok = (sorted_key < p.num_buckets) & (rank < cap)
     dropped = jnp.sum((sorted_key < p.num_buckets) & ~ok).astype(jnp.int32)
-    table_size = p.num_buckets * p.cell_capacity
-    # Out-of-range index + mode="drop" discards non-ok writes.
-    flat_idx = jnp.where(ok, sorted_key * p.cell_capacity + rank, table_size)
-    grid = jnp.full((table_size,), n, dtype=jnp.int32)
-    grid = grid.at[flat_idx].set(order.astype(jnp.int32), mode="drop")
-    return grid, dropped
+    table_size = p.num_buckets * stride
+    dst = jnp.where(ok, sorted_key * stride + rank, table_size)
+    table = jnp.full((table_size,), n, dtype=jnp.int32)
+    table = table.at[dst].set(order.astype(jnp.int32), mode="drop")
+    slot_sorted = jnp.where(ok, dst, -1).astype(jnp.int32)
+    slot = jnp.zeros((n,), jnp.int32).at[order].set(slot_sorted)
+    return table, slot, dropped, order, dst
 
 
-def _neighbor_sets(
-    p: NeighborParams,
-    grid: jax.Array,
-    pos: jax.Array,  # f32[N,2] global positions
-    active: jax.Array,  # bool[N] global
-    space: jax.Array,  # i32[N] global
-    q_ids: jax.Array,  # i32[Q] global slot ids of the query entities
-    q_pos: jax.Array,  # f32[Q,2]
-    q_active: jax.Array,  # bool[Q]
-    q_space: jax.Array,  # i32[Q]
-    q_radius: jax.Array,  # f32[Q]
-) -> tuple[jax.Array, jax.Array]:
-    """Compute sorted fixed-K neighbor id lists for the Q query entities
-    against the full (possibly all-gathered) world.
-
-    Single-device: Q == N and q_ids == arange(N). Sharded: each device passes
-    only the slots it owns (SURVEY.md §2.9: entity-sharded global query).
-    """
-    n, k, m = p.capacity, p.max_neighbors, p.cell_capacity
-
-    q_cx = jnp.floor(q_pos[:, 0] / p.cell_size).astype(jnp.int32)
-    q_cz = jnp.floor(q_pos[:, 1] / p.cell_size).astype(jnp.int32)
-
-    # Gather 3x3 cell neighborhoods → candidate slot ids [Q, 9*M].
-    offsets = [(dx, dz) for dz in (-1, 0, 1) for dx in (-1, 0, 1)]
-    cand_parts = []
-    for dx, dz in offsets:
-        b = _bucket_of(p, q_cx + dx, q_cz + dz, q_space)  # [Q]
-        base = b * m
-        idx = base[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]  # [Q, M]
-        cand_parts.append(grid[idx])
-    cand = jnp.concatenate(cand_parts, axis=1)  # [Q, 9M]
-
-    cand_safe = jnp.minimum(cand, n - 1)  # safe gather index for sentinel rows
-    # Gather x and z separately: a trailing dim of 2 would be padded to 128
-    # lanes by TPU tiling (64x memory blowup on the [Q, 9M] intermediates).
-    dx = pos[:, 0][cand_safe] - q_pos[:, 0][:, None]  # [Q, 9M]
-    dz = pos[:, 1][cand_safe] - q_pos[:, 1][:, None]
+def _pair_valid(
+    q_av, q_space, q_r2, q_x, q_z, c_av, c_space, c_x, c_z, not_self
+):
+    """The per-pair interest predicate for one epoch (shared jnp/oracle)."""
+    dx = c_x - q_x
+    dz = c_z - q_z
     d2 = dx * dx + dz * dz
-    r2 = (q_radius * q_radius)[:, None]
-
-    valid = (
-        (cand < n)
-        & (cand != q_ids[:, None])
-        & q_active[:, None]
-        & active[cand_safe]
-        & (space[cand_safe] == q_space[:, None])
-        & (d2 <= r2)
-    )
-    # True neighbor degree (before K-truncation) for overflow accounting.
-    degree = jnp.sum(valid, axis=1)
-
-    # K lowest ids among valid candidates; sentinel n pads the tail. A cell
-    # neighborhood holds at most 9*M candidates, so clamp the top_k width and
-    # pad the remaining columns with the sentinel.
-    keys = jnp.where(valid, cand, n)
-    kk = min(k, 9 * m)
-    neg_topk, _ = jax.lax.top_k(-keys, kk)  # top_k of negated → kk smallest
-    neighbors = -neg_topk  # ascending, padded with n
-    if kk < k:
-        pad = jnp.full((neighbors.shape[0], k - kk), n, neighbors.dtype)
-        neighbors = jnp.concatenate([neighbors, pad], axis=1)
-    overflow = jnp.sum(degree > k)
-    return neighbors.astype(jnp.int32), overflow.astype(jnp.int32)
+    return q_av & c_av & (q_space == c_space) & (d2 <= q_r2) & not_self
 
 
-def _row_membership(sorted_ref: jax.Array, queries: jax.Array, sentinel: int) -> jax.Array:
-    """For each row: is queries[i,j] present in sorted_ref[i,:]? (vectorized)"""
-
-    def one_row(ref_row, q_row):
-        pos = jnp.searchsorted(ref_row, q_row)
-        pos = jnp.minimum(pos, ref_row.shape[0] - 1)
-        return (ref_row[pos] == q_row) & (q_row < sentinel)
-
-    return jax.vmap(one_row)(sorted_ref, queries)
+# --- jnp reference path ------------------------------------------------------
 
 
-def _step(
+def _gather_cands(p: NeighborParams, table: jax.Array, cx, cz, sm) -> jax.Array:
+    """Candidate id matrix [Q, 9*M] from each query's 3x3 cell block."""
+    m = p.cell_capacity
+    parts = []
+    for dz in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            cxx = jnp.mod(cx + dx, p.grid_x)
+            czz = jnp.mod(cz + dz, p.grid_z)
+            b = (sm * p.grid_z + czz) * p.grid_x + cxx
+            idx = (b * m)[:, None] + jnp.arange(m, dtype=jnp.int32)[None, :]
+            parts.append(table[idx])
+    return jnp.concatenate(parts, axis=1)  # [Q, 9M]
+
+
+def _epoch_mask(
     p: NeighborParams,
-    prev_neighbors: jax.Array,
-    pos: jax.Array,
-    active: jax.Array,
-    space: jax.Array,
-    radius: jax.Array,
-) -> MatrixStepResult:
+    cand: jax.Array,  # i32[Q, 9M] candidate ids (sentinel N)
+    q_ids: jax.Array,  # i32[Q] global ids of the queries
+    q_pos, q_av, q_space, q_radius,  # query-side epoch arrays, [Q]
+    pos, av, space,  # full per-entity epoch arrays, [N]
+) -> jax.Array:
     n = p.capacity
-    cx = jnp.floor(pos[:, 0] / p.cell_size).astype(jnp.int32)
-    cz = jnp.floor(pos[:, 1] / p.cell_size).astype(jnp.int32)
-    bucket = _bucket_of(p, cx, cz, space)
+    safe = jnp.minimum(cand, n - 1)
+    # x and z gathered separately: a trailing dim of 2 would be padded to 128
+    # lanes by TPU tiling (64x memory blowup on the [Q, 9M] intermediates).
+    not_self = (cand < n) & (cand != q_ids[:, None])
+    return _pair_valid(
+        q_av[:, None],
+        q_space[:, None],
+        (q_radius * q_radius)[:, None],
+        q_pos[:, 0][:, None],
+        q_pos[:, 1][:, None],
+        av[safe],
+        space[safe],
+        pos[:, 0][safe],
+        pos[:, 1][safe],
+        not_self,
+    )
 
-    grid, grid_dropped = _build_grid(p, bucket, active)
+
+def _step_jnp(
+    p: NeighborParams,
+    ppos, pact, pspc, prad,  # previous-tick inputs (device state)
+    pos, act, spc, rad,  # current-tick inputs
+):
+    """Two-grid pairwise diff, jnp path. Returns
+    (enter_ids [N, 9M], leave_ids [N, 9M], n_enters, n_leaves, dropped)."""
+    n = p.capacity
+    m = p.cell_capacity
     q_ids = jnp.arange(n, dtype=jnp.int32)
-    neighbors, overflow = _neighbor_sets(
-        p, grid, pos, active, space, q_ids, pos, active, space, radius
-    )
 
-    entered = ~_row_membership(prev_neighbors, neighbors, n) & (neighbors < n)
-    left = ~_row_membership(neighbors, prev_neighbors, n) & (prev_neighbors < n)
+    cxc, czc, smc = _bins(p, pos, spc)
+    cxp, czp, smp = _bins(p, ppos, pspc)
+    buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
+    buc_p = (smp * p.grid_z + czp) * p.grid_x + cxp
+    table_c, slot_c, dropped_c, _, _ = _build_table(p, buc_c, act, m)
+    table_p, slot_p, _, _, _ = _build_table(p, buc_p, pact, m)
+    av_c = slot_c >= 0
+    av_p = slot_p >= 0
 
-    enter_ids = jnp.where(entered, neighbors, n)
-    leave_ids = jnp.where(left, prev_neighbors, n)
-    n_enters = jnp.sum(entered).astype(jnp.int32)
-    n_leaves = jnp.sum(left).astype(jnp.int32)
-    return MatrixStepResult(
-        neighbors, enter_ids, leave_ids, n_enters, n_leaves, overflow, grid_dropped
-    )
+    # Enter pass: candidates from the current grid.
+    cand_c = _gather_cands(p, table_c, cxc, czc, smc)
+    vc = _epoch_mask(p, cand_c, q_ids, pos, av_c, spc, rad, pos, av_c, spc)
+    vp_on_c = _epoch_mask(p, cand_c, q_ids, ppos, av_p, pspc, prad, ppos, av_p, pspc)
+    enter_mask = vc & ~vp_on_c
+
+    # Leave pass: candidates from the previous grid.
+    cand_p = _gather_cands(p, table_p, cxp, czp, smp)
+    vp = _epoch_mask(p, cand_p, q_ids, ppos, av_p, pspc, prad, ppos, av_p, pspc)
+    vc_on_p = _epoch_mask(p, cand_p, q_ids, pos, av_c, spc, rad, pos, av_c, spc)
+    leave_mask = vp & ~vc_on_p
+
+    enter_ids = jnp.where(enter_mask, cand_c, n)
+    leave_ids = jnp.where(leave_mask, cand_p, n)
+    n_enters = jnp.sum(enter_mask).astype(jnp.int32)
+    n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
+    return enter_ids, leave_ids, n_enters, n_leaves, dropped_c
 
 
-def _drain(
-    p: NeighborParams, ids: jax.Array, start_flat: jax.Array
-) -> tuple[jax.Array, jax.Array]:
+def _drain_ids(ids: jax.Array, n: int, max_events: int, start_flat: jax.Array):
     """Compact one chunk of events from an id matrix.
 
-    ``ids`` is i32[N,K] with sentinel N in non-event slots. Returns
+    ``ids`` is i32[Q, W] with sentinel ``n`` in non-event slots. Returns
     (pairs i32[max_events, 2], flat_positions i32[max_events]) for the first
     ``max_events`` events at flat index >= start_flat. Host pages through by
     passing last_flat+1 as the next start.
     """
-    n, k = p.capacity, p.max_neighbors
-    total = n * k
+    q, w = ids.shape
+    total = q * w
     flat = ids.reshape(-1)
     mask = (flat < n) & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
-    (idx,) = jnp.nonzero(mask, size=p.max_events, fill_value=total)
+    (idx,) = jnp.nonzero(mask, size=max_events, fill_value=total)
     idx = idx.astype(jnp.int32)
     valid = idx < total
     safe = jnp.minimum(idx, total - 1)
-    ent = jnp.where(valid, safe // k, n)
+    ent = jnp.where(valid, safe // w, n)
     oth = jnp.where(valid, flat[safe], n)
     return jnp.stack([ent, oth], axis=1), idx
 
 
-def _step_packed(
-    p: NeighborParams,
-    prev_neighbors: jax.Array,
-    pos: jax.Array,
-    active: jax.Array,
-    space: jax.Array,
-    radius: jax.Array,
-) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
-    """One tick, with everything the host needs packed into ONE array.
+def _pack_out(p: NeighborParams, enter_pairs, enter_idx, leave_pairs, leave_idx,
+              n_enters, n_leaves, dropped):
+    """Assemble the single packed host readback (ONE fetch per tick).
 
-    Host↔device round trips are the latency budget (a blocking fetch costs a
-    full RTT — ~100 ms through a tunneled chip, ~100 µs locally), so the step
-    emits a single i32 ``out`` of shape [3 + 2*max_events, 2]:
-
+    out i32[3 + 2*max_events, 2]:
         out[0] = (n_enters, n_leaves)          total event counts
-        out[1] = (overflow, grid_dropped)      diagnostics
+        out[1] = (dropped, 0)                  grid-capacity drop diagnostic
         out[2] = (enter_last_flat, leave_last_flat)  resume cursors
         out[3          : 3+E]  = first E enter pairs (slot, other)
         out[3+E : 3+2E]        = first E leave pairs
-
-    One ``np.asarray(out)`` per tick replaces the previous design's ~6
-    separate scalar/array fetches. If a tick produces more than E events
-    (mass spawns), the host pages the remainder from the returned
-    ``enter_ids``/``leave_ids`` matrices starting at the resume cursors.
     """
-    res = _step(p, prev_neighbors, pos, active, space, radius)
     e = p.max_events
-    enter_pairs, enter_idx = _drain(p, res.enter_ids, jnp.int32(0))
-    leave_pairs, leave_idx = _drain(p, res.leave_ids, jnp.int32(0))
     header = jnp.stack(
         [
-            jnp.stack([res.n_enters, res.n_leaves]),
-            jnp.stack([res.overflow, res.grid_dropped]),
+            jnp.stack([n_enters, n_leaves]),
+            jnp.stack([dropped, jnp.int32(0)]),
             jnp.stack([enter_idx[e - 1], leave_idx[e - 1]]),
         ]
     ).astype(jnp.int32)
-    out = jnp.concatenate([header, enter_pairs, leave_pairs], axis=0)
-    return res.neighbors, res.enter_ids, res.leave_ids, out
+    return jnp.concatenate([header, enter_pairs, leave_pairs], axis=0)
+
+
+def _step_packed_jnp(p: NeighborParams, ppos, pact, pspc, prad, pos, act, spc, rad):
+    enter_ids, leave_ids, n_e, n_l, dropped = _step_jnp(
+        p, ppos, pact, pspc, prad, pos, act, spc, rad
+    )
+    n = p.capacity
+    ep, ei = _drain_ids(enter_ids, n, p.max_events, jnp.int32(0))
+    lp, li = _drain_ids(leave_ids, n, p.max_events, jnp.int32(0))
+    out = _pack_out(p, ep, ei, lp, li, n_e, n_l, dropped)
+    return enter_ids, leave_ids, out
+
+
+# --- Pallas path -------------------------------------------------------------
+
+
+def _scatter_feats(p: NeighborParams, order, dst, feats_a, feats_b):
+    """Scatter per-entity features into the dense cell layout and wrap-pad.
+
+    feats_a = (x, z, space, radius, av) of the epoch the grid is binned by;
+    feats_b = the same five for the other epoch. Returns
+    f32[space_slots, gz+2, gx+2, F, LANES].
+    """
+    flat_size = p.num_buckets * LANES
+
+    def scatter(values):
+        flat = jnp.zeros((flat_size,), jnp.float32)
+        return flat.at[dst].set(values[order].astype(jnp.float32), mode="drop")
+
+    rows = [scatter(v) for v in feats_a] + [scatter(v) for v in feats_b]
+    feats = jnp.stack(rows)  # [10, flat]
+    feats = jnp.pad(feats, ((0, _F - len(rows)), (0, 0)))
+    cells = feats.reshape(_F, p.space_slots, p.grid_z, p.grid_x, LANES)
+    cells = cells.transpose(1, 2, 3, 0, 4)  # [S, gz, gx, F, LANES]
+    # Torus halo ring per space slab (spatial dims only).
+    return jnp.pad(cells, ((0, 0), (1, 1), (1, 1), (0, 0), (0, 0)), mode="wrap")
+
+
+def _event_kernel(p: NeighborParams, cells_hbm, out_ref, scratch, sem):
+    """One program per grid cell: DMA the 3x3 halo block, evaluate
+    valid_A ∧ ¬valid_B for all 128 × 1152 pairs, bit-pack the mask."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = pl.program_id(0)
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    dma = pltpu.make_async_copy(
+        cells_hbm.at[s, pl.ds(i, 3), pl.ds(j, 3)], scratch, sem
+    )
+    dma.start()
+    dma.wait()
+
+    c = scratch[:]  # [3, 3, F, LANES]
+    cand = c.transpose(2, 0, 1, 3).reshape(_F, 9 * LANES)
+    q = c[1, 1]  # [F, LANES]
+
+    # Self-pairs: the center cell is candidate block 4 (row-major 3x3).
+    lane = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 0)
+    cidx = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 1)
+    not_self = cidx != 4 * LANES + lane
+
+    def valid(fx, fz, fs, fr, fav):
+        dx = cand[fx][None, :] - q[fx][:, None]
+        dz = cand[fz][None, :] - q[fz][:, None]
+        d2 = dx * dx + dz * dz
+        r2 = (q[fr] * q[fr])[:, None]
+        return (
+            (q[fav][:, None] > 0.0)
+            & (cand[fav][None, :] > 0.0)
+            & (q[fs][:, None] == cand[fs][None, :])
+            & (d2 <= r2)
+            & not_self
+        )
+
+    mask = valid(_FX_A, _FZ_A, _FS_A, _FR_A, _FAV_A) & ~valid(
+        _FX_B, _FZ_B, _FS_B, _FR_B, _FAV_B
+    )
+
+    # Bit-pack 16 candidate bits per i32 word via one MXU matmul:
+    # P[c, w] = 2^(c mod 16) if c // 16 == w else 0. Products are exact in
+    # bf16 (single-bit mantissas) and sums < 2^16 are exact in f32.
+    w_words = 9 * LANES // _PACK
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 0)
+    w_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 1)
+    pmat = jnp.where(
+        c_iota // _PACK == w_iota,
+        jnp.exp2(jnp.mod(c_iota, _PACK).astype(jnp.float32)),
+        0.0,
+    )
+    packed = jnp.dot(
+        mask.astype(jnp.float32), pmat, preferred_element_type=jnp.float32
+    )  # [LANES, W]
+    out_ref[0, 0, 0] = packed.astype(jnp.int32)
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_step(params: NeighborParams):
-    """One compiled step per distinct NeighborParams (shared across engines)."""
-    return jax.jit(functools.partial(_step, params), donate_argnums=(0,))
+def _compiled_event_kernel(p: NeighborParams, interpret: bool):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    w_words = 9 * LANES // _PACK
+    kernel = functools.partial(_event_kernel, p)
+    return pl.pallas_call(
+        kernel,
+        grid=(p.space_slots, p.grid_z, p.grid_x),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(
+            (1, 1, 1, LANES, w_words),
+            lambda s, i, j: (s, i, j, 0, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        out_shape=jax.ShapeDtypeStruct(
+            (p.space_slots, p.grid_z, p.grid_x, LANES, w_words), jnp.int32
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((3, 3, _F, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )
+
+
+def _unpack_bits(packed: jax.Array) -> jax.Array:
+    """i32[Q, W] 16-bit words → bool[Q, W*16]."""
+    q, w = packed.shape
+    bits = (packed[:, :, None] >> jnp.arange(_PACK, dtype=jnp.int32)) & 1
+    return bits.reshape(q, w * _PACK) > 0
+
+
+def _drain_bits(
+    p: NeighborParams,
+    packed_e: jax.Array,  # i32[N, W] per-entity packed event mask
+    cx, cz, sm,  # i32[N] bin coords of the pass's grid
+    table: jax.Array,  # i32[num_buckets * LANES] id table of the pass's grid
+    start_flat: jax.Array,
+):
+    """Pallas-path drain: page (entity, other) pairs out of the packed event
+    bits. Flat index space is [N * 9 * LANES); candidate c of entity i maps
+    to halo cell c // LANES (row-major 3x3) and lane c % LANES."""
+    n = p.capacity
+    cw = 9 * LANES
+    total = n * cw
+    flat = _unpack_bits(packed_e).reshape(-1)
+    mask = flat & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
+    (idx,) = jnp.nonzero(mask, size=p.max_events, fill_value=total)
+    idx = idx.astype(jnp.int32)
+    valid = idx < total
+    safe = jnp.minimum(idx, total - 1)
+    ent = safe // cw
+    c = safe % cw
+    hc = c // LANES
+    lane = c % LANES
+    dzo = hc // 3 - 1
+    dxo = hc % 3 - 1
+    czz = jnp.mod(cz[ent] + dzo, p.grid_z)
+    cxx = jnp.mod(cx[ent] + dxo, p.grid_x)
+    bucket = (sm[ent] * p.grid_z + czz) * p.grid_x + cxx
+    other = table[bucket * LANES + lane]
+    ent = jnp.where(valid, ent, n)
+    other = jnp.where(valid, other, n)
+    return jnp.stack([ent, other], axis=1), idx
+
+
+def _step_pallas(
+    p: NeighborParams, interpret: bool,
+    ppos, pact, pspc, prad, pos, act, spc, rad,
+):
+    """Two Pallas passes (enter on the current grid, leave on the previous
+    grid) + XLA postlude. Returns device arrays for the packed readback and
+    the paging context."""
+    kernel = _compiled_event_kernel(p, interpret)
+
+    cxc, czc, smc = _bins(p, pos, spc)
+    cxp, czp, smp = _bins(p, ppos, pspc)
+    buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
+    buc_p = (smp * p.grid_z + czp) * p.grid_x + cxp
+    table_c, slot_c, dropped_c, order_c, dst_c = _build_table(p, buc_c, act, LANES)
+    table_p, slot_p, _, order_p, dst_p = _build_table(p, buc_p, pact, LANES)
+    av_c = (slot_c >= 0).astype(jnp.float32)
+    av_p = (slot_p >= 0).astype(jnp.float32)
+
+    cur_feats = (pos[:, 0], pos[:, 1], spc, rad, av_c)
+    prev_feats = (ppos[:, 0], ppos[:, 1], pspc, prad, av_p)
+    cells_c = _scatter_feats(p, order_c, dst_c, cur_feats, prev_feats)
+    cells_p = _scatter_feats(p, order_p, dst_p, prev_feats, cur_feats)
+
+    packed_cells_e = kernel(cells_c)  # enter mask, rows = current grid
+    packed_cells_l = kernel(cells_p)  # leave mask, rows = previous grid
+
+    w_words = 9 * LANES // _PACK
+
+    def per_entity(packed_cells, slot):
+        flat = packed_cells.reshape(-1, w_words)
+        safe = jnp.maximum(slot, 0)
+        return jnp.where((slot >= 0)[:, None], flat[safe], 0)
+
+    packed_e = per_entity(packed_cells_e, slot_c)  # i32[N, W]
+    packed_l = per_entity(packed_cells_l, slot_p)
+    n_enters = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
+    n_leaves = jnp.sum(jax.lax.population_count(packed_l)).astype(jnp.int32)
+
+    ep, ei = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0))
+    lp, li = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0))
+    out = _pack_out(p, ep, ei, lp, li, n_enters, n_leaves, dropped_c)
+    # Paging context: everything _drain_bits needs for overflow chunks.
+    enter_ctx = (packed_e, cxc, czc, smc, table_c)
+    leave_ctx = (packed_l, cxp, czp, smp, table_p)
+    return enter_ctx, leave_ctx, out
+
+
+# --- jit wrappers ------------------------------------------------------------
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_step_packed(params: NeighborParams):
-    return jax.jit(functools.partial(_step_packed, params), donate_argnums=(0,))
+def _jitted_step_packed(params: NeighborParams, backend: str):
+    if backend == "jnp":
+        fn = functools.partial(_step_packed_jnp, params)
+    else:
+        fn = functools.partial(
+            _step_pallas, params, backend == "pallas_interpret"
+        )
+    return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_drain(params: NeighborParams):
-    return jax.jit(functools.partial(_drain, params))
+def _jitted_drain_ids(params: NeighborParams):
+    return jax.jit(
+        functools.partial(
+            _drain_ids, n=params.capacity, max_events=params.max_events
+        )
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_drain_bits(params: NeighborParams):
+    return jax.jit(functools.partial(_drain_bits, params))
+
+
+# --- host-facing engine ------------------------------------------------------
 
 
 class PendingStep:
@@ -313,12 +524,11 @@ class PendingStep:
     engine's documented delivery model anyway (batched.py docstring).
     """
 
-    __slots__ = ("_engine", "_enter_ids", "_leave_ids", "_out", "_collected")
+    __slots__ = ("_engine", "_pager", "_out", "_collected")
 
-    def __init__(self, engine: "NeighborEngine", enter_ids, leave_ids, out) -> None:
+    def __init__(self, engine: "NeighborEngine", pager, out) -> None:
         self._engine = engine
-        self._enter_ids = enter_ids
-        self._leave_ids = leave_ids
+        self._pager = pager  # pager(which, remaining, start_flat) -> pairs
         self._out = out
         self._collected = False
         try:
@@ -333,7 +543,7 @@ class PendingStep:
                 raise
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
-        """Fetch (enter_pairs, leave_pairs, overflow); one blocking read."""
+        """Fetch (enter_pairs, leave_pairs, dropped); one blocking read."""
         assert not self._collected, "PendingStep already collected"
         self._collected = True
         eng = self._engine
@@ -341,29 +551,30 @@ class PendingStep:
         e = p.max_events
         out = np.asarray(self._out)  # THE round trip
         n_e, n_l = int(out[0, 0]), int(out[0, 1])
-        overflow, dropped = int(out[1, 0]), int(out[1, 1])
+        dropped = int(out[1, 0])
         enter_last, leave_last = int(out[2, 0]), int(out[2, 1])
         enters = out[3:3 + min(n_e, e)]
         leaves = out[3 + e:3 + e + min(n_l, e)]
         if n_e > e:  # mass-spawn storm: page the rest (rare)
-            more = eng._page_events(self._enter_ids, n_e - e, enter_last + 1)
-            enters = np.concatenate([enters, more])
+            enters = np.concatenate(
+                [enters, self._pager("enter", n_e - e, enter_last + 1)]
+            )
         if n_l > e:
-            more = eng._page_events(self._leave_ids, n_l - e, leave_last + 1)
-            leaves = np.concatenate([leaves, more])
-        eng.last_overflow = overflow
+            leaves = np.concatenate(
+                [leaves, self._pager("leave", n_l - e, leave_last + 1)]
+            )
         eng.last_grid_dropped = dropped
         if dropped:
             from goworld_tpu.utils import gwlog
 
             gwlog.warnf(
-                "AOI grid overflow: %d active entities exceeded cell_capacity=%d "
-                "and are invisible to neighbors this tick; raise cell_capacity "
-                "or space_slots/grid size",
+                "AOI grid overflow: %d active entities exceeded cell_capacity"
+                "=%d and are invisible this tick; raise cell_capacity or "
+                "space_slots/grid size",
                 dropped,
                 p.cell_capacity,
             )
-        return enters, leaves, overflow
+        return enters, leaves, dropped
 
 
 class NeighborEngine:
@@ -373,53 +584,53 @@ class NeighborEngine:
 
         eng = NeighborEngine(NeighborParams(capacity=1024))
         eng.reset()
-        enters, leaves = eng.step(pos, active, space, radius)
+        enters, leaves, dropped = eng.step(pos, active, space, radius)
 
     ``enters`` / ``leaves`` are numpy ``[E, 2]`` arrays of (slot, other_slot)
     pairs — the batched equivalent of the reference's OnEnterAOI/OnLeaveAOI
     callback invocations (Entity.go:227-246).
+
+    ``backend``: "auto" picks the Pallas kernel on TPU and the jnp reference
+    path elsewhere; "pallas_interpret" runs the kernel through the Pallas
+    interpreter (slow — oracle tests only); "jnp" / "pallas" force a path.
     """
 
-    def __init__(self, params: NeighborParams, device: jax.Device | None = None):
+    def __init__(self, params: NeighborParams, backend: str = "auto"):
+        if backend == "auto":
+            backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
+        if backend not in ("jnp", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown backend {backend!r}")
+        if backend != "jnp" and params.cell_capacity > LANES:
+            raise ValueError(
+                f"pallas path supports cell_capacity <= {LANES}, "
+                f"got {params.cell_capacity}"
+            )
         self.params = params
-        self.device = device
-        self._jit_step = _jitted_step(params)
-        self._jit_step_packed = _jitted_step_packed(params)
-        self._jit_drain = _jitted_drain(params)
-        self._neighbors: jax.Array | None = None
-        # Diagnostics from the latest step() (see MatrixStepResult).
+        self.backend = backend
+        self._jit_step = _jitted_step_packed(params, backend)
+        if backend == "jnp":
+            self._jit_drain = _jitted_drain_ids(params)
+        else:
+            self._jit_drain = _jitted_drain_bits(params)
+        self._state: tuple | None = None
         self.last_grid_dropped = 0
-        self.last_overflow = 0
 
     def reset(self) -> None:
-        n, k = self.params.capacity, self.params.max_neighbors
-        arr = jnp.full((n, k), n, dtype=jnp.int32)
-        if self.device is not None:
-            arr = jax.device_put(arr, self.device)
-        self._neighbors = arr
+        """Clear device state: the next step sees an all-inactive previous
+        tick and emits the full enter storm (freeze/restore re-entry)."""
+        n = self.params.capacity
+        self._state = (
+            jnp.zeros((n, 2), jnp.float32),
+            jnp.zeros((n,), jnp.bool_),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.float32),
+        )
 
-    @property
-    def neighbors(self) -> jax.Array:
-        assert self._neighbors is not None, "call reset() first"
-        return self._neighbors
-
-    def step_device(self, pos, active, space, radius) -> MatrixStepResult:
-        """Run one tick; returns device arrays (no host sync)."""
-        assert self._neighbors is not None, "call reset() first"
-        res = self._jit_step(self._neighbors, pos, active, space, radius)
-        self._neighbors = res.neighbors
-        return res
-
-    def _page_events(self, ids: jax.Array, remaining: int, start_flat: int = 0) -> np.ndarray:
-        """Page events out of an id matrix in max_events-sized chunks,
-        starting at flat index ``start_flat`` (used for the overflow tail
-        beyond the packed result's inline buffer)."""
-        if remaining <= 0:
-            return np.empty((0, 2), np.int32)
+    def _page(self, ctx, remaining: int, start_flat: int) -> np.ndarray:
         chunks = []
         start = jnp.int32(start_flat)
         while remaining > 0:
-            pairs, idx = self._jit_drain(ids, start)
+            pairs, idx = self._jit_drain(*ctx, start_flat=start)
             take = min(self.params.max_events, remaining)
             chunks.append(np.asarray(pairs[:take]))
             remaining -= take
@@ -436,21 +647,39 @@ class NeighborEngine:
     ) -> PendingStep:
         """Dispatch one tick without blocking; collect() fetches the events.
 
-        The neighbor state advances immediately, so back-to-back step_async
-        calls pipeline: tick t+1 computes while tick t's packed result is in
+        State advances immediately, so back-to-back step_async calls
+        pipeline: tick t+1 computes while tick t's packed result is in
         flight to the host.
         """
-        assert self._neighbors is not None, "call reset() first"
-        self._check_radius(radius, active)
-        neighbors, enter_ids, leave_ids, out = self._jit_step_packed(
-            self._neighbors,
-            jnp.asarray(pos, jnp.float32),
-            jnp.asarray(active, jnp.bool_),
-            jnp.asarray(space, jnp.int32),
-            jnp.asarray(radius, jnp.float32),
+        assert self._state is not None, "call reset() first"
+        check_radius(self.params, radius, active)
+        # jnp.array (not asarray): the arrays become next tick's PREVIOUS
+        # state, so they must not alias the caller's numpy buffers — on the
+        # CPU backend a zero-copy view would silently mutate history when
+        # game code updates positions in place.
+        cur = (
+            jnp.array(pos, jnp.float32),
+            jnp.array(active, jnp.bool_),
+            jnp.array(space, jnp.int32),
+            jnp.array(radius, jnp.float32),
         )
-        self._neighbors = neighbors
-        return PendingStep(self, enter_ids, leave_ids, out)
+        if self.backend == "jnp":
+            enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
+            n = self.params.capacity
+
+            def pager(which, remaining, start):
+                ids = enter_ids if which == "enter" else leave_ids
+                return self._page((ids,), remaining, start)
+
+        else:
+            enter_ctx, leave_ctx, out = self._jit_step(*self._state, *cur)
+
+            def pager(which, remaining, start):
+                ctx = enter_ctx if which == "enter" else leave_ctx
+                return self._page(ctx, remaining, start)
+
+        self._state = cur
+        return PendingStep(self, pager, out)
 
     def step(
         self,
@@ -459,16 +688,13 @@ class NeighborEngine:
         space: np.ndarray,
         radius: np.ndarray,
     ) -> tuple[np.ndarray, np.ndarray, int]:
-        """Run one tick; returns (enter_pairs, leave_pairs, overflow) on host.
+        """Run one tick; returns (enter_pairs, leave_pairs, dropped) on host.
 
         One upload batch + ONE blocking readback (the packed result); event
         counts are still unbounded — a mass spawn's "enter storm" pages extra
         chunks beyond the inline max_events.
         """
         return self.step_async(pos, active, space, radius).collect()
-
-    def _check_radius(self, radius: np.ndarray, active: np.ndarray) -> None:
-        check_radius(self.params, radius, active)
 
 
 def check_radius(params: NeighborParams, radius: np.ndarray, active: np.ndarray) -> None:

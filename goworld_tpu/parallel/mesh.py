@@ -4,15 +4,22 @@ The reference scales by sharding entities/spaces across game processes, with
 no cross-process AOI at all (SURVEY.md §5.7: AOI is strictly per-Space,
 per-game). The TPU-native design goes further: entity slots are sharded over
 a mesh axis; each tick, **positions are all-gathered over ICI** so every
-device sees the whole world, then each device computes neighbor sets and
-enter/leave diffs only for the slots it owns. This is the "sequence
-parallelism" of this domain (BASELINE.json config 5: 1M entities, 8 game
-processes → v5e-16 pod).
+device sees the whole world, then each device computes the enter/leave event
+diffs only for the entity rows it owns (the same event-native two-grid
+pairwise formulation as ops/neighbor.py — exact sets, no truncation). This
+is the "sequence parallelism" of this domain (BASELINE.json config 5: 1M
+entities, 8 game processes → v5e-16 pod).
 
-Communication per tick = one all-gather of [N, 2] f32 positions + [N] masks
-(~1 MB at 100k entities) — rides ICI, far below its bandwidth. Grid build is
-replicated per device (cheap: one sort of N keys); the O(N·9M) candidate math
-— the actual FLOPs — is perfectly sharded.
+Communication per tick = one all-gather of the per-entity feature arrays
+(~1 MB at 100k entities) — rides ICI, far below its bandwidth. Grid builds
+are replicated per device (cheap: one sort of N keys each); the O(N·9M)
+candidate math — the actual FLOPs — is perfectly sharded on query rows.
+
+Host interface parity with the single-device engine (round-2 upgrade):
+``step_async`` dispatches without blocking and ``collect()`` performs
+exactly ONE blocking device→host read — every shard packs its header +
+inline event pairs into one stacked ``[D * (3 + 2E), 2]`` buffer. Event
+storms beyond the inline budget page through per-shard chunked drains.
 
 Collectives are XLA's (all_gather inside shard_map); there is no NCCL/MPI
 analog to port — the reference's TCP star stays the control plane
@@ -29,13 +36,13 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from goworld_tpu.ops.neighbor import (
-    MatrixStepResult,
     NeighborParams,
-    _bucket_of,
-    _build_grid,
-    _jitted_drain,
-    _neighbor_sets,
-    _row_membership,
+    _bins,
+    _build_table,
+    _drain_ids,
+    _epoch_mask,
+    _gather_cands,
+    check_radius,
 )
 
 SHARD_AXIS = "shard"
@@ -68,84 +75,179 @@ def make_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh
 
 def _sharded_step(
     p: NeighborParams,
-    prev_nb: jax.Array,  # i32[chunk, K] this shard's previous neighbor lists
-    pos_l: jax.Array,  # f32[chunk, 2] this shard's positions
-    active_l: jax.Array,
-    space_l: jax.Array,
-    radius_l: jax.Array,
-) -> MatrixStepResult:
-    """Per-shard body run under shard_map."""
+    events_inline: int,  # per-shard inline event budget E
+    ppos_l, pact_l, pspc_l, prad_l,  # this shard's previous-tick rows
+    pos_l, act_l, spc_l, rad_l,  # this shard's current-tick rows
+):
+    """Per-shard body run under shard_map. Returns
+    (enter_ids [chunk, 9M], leave_ids [chunk, 9M], out [3+2E, 2])."""
     n = p.capacity
+    m = p.cell_capacity
     chunk = pos_l.shape[0]
     shard = jax.lax.axis_index(SHARD_AXIS)
     q_ids = shard * chunk + jnp.arange(chunk, dtype=jnp.int32)
 
-    # ICI all-gather: full world view on every device.
-    pos = jax.lax.all_gather(pos_l, SHARD_AXIS, tiled=True)  # [N, 2]
-    active = jax.lax.all_gather(active_l, SHARD_AXIS, tiled=True)
-    space = jax.lax.all_gather(space_l, SHARD_AXIS, tiled=True)
-
-    cx = jnp.floor(pos[:, 0] / p.cell_size).astype(jnp.int32)
-    cz = jnp.floor(pos[:, 1] / p.cell_size).astype(jnp.int32)
-    bucket = _bucket_of(p, cx, cz, space)
-    grid, grid_dropped = _build_grid(p, bucket, active)
-
-    neighbors, overflow = _neighbor_sets(
-        p, grid, pos, active, space, q_ids, pos_l, active_l, space_l, radius_l
+    # ICI all-gather: full world view of both epochs on every device.
+    gather = lambda x: jax.lax.all_gather(x, SHARD_AXIS, tiled=True)  # noqa: E731
+    pos, act, spc, rad = gather(pos_l), gather(act_l), gather(spc_l), gather(rad_l)
+    ppos, pact, pspc, prad = (
+        gather(ppos_l), gather(pact_l), gather(pspc_l), gather(prad_l),
     )
 
-    entered = ~_row_membership(prev_nb, neighbors, n) & (neighbors < n)
-    left = ~_row_membership(neighbors, prev_nb, n) & (prev_nb < n)
+    cxc, czc, smc = _bins(p, pos, spc)
+    cxp, czp, smp = _bins(p, ppos, pspc)
+    buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
+    buc_p = (smp * p.grid_z + czp) * p.grid_x + cxp
+    # Replicated table builds (one N-key sort each); identical on all shards.
+    table_c, slot_c, dropped_c, _, _ = _build_table(p, buc_c, act, m)
+    table_p, slot_p, _, _, _ = _build_table(p, buc_p, pact, m)
+    av_c = slot_c >= 0
+    av_p = slot_p >= 0
 
-    # Event matrices with global ids in non-event slots = sentinel n; the host
-    # drains them in chunks exactly like the single-device engine (the [N, K]
-    # event matrices are sharded on rows, so flat indices stay global).
-    enter_ids = jnp.where(entered, neighbors, n)
-    leave_ids = jnp.where(left, prev_nb, n)
-    n_enters = jnp.sum(entered).astype(jnp.int32)
-    n_leaves = jnp.sum(left).astype(jnp.int32)
-    # grid_dropped is identical on every shard (computed from the all-gathered
-    # world); divide after psum-free sum on host instead of psumming here.
-    return MatrixStepResult(
-        neighbors,
-        enter_ids,
-        leave_ids,
-        n_enters[None],
-        n_leaves[None],
-        overflow[None],
-        grid_dropped[None],
-    )
+    lo = shard * chunk
+    sl = lambda x: jax.lax.dynamic_slice_in_dim(x, lo, chunk)  # noqa: E731
+    sl2 = lambda x: jax.lax.dynamic_slice_in_dim(x, lo, chunk, axis=0)  # noqa: E731
+
+    # Enter pass: candidates from the current grid, this shard's queries.
+    cand_c = _gather_cands(p, table_c, sl(cxc), sl(czc), sl(smc))
+    vc = _epoch_mask(p, cand_c, q_ids, sl2(pos), sl(av_c), sl(spc), sl(rad),
+                     pos, av_c, spc)
+    vp_on_c = _epoch_mask(p, cand_c, q_ids, sl2(ppos), sl(av_p), sl(pspc),
+                          sl(prad), ppos, av_p, pspc)
+    enter_mask = vc & ~vp_on_c
+
+    # Leave pass: candidates from the previous grid.
+    cand_p = _gather_cands(p, table_p, sl(cxp), sl(czp), sl(smp))
+    vp = _epoch_mask(p, cand_p, q_ids, sl2(ppos), sl(av_p), sl(pspc), sl(prad),
+                     ppos, av_p, pspc)
+    vc_on_p = _epoch_mask(p, cand_p, q_ids, sl2(pos), sl(av_c), sl(spc),
+                          sl(rad), pos, av_c, spc)
+    leave_mask = vp & ~vc_on_p
+
+    enter_ids = jnp.where(enter_mask, cand_c, n)
+    leave_ids = jnp.where(leave_mask, cand_p, n)
+    n_enters = jnp.sum(enter_mask).astype(jnp.int32)
+    n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
+
+    def globalize(pairs):
+        ent = pairs[:, 0]
+        ent = jnp.where(ent < chunk, ent + lo, n)
+        return jnp.stack([ent, pairs[:, 1]], axis=1)
+
+    ep, ei = _drain_ids(enter_ids, n, events_inline, jnp.int32(0))
+    lp, li = _drain_ids(leave_ids, n, events_inline, jnp.int32(0))
+    header = jnp.stack(
+        [
+            jnp.stack([n_enters, n_leaves]),
+            jnp.stack([dropped_c, jnp.int32(0)]),
+            jnp.stack([ei[events_inline - 1], li[events_inline - 1]]),
+        ]
+    ).astype(jnp.int32)
+    out = jnp.concatenate([header, globalize(ep), globalize(lp)], axis=0)
+    return enter_ids, leave_ids, out
+
+
+def _sharded_drain(
+    p: NeighborParams, events_inline: int, chunk: int,
+    ids_l: jax.Array,  # [chunk, 9M] this shard's event-id matrix
+    start_l: jax.Array,  # [1] this shard's resume cursor (local flat index)
+):
+    n = p.capacity
+    shard = jax.lax.axis_index(SHARD_AXIS)
+    pairs, idx = _drain_ids(ids_l, n, events_inline, start_l[0])
+    ent = jnp.where(pairs[:, 0] < chunk, pairs[:, 0] + shard * chunk, n)
+    pairs = jnp.stack([ent, pairs[:, 1]], axis=1)
+    return pairs, idx[None]
 
 
 @functools.lru_cache(maxsize=None)
-def _jitted_sharded_step(params: NeighborParams, mesh: Mesh):
+def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int):
     from jax import shard_map
 
-    body = functools.partial(_sharded_step, params)
+    body = functools.partial(_sharded_step, params, events_inline)
     spec = P(SHARD_AXIS)
     mapped = shard_map(
         body,
         mesh=mesh,
-        in_specs=(spec, spec, spec, spec, spec),
-        out_specs=MatrixStepResult(
-            neighbors=spec,
-            enter_ids=spec,
-            leave_ids=spec,
-            n_enters=spec,
-            n_leaves=spec,
-            overflow=spec,
-            grid_dropped=spec,
-        ),
+        in_specs=(spec,) * 8,
+        out_specs=(spec, spec, spec),
     )
-    return jax.jit(mapped, donate_argnums=(0,))
+    return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_sharded_drain(
+    params: NeighborParams, mesh: Mesh, events_inline: int, chunk: int
+):
+    from jax import shard_map
+
+    body = functools.partial(_sharded_drain, params, events_inline, chunk)
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
+    )
+    return jax.jit(mapped)
+
+
+class ShardedPendingStep:
+    """In-flight sharded tick; ``collect()`` = ONE blocking host read of the
+    stacked per-shard packed buffers, then (rare) storm paging."""
+
+    __slots__ = ("_engine", "_enter_ids", "_leave_ids", "_out", "_collected")
+
+    def __init__(self, engine, enter_ids, leave_ids, out) -> None:
+        self._engine = engine
+        self._enter_ids = enter_ids
+        self._leave_ids = leave_ids
+        self._out = out
+        self._collected = False
+        try:
+            out.copy_to_host_async()
+        except NotImplementedError:
+            pass
+        except jax.errors.JaxRuntimeError as err:
+            if "unimplemented" not in str(err).lower():
+                raise
+
+    def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
+        assert not self._collected, "ShardedPendingStep already collected"
+        self._collected = True
+        eng = self._engine
+        e = eng.events_inline
+        block = 3 + 2 * e
+        out = np.asarray(self._out)  # THE round trip
+        enters, leaves = [], []
+        enter_deficit = np.zeros(eng.n_devices, np.int64)
+        leave_deficit = np.zeros(eng.n_devices, np.int64)
+        enter_starts = np.zeros(eng.n_devices, np.int32)
+        leave_starts = np.zeros(eng.n_devices, np.int32)
+        dropped = 0
+        for d in range(eng.n_devices):
+            o = out[d * block:(d + 1) * block]
+            n_e, n_l = int(o[0, 0]), int(o[0, 1])
+            dropped = int(o[1, 0])  # replicated diagnostic, same on all
+            enters.append(o[3:3 + min(n_e, e)])
+            leaves.append(o[3 + e:3 + e + min(n_l, e)])
+            enter_deficit[d] = max(0, n_e - e)
+            leave_deficit[d] = max(0, n_l - e)
+            enter_starts[d] = int(o[2, 0]) + 1
+            leave_starts[d] = int(o[2, 1]) + 1
+        if enter_deficit.any():
+            enters += eng._page(self._enter_ids, enter_deficit, enter_starts)
+        if leave_deficit.any():
+            leaves += eng._page(self._leave_ids, leave_deficit, leave_starts)
+        eng.last_grid_dropped = dropped
+        return (
+            np.concatenate(enters) if enters else np.empty((0, 2), np.int32),
+            np.concatenate(leaves) if leaves else np.empty((0, 2), np.int32),
+            dropped,
+        )
 
 
 class ShardedNeighborEngine:
-    """Multi-device AOI engine: same semantics as NeighborEngine, with entity
-    slots sharded over a mesh. Slot i lives on device i // (N / D).
-
-    Event results come back as D per-shard blocks; ``step`` flattens them.
-    """
+    """Multi-device AOI engine: same semantics and event stream as the
+    single-device jnp path, with entity rows sharded over a mesh.
+    Slot i lives on device i // (N / D)."""
 
     def __init__(self, params: NeighborParams, mesh: Mesh):
         n_dev = mesh.devices.size
@@ -153,46 +255,82 @@ class ShardedNeighborEngine:
             raise ValueError(
                 f"capacity {params.capacity} must be a multiple of 8*{n_dev}"
             )
+        if params.max_events % n_dev != 0:
+            raise ValueError(
+                f"max_events {params.max_events} must be divisible by {n_dev}"
+            )
         self.params = params
         self.mesh = mesh
         self.n_devices = n_dev
-        self._jit_step = _jitted_sharded_step(params, mesh)
-        self._jit_drain = _jitted_drain(params)
+        self.chunk = params.capacity // n_dev
+        # Inline budget per shard; total inline capacity stays max_events.
+        self.events_inline = params.max_events // n_dev
+        self._jit_step = _jitted_sharded_step(params, mesh, self.events_inline)
+        self._jit_drain = _jitted_sharded_drain(
+            params, mesh, self.events_inline, self.chunk
+        )
         self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
-        self._neighbors: jax.Array | None = None
+        self._state: tuple | None = None
+        self.last_grid_dropped = 0
 
     def reset(self) -> None:
-        n, k = self.params.capacity, self.params.max_neighbors
-        self._neighbors = jax.device_put(
-            jnp.full((n, k), n, dtype=jnp.int32), self._sharding
-        )
-
-    def step_device(self, pos, active, space, radius) -> MatrixStepResult:
-        assert self._neighbors is not None, "call reset() first"
+        n = self.params.capacity
         put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
-        res = self._jit_step(
-            self._neighbors, put(pos), put(active), put(space), put(radius)
+        self._state = (
+            put(jnp.zeros((n, 2), jnp.float32)),
+            put(jnp.zeros((n,), jnp.bool_)),
+            put(jnp.zeros((n,), jnp.int32)),
+            put(jnp.zeros((n,), jnp.float32)),
         )
-        self._neighbors = res.neighbors
-        return res
 
-    def _drain_all(self, ids: jax.Array, total: int) -> np.ndarray:
-        """Chunked event drain, identical semantics to NeighborEngine: the
-        [N, K] event matrix is row-sharded, so global flat indices page
-        through all shards in order."""
-        if total == 0:
-            return np.empty((0, 2), np.int32)
-        chunks = []
-        start = jnp.int32(0)
-        remaining = total
-        while remaining > 0:
-            pairs, idx = self._jit_drain(ids, start)
-            take = min(self.params.max_events, remaining)
-            chunks.append(np.asarray(pairs[:take]))
-            remaining -= take
-            if remaining > 0:
-                start = idx[take - 1] + 1
-        return np.concatenate(chunks)
+    def _page(
+        self, ids: jax.Array, deficit: np.ndarray, starts: np.ndarray
+    ) -> list[np.ndarray]:
+        """Per-shard chunked drain for events beyond the inline budget."""
+        chunks: list[np.ndarray] = []
+        starts = starts.copy()
+        deficit = deficit.copy()
+        while deficit.any():
+            pairs, idx = self._jit_drain(
+                ids, jax.device_put(jnp.asarray(starts), self._sharding)
+            )
+            pairs = np.asarray(pairs)
+            idx = np.asarray(idx)
+            e = self.events_inline
+            for d in range(self.n_devices):
+                take = int(min(e, deficit[d]))
+                if take <= 0:
+                    continue
+                chunks.append(pairs[d * e:d * e + take])
+                deficit[d] -= take
+                if deficit[d] > 0:
+                    starts[d] = idx[d, take - 1] + 1
+                else:
+                    starts[d] = self.chunk * 9 * self.params.cell_capacity
+        return chunks
+
+    def step_async(
+        self,
+        pos: np.ndarray,
+        active: np.ndarray,
+        space: np.ndarray,
+        radius: np.ndarray,
+    ) -> ShardedPendingStep:
+        """Dispatch one tick without blocking (parity with NeighborEngine)."""
+        assert self._state is not None, "call reset() first"
+        check_radius(self.params, radius, active)
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        # jnp.array (not asarray): state must not alias caller buffers — see
+        # NeighborEngine.step_async.
+        cur = (
+            put(jnp.array(pos, jnp.float32)),
+            put(jnp.array(active, jnp.bool_)),
+            put(jnp.array(space, jnp.int32)),
+            put(jnp.array(radius, jnp.float32)),
+        )
+        enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
+        self._state = cur
+        return ShardedPendingStep(self, enter_ids, leave_ids, out)
 
     def step(
         self,
@@ -201,18 +339,5 @@ class ShardedNeighborEngine:
         space: np.ndarray,
         radius: np.ndarray,
     ) -> tuple[np.ndarray, np.ndarray, int]:
-        """Run one tick; returns host (enter_pairs, leave_pairs, overflow)."""
-        from goworld_tpu.ops.neighbor import check_radius
-
-        check_radius(self.params, radius, active)
-        res = self.step_device(
-            jnp.asarray(pos, jnp.float32),
-            jnp.asarray(active, jnp.bool_),
-            jnp.asarray(space, jnp.int32),
-            jnp.asarray(radius, jnp.float32),
-        )
-        n_e = int(np.sum(np.asarray(res.n_enters)))
-        n_l = int(np.sum(np.asarray(res.n_leaves)))
-        enters = self._drain_all(res.enter_ids, n_e)
-        leaves = self._drain_all(res.leave_ids, n_l)
-        return enters, leaves, int(np.sum(np.asarray(res.overflow)))
+        """Run one tick; returns host (enter_pairs, leave_pairs, dropped)."""
+        return self.step_async(pos, active, space, radius).collect()

@@ -279,7 +279,7 @@ def _setup_batched():
 
     em.runtime.aoi_backend = "batched"
     em.runtime.aoi_params = NeighborParams(
-        capacity=64, max_neighbors=16, cell_size=100.0, grid_x=8, grid_z=8,
+        capacity=64, cell_size=100.0, grid_x=8, grid_z=8,
         space_slots=4, cell_capacity=16, max_events=512,
     )
 

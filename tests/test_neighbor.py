@@ -1,16 +1,21 @@
 """Correctness tests for the batched AOI neighbor engine.
 
 The oracle is a brute-force O(N^2) numpy computation of the same interest
-semantics: entity j is in entity i's set iff both active, same space, j != i,
-and dist(i,j) <= radius_i. This mirrors how the reference's AOI behavior is
-pinned by its CPU implementation (SURVEY.md §7.2 step 7: "correctness oracle =
-CPU manager on identical traces").
+semantics: entity j is in entity i's set iff both active (and grid-visible),
+same space, j != i, and dist(i,j) <= radius_i. This mirrors how the
+reference's AOI behavior is pinned by its CPU implementation (SURVEY.md §7.2
+step 7: "correctness oracle = CPU manager on identical traces").
+
+The engine is event-native (exact geometric sets, no max_neighbors
+truncation): host-side sets are reconstructed incrementally from the
+enter/leave stream and compared to the oracle each tick.
 """
 
 import numpy as np
 import pytest
 
 from goworld_tpu.ops import NeighborEngine, NeighborParams
+from goworld_tpu.ops.neighbor import LANES
 
 
 def brute_force_sets(pos, active, space, radius):
@@ -38,6 +43,13 @@ def pairs_to_setlist(pairs, n):
     return out
 
 
+def apply_events(cur, enters, leaves):
+    for a, b in leaves:
+        cur[int(a)].discard(int(b))
+    for a, b in enters:
+        cur[int(a)].add(int(b))
+
+
 def make_world(n, n_active, seed, world=1000.0, n_spaces=1):
     rng = np.random.default_rng(seed)
     pos = rng.uniform(0, world, size=(n, 2)).astype(np.float32)
@@ -49,13 +61,13 @@ def make_world(n, n_active, seed, world=1000.0, n_spaces=1):
 
 
 PARAMS = NeighborParams(
-    capacity=256, max_neighbors=64, cell_size=100.0, grid_x=16, grid_z=16,
+    capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
     space_slots=4, cell_capacity=64, max_events=16384,
 )
 
 
-def engine():
-    e = NeighborEngine(PARAMS)
+def engine(backend="jnp"):
+    e = NeighborEngine(PARAMS, backend=backend)
     e.reset()
     return e
 
@@ -63,9 +75,9 @@ def engine():
 def test_first_tick_all_enters():
     eng = engine()
     pos, active, space, radius = make_world(256, 200, seed=0)
-    enters, leaves, overflow = eng.step(pos, active, space, radius)
+    enters, leaves, dropped = eng.step(pos, active, space, radius)
     assert len(leaves) == 0
-    assert overflow == 0
+    assert dropped == 0
     got = pairs_to_setlist(enters, 256)
     want = brute_force_sets(pos, active, space, radius)
     assert got == want
@@ -79,14 +91,26 @@ def test_incremental_diffs_match_oracle():
     for tick in range(10):
         pos = pos + rng.normal(0, 15, size=pos.shape).astype(np.float32)
         pos = np.clip(pos, 0, 1500).astype(np.float32)
-        enters, leaves, overflow = eng.step(pos, active, space, radius)
-        assert overflow == 0
-        for a, b in leaves:
-            cur[int(a)].discard(int(b))
-        for a, b in enters:
-            cur[int(a)].add(int(b))
+        enters, leaves, dropped = eng.step(pos, active, space, radius)
+        assert dropped == 0
+        apply_events(cur, enters, leaves)
         want = brute_force_sets(pos, active, space, radius)
         assert cur == want, f"tick {tick} mismatch"
+
+
+def test_teleports_are_exact():
+    """Unbounded per-tick movement (EnterSpace / cross-game migration lands
+    an entity anywhere): the two-grid formulation must emit exact diffs."""
+    eng = engine()
+    rng = np.random.default_rng(7)
+    pos, active, space, radius = make_world(256, 200, seed=7, world=1500.0)
+    cur = [set() for _ in range(256)]
+    for tick in range(6):
+        pos = rng.uniform(0, 1500, size=pos.shape).astype(np.float32)  # all teleport
+        enters, leaves, _ = eng.step(pos, active, space, radius)
+        apply_events(cur, enters, leaves)
+        want = brute_force_sets(pos, active, space, radius)
+        assert cur == want, f"teleport tick {tick} mismatch"
 
 
 def test_space_isolation():
@@ -154,19 +178,23 @@ def test_wraparound_no_false_neighbors():
     assert len(enters) == 0
 
 
-def test_overflow_reported():
+def test_no_truncation_exact_sets():
+    """Round-1's engine capped interest sets at max_neighbors (lowest-id-K);
+    the event-native engine has no cap: 255 true neighbors all reported."""
     p = NeighborParams(
-        capacity=256, max_neighbors=8, cell_size=100.0, grid_x=16, grid_z=16,
-        space_slots=4, cell_capacity=64, max_events=16384,
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=256, max_events=131072,
     )
-    eng = NeighborEngine(p)
+    eng = NeighborEngine(p, backend="jnp")
     eng.reset()
     pos = np.zeros((256, 2), np.float32)
     active = np.ones(256, bool)
     space = np.zeros(256, np.int32)
     radius = np.full(256, 100.0, np.float32)
-    _, _, overflow = eng.step(pos, active, space, radius)
-    assert overflow == 256  # every entity has 255 > 8 true neighbors
+    enters, _, dropped = eng.step(pos, active, space, radius)
+    assert dropped == 0
+    got = pairs_to_setlist(enters, 256)
+    assert all(len(got[i]) == 255 for i in range(256))
 
 
 def test_negative_coordinates():
@@ -183,10 +211,10 @@ def test_chunked_drain_small_buffer():
     """max_events far below the first-tick enter storm: chunked drain must
     still deliver every event exactly once."""
     p = NeighborParams(
-        capacity=256, max_neighbors=64, cell_size=100.0, grid_x=16, grid_z=16,
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64, max_events=64,
     )
-    eng = NeighborEngine(p)
+    eng = NeighborEngine(p, backend="jnp")
     eng.reset()
     pos, active, space, radius = make_world(256, 200, seed=0)
     enters, leaves, _ = eng.step(pos, active, space, radius)
@@ -209,17 +237,74 @@ def test_grid_capacity_drop_reported():
     """More entities in one cell than cell_capacity: dropped count surfaces
     via the engine diagnostics (entities become invisible, never silently)."""
     p = NeighborParams(
-        capacity=256, max_neighbors=256, cell_size=100.0, grid_x=16, grid_z=16,
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=16, max_events=65536,
     )
-    eng = NeighborEngine(p)
+    eng = NeighborEngine(p, backend="jnp")
     eng.reset()
     pos = np.full((256, 2), 50.0, np.float32)  # all in one cell
     active = np.ones(256, bool)
     space = np.zeros(256, np.int32)
     radius = np.full(256, 90.0, np.float32)
-    eng.step(pos, active, space, radius)
-    assert eng.last_grid_dropped == 256 - 16  # cell holds 16 of 256
+    _, _, dropped = eng.step(pos, active, space, radius)
+    assert dropped == 256 - 16  # cell holds 16 of 256
+    assert eng.last_grid_dropped == 240
+
+
+def test_drop_window_event_consistency():
+    """Entities dropped by cell overflow are invisible (validity includes
+    grid visibility), and the event stream must remain consistent across the
+    drop window: host sets reconstructed from events always equal the
+    oracle-with-visibility, with no stale pairs left behind."""
+    p = NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=8, grid_z=8,
+        space_slots=2, cell_capacity=8, max_events=16384,
+    )
+    eng = NeighborEngine(p, backend="jnp")
+    eng.reset()
+    rng = np.random.default_rng(11)
+    n = 64
+    active = np.ones(n, bool)
+    space = np.zeros(n, np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    pos = rng.uniform(0, 800, (n, 2)).astype(np.float32)
+    cur = [set() for _ in range(n)]
+    saw_drop = False
+    for tick in range(12):
+        if tick % 3 == 1:
+            # Cram half the world into one cell → guaranteed overflow.
+            pos[: n // 2] = rng.uniform(10, 90, (n // 2, 2)).astype(np.float32)
+        else:
+            pos = rng.uniform(0, 800, (n, 2)).astype(np.float32)
+        enters, leaves, dropped = eng.step(pos, active, space, radius)
+        saw_drop |= dropped > 0
+        apply_events(cur, enters, leaves)
+        # Oracle with visibility: recompute which entities made it into the
+        # grid (stable argsort order = first-come per cell).
+        vis = _visible_mask(p, pos, active, space)
+        want = brute_force_sets(pos, vis, space, radius)
+        assert cur == want, f"tick {tick}: stale/missing pairs after drops"
+    assert saw_drop, "test never exercised a drop window"
+
+
+def _visible_mask(p, pos, active, space):
+    """Replicates the engine's deterministic first-come-per-cell visibility."""
+    cx = np.floor(pos[:, 0] / p.cell_size).astype(int) % p.grid_x
+    cz = np.floor(pos[:, 1] / p.cell_size).astype(int) % p.grid_z
+    sm = space % p.space_slots
+    bucket = (sm * p.grid_z + cz) * p.grid_x + cx
+    vis = np.zeros(len(pos), bool)
+    counts: dict[int, int] = {}
+    order = np.argsort(np.where(active, bucket, p.num_buckets), kind="stable")
+    for i in order:
+        if not active[i]:
+            continue
+        b = int(bucket[i])
+        c = counts.get(b, 0)
+        if c < p.cell_capacity:
+            vis[i] = True
+            counts[b] = c + 1
+    return vis
 
 
 def test_determinism():
@@ -245,10 +330,91 @@ def test_step_async_pipeline_matches_sync():
         sync_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
         nxt = eng_pipe.step_async(pos, active, space, radius)
         if pending is not None:
-            enters, leaves, _ = pending.collect()
-            pipe_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
+            e2, l2, _ = pending.collect()
+            pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
         pending = nxt
-        pos = pos + vel
-    enters, leaves, _ = pending.collect()
-    pipe_stream.append((sorted(map(tuple, enters)), sorted(map(tuple, leaves))))
-    assert pipe_stream == sync_stream
+        pos = np.clip(pos + vel, 0, 1500).astype(np.float32)
+    e2, l2, _ = pending.collect()
+    pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+    assert sync_stream == pipe_stream
+
+
+# --- Pallas path (interpret mode = the kernel itself, CPU-executed) ---------
+
+PALLAS_PARAMS = NeighborParams(
+    capacity=128, cell_size=100.0, grid_x=4, grid_z=4,
+    space_slots=2, cell_capacity=64, max_events=8192,
+)
+
+
+def test_pallas_kernel_matches_jnp_reference():
+    e1 = NeighborEngine(PALLAS_PARAMS, backend="jnp")
+    e2 = NeighborEngine(PALLAS_PARAMS, backend="pallas_interpret")
+    e1.reset()
+    e2.reset()
+    rng = np.random.default_rng(2)
+    pos = rng.uniform(0, 400, (128, 2)).astype(np.float32)
+    active = np.zeros(128, bool)
+    active[:100] = True
+    space = rng.integers(0, 2, 128).astype(np.int32)
+    radius = np.full(128, 100.0, np.float32)
+
+    def canon(pairs):
+        return sorted(map(tuple, np.asarray(pairs).tolist()))
+
+    for tick in range(4):
+        pos = np.clip(
+            pos + rng.normal(0, 20, pos.shape).astype(np.float32), 0, 400
+        ).astype(np.float32)
+        a1 = e1.step(pos, active, space, radius)
+        a2 = e2.step(pos, active, space, radius)
+        assert canon(a1[0]) == canon(a2[0]), f"tick {tick} enters differ"
+        assert canon(a1[1]) == canon(a2[1]), f"tick {tick} leaves differ"
+        assert a1[2] == a2[2], f"tick {tick} dropped differ"
+
+
+def test_pallas_kernel_oracle_and_drops():
+    """Pallas path against the brute-force oracle, including an overflow
+    tick (cell_capacity < occupants) where both paths must agree on the
+    visibility-folded semantics."""
+    p = NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=4, grid_z=4,
+        space_slots=2, cell_capacity=8, max_events=8192,
+    )
+    e1 = NeighborEngine(p, backend="jnp")
+    e2 = NeighborEngine(p, backend="pallas_interpret")
+    e1.reset()
+    e2.reset()
+    rng = np.random.default_rng(5)
+    active = np.ones(64, bool)
+    space = np.zeros(64, np.int32)
+    radius = np.full(64, 80.0, np.float32)
+    cur = [set() for _ in range(64)]
+    saw_drop = False
+    for tick in range(6):
+        if tick == 2:
+            pos = np.full((64, 2), 50.0, np.float32)  # everyone in one cell
+        else:
+            pos = rng.uniform(0, 400, (64, 2)).astype(np.float32)
+        a1 = e1.step(pos, active, space, radius)
+        a2 = e2.step(pos, active, space, radius)
+        saw_drop |= a1[2] > 0
+        assert sorted(map(tuple, a1[0].tolist())) == sorted(map(tuple, a2[0].tolist()))
+        assert sorted(map(tuple, a1[1].tolist())) == sorted(map(tuple, a2[1].tolist()))
+        assert a1[2] == a2[2]
+        apply_events(cur, a1[0], a1[1])
+        vis = _visible_mask(p, pos, active, space)
+        want = brute_force_sets(pos, vis, space, radius)
+        assert cur == want, f"tick {tick}"
+    assert saw_drop
+
+
+def test_pallas_cell_capacity_cap():
+    with pytest.raises(ValueError, match="cell_capacity"):
+        NeighborEngine(
+            NeighborParams(
+                capacity=64, cell_size=100.0, grid_x=4, grid_z=4,
+                space_slots=2, cell_capacity=LANES + 1, max_events=64,
+            ),
+            backend="pallas_interpret",
+        )

@@ -10,7 +10,7 @@ from goworld_tpu.ops import NeighborEngine, NeighborParams
 from goworld_tpu.parallel import ShardedNeighborEngine, make_mesh
 
 PARAMS = NeighborParams(
-    capacity=512, max_neighbors=32, cell_size=100.0, grid_x=16, grid_z=16,
+    capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
     space_slots=4, cell_capacity=64, max_events=8192,
 )
 
@@ -34,7 +34,7 @@ def to_sets(pairs, n):
 
 def test_sharded_matches_single_device():
     mesh = make_mesh(8)
-    single = NeighborEngine(PARAMS)
+    single = NeighborEngine(PARAMS, backend="jnp")
     sharded = ShardedNeighborEngine(PARAMS, mesh)
     single.reset()
     sharded.reset()
@@ -45,32 +45,49 @@ def test_sharded_matches_single_device():
         pos = np.clip(
             pos + rng.normal(0, 20, pos.shape), 0, 1500
         ).astype(np.float32)
-        e1, l1, o1 = single.step(pos, active, space, radius)
-        e2, l2, o2 = sharded.step(pos, active, space, radius)
+        e1, l1, d1 = single.step(pos, active, space, radius)
+        e2, l2, d2 = sharded.step(pos, active, space, radius)
         assert to_sets(e1, 512) == to_sets(e2, 512), f"enters differ @ tick {tick}"
         assert to_sets(l1, 512) == to_sets(l2, 512), f"leaves differ @ tick {tick}"
-        assert o1 == o2
+        assert d1 == d2
 
 
-def test_sharded_neighbor_state_matches():
+def test_sharded_pipeline_matches_sync():
+    """step_async pipelining (round-2 parity with the single-device engine):
+    depth-2 dispatch/collect must produce the same event stream, with one
+    packed readback per collect."""
     mesh = make_mesh(8)
-    single = NeighborEngine(PARAMS)
-    sharded = ShardedNeighborEngine(PARAMS, mesh)
-    single.reset()
-    sharded.reset()
-    pos, active, space, radius = make_world(512, 512, seed=9)
-    single.step(pos, active, space, radius)
-    sharded.step(pos, active, space, radius)
-    assert np.array_equal(np.asarray(single.neighbors), np.asarray(sharded._neighbors))
+    eng_sync = ShardedNeighborEngine(PARAMS, mesh)
+    eng_pipe = ShardedNeighborEngine(PARAMS, mesh)
+    eng_sync.reset()
+    eng_pipe.reset()
+    rng = np.random.default_rng(13)
+    pos, active, space, radius = make_world(512, 450, seed=13)
+    vel = rng.normal(0, 25.0, pos.shape).astype(np.float32)
+
+    sync_stream, pipe_stream = [], []
+    pending = None
+    for t in range(6):
+        e1, l1, _ = eng_sync.step(pos, active, space, radius)
+        sync_stream.append((sorted(map(tuple, e1)), sorted(map(tuple, l1))))
+        nxt = eng_pipe.step_async(pos, active, space, radius)
+        if pending is not None:
+            e2, l2, _ = pending.collect()
+            pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+        pending = nxt
+        pos = np.clip(pos + vel, 0, 1500).astype(np.float32)
+    e2, l2, _ = pending.collect()
+    pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+    assert sync_stream == pipe_stream
 
 
 def test_sharded_chunked_drain_small_buffer():
     p = NeighborParams(
-        capacity=512, max_neighbors=32, cell_size=100.0, grid_x=16, grid_z=16,
+        capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64, max_events=128,
     )
     mesh = make_mesh(8)
-    single = NeighborEngine(PARAMS)  # big buffer reference
+    single = NeighborEngine(PARAMS, backend="jnp")  # big buffer reference
     sharded = ShardedNeighborEngine(p, mesh)  # tiny buffer, must chunk
     single.reset()
     sharded.reset()

@@ -37,7 +37,7 @@ if backend == "cpu":
 
 from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
 
-p = NeighborParams(capacity=512, max_neighbors=32, cell_size=100.0,
+p = NeighborParams(capacity=512, cell_size=100.0,
                    grid_x=8, grid_z=8, space_slots=2, cell_capacity=32,
                    max_events=4096)
 eng = NeighborEngine(p)
@@ -64,10 +64,10 @@ def _cpu_oracle():
     """Same two ticks on the (conftest-forced) CPU backend, in-process."""
     from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
 
-    p = NeighborParams(capacity=512, max_neighbors=32, cell_size=100.0,
+    p = NeighborParams(capacity=512, cell_size=100.0,
                        grid_x=8, grid_z=8, space_slots=2, cell_capacity=32,
                        max_events=4096)
-    eng = NeighborEngine(p)
+    eng = NeighborEngine(p, backend="jnp")
     eng.reset()
     rng = np.random.default_rng(7)
     pos = rng.uniform(0, 800, (512, 2)).astype(np.float32)
