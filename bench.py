@@ -45,72 +45,93 @@ P99_TARGET_MS = 5.0
 # --- backend resolution ------------------------------------------------------
 
 
-def _probe_tpu() -> tuple[bool, str]:
+def _probe_tpu(diag: dict) -> tuple[bool, str]:
     """Check in a subprocess whether the TPU backend initializes.
 
-    Round 1 failed here: `Unable to initialize backend 'axon'` in one env and
-    an indefinite HANG in another. A subprocess + kill is the only reliable
-    bound; in-process init can never be cancelled.
+    History: round 1 died with `Unable to initialize backend 'axon'`
+    (transient tunnel fault); round 2 hung for 120 s — because the probe
+    STRIPPED ``JAX_PLATFORMS=axon`` and let jax autodiscover, which on this
+    image hangs. Keeping the inherited ``JAX_PLATFORMS`` (axon) initializes
+    the chip in ~3 s. So: strategy 1 = env exactly as inherited; strategy 2
+    = env without JAX_PLATFORMS (in case the driver env differs). Whichever
+    works is replicated in-process. All child stderr tails are recorded in
+    the output JSON so a future failure is diagnosable.
     """
-    timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "120"))
-    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "3"))
+    timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180"))
+    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "2"))
     code = (
         "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform);"
+        "print('NDEV=%d' % len(d)); print('DEV0=' + str(d[0]));"
         "import jax.numpy as jnp;"
         "x = jnp.ones((128, 128));"
         "print('COMPUTE_OK', float((x @ x)[0, 0]))"
     )
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)  # let the default (TPU) backend resolve
-    last_err = "unknown"
+    env_inherit = dict(os.environ)
+    env_stripped = dict(os.environ)
+    env_stripped.pop("JAX_PLATFORMS", None)
+    strategies = [("inherit_env", env_inherit)]
+    if "JAX_PLATFORMS" in os.environ:
+        strategies.append(("strip_jax_platforms", env_stripped))
+    probe_log: list[str] = []
     for attempt in range(attempts):
         if attempt:
             time.sleep(5.0 * attempt)
-        try:
-            r = subprocess.run(
-                [sys.executable, "-c", code],
-                timeout=timeout,
-                capture_output=True,
-                text=True,
-                env=env,
-            )
-        except subprocess.TimeoutExpired:
-            last_err = f"backend init hang: no response in {timeout:.0f}s"
-            continue
-        out = r.stdout or ""
-        if r.returncode == 0 and "COMPUTE_OK" in out:
-            platform = "unknown"
-            for line in out.splitlines():
-                if line.startswith("PLATFORM="):
-                    platform = line.split("=", 1)[1].strip()
-            if platform == "cpu":
-                last_err = "default backend resolved to cpu (no TPU plugin)"
+        for name, env in strategies:
+            try:
+                r = subprocess.run(
+                    [sys.executable, "-c", code],
+                    timeout=timeout,
+                    capture_output=True,
+                    text=True,
+                    env=env,
+                )
+            except subprocess.TimeoutExpired:
+                probe_log.append(
+                    f"{name}: hang >{timeout:.0f}s (backend init never returned)"
+                )
                 continue
-            return True, platform
-        tail = ((r.stderr or "") + out).strip().splitlines()
-        last_err = " | ".join(tail[-3:]) if tail else f"rc={r.returncode}"
-    return False, last_err
+            out = r.stdout or ""
+            if r.returncode == 0 and "COMPUTE_OK" in out:
+                platform = "unknown"
+                for line in out.splitlines():
+                    if line.startswith("PLATFORM="):
+                        platform = line.split("=", 1)[1].strip()
+                if platform == "cpu":
+                    probe_log.append(f"{name}: resolved to cpu (no TPU plugin)")
+                    continue
+                diag["tpu_probe_strategy"] = name
+                diag["tpu_probe_log"] = probe_log
+                if name == "strip_jax_platforms":
+                    os.environ.pop("JAX_PLATFORMS", None)
+                return True, platform
+            tail = ((r.stderr or "") + out).strip().splitlines()
+            probe_log.append(
+                f"{name}: rc={r.returncode} " + " | ".join(tail[-5:])
+            )
+    diag["tpu_probe_log"] = probe_log
+    return False, probe_log[-1] if probe_log else "unknown"
 
 
 def _resolve_platform(diag: dict) -> str:
     """Decide tpu vs cpu; on cpu, force the platform before any jax import
     (the axon plugin ignores JAX_PLATFORMS, so use jax.config)."""
     forced = os.environ.get("BENCH_PLATFORM", "")
+    if forced and forced not in ("cpu", "tpu"):
+        # ADVICE r2: a typo must not silently assert a chip.
+        raise SystemExit(
+            f"BENCH_PLATFORM must be 'cpu' or 'tpu', got {forced!r}"
+        )
     if forced == "cpu":
         platform = "cpu"
         diag["platform_forced"] = forced
-    elif forced:
+    elif forced == "tpu":
         platform = "tpu"  # caller asserts a chip; verified against the
         diag["platform_forced"] = forced  # actual backend in main()
-        os.environ.pop("JAX_PLATFORMS", None)
     else:
-        ok, info = _probe_tpu()
+        ok, info = _probe_tpu(diag)
         platform = "tpu" if ok else "cpu"
         if ok:
             diag["tpu_platform_name"] = info
-            # The probe ran with JAX_PLATFORMS stripped; strip it here too so
-            # the in-process run resolves to the same (TPU) backend.
-            os.environ.pop("JAX_PLATFORMS", None)
         else:
             diag["error"] = f"tpu_unavailable: {info}"
     if platform == "cpu":

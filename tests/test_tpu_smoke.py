@@ -89,7 +89,12 @@ def _skip_or_fail(reason: str):
 
 def test_neighbor_engine_on_chip_matches_cpu_oracle():
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
+    # Keep JAX_PLATFORMS as inherited: on this image it is `axon` (the TPU
+    # tunnel plugin) and stripping it makes backend autodiscovery HANG —
+    # that exact strip cost rounds 1-2 all their chip time. Only a forced
+    # `cpu` value (a test env leak) is removed.
+    if env.get("JAX_PLATFORMS") == "cpu":
+        env.pop("JAX_PLATFORMS")
     env.pop("XLA_FLAGS", None)  # don't leak the 8-virtual-device forcing
     try:
         r = subprocess.run(
