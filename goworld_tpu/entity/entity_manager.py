@@ -45,6 +45,7 @@ class Runtime:
         self.aoi_backend: str = "xzlist"  # xzlist | batched
         self.aoi_service = None  # BatchAOIService, lazily created
         self.aoi_params = None  # NeighborParams override
+        self.aoi_mesh_shards: int = 1  # [aoi] mesh_shards: devices to shard over
         self.storage = None  # object with .save/.load/.exists (storage module)
         self.game_service = None  # the running GameService, if any
 
@@ -65,7 +66,9 @@ class Runtime:
             from goworld_tpu.ops.neighbor import NeighborParams
 
             params = self.aoi_params or NeighborParams()
-            self.aoi_service = BatchAOIService(params)
+            self.aoi_service = BatchAOIService(
+                params, mesh_shards=self.aoi_mesh_shards
+            )
         return self.aoi_service
 
     def new_aoi_manager(self, distance: float):

@@ -76,6 +76,13 @@ class GameService:
             rt.position_sync_interval = game_cfg.position_sync_interval
         if self.cfg.aoi.backend != "auto":
             rt.aoi_backend = "xzlist" if self.cfg.aoi.backend == "xzlist" else "batched"
+        # [aoi] capacity/cell/mesh knobs → engine params (ini is the single
+        # source of truth; tests may pre-seed rt.aoi_params to override).
+        rt.aoi_mesh_shards = max(1, self.cfg.aoi.mesh_shards)
+        if rt.aoi_backend != "xzlist" and rt.aoi_params is None:
+            from goworld_tpu.entity.aoi.batched import params_from_config
+
+            rt.aoi_params = params_from_config(self.cfg.aoi)
         if not storage.initialized():
             storage.initialize(self.cfg.storage)
         rt.storage = storage.SyncStorageAdapter()
