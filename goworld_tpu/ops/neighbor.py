@@ -91,6 +91,14 @@ class NeighborParams:
             raise ValueError("grid_x and grid_z must be >= 4")
         if self.capacity % 8 != 0:
             raise ValueError("capacity must be a multiple of 8 (TPU sublanes)")
+        # The Pallas drain's flat event-index space is capacity*9*LANES held
+        # in int32 (ADVICE r2: overflow above ~1.86M slots must fail loudly).
+        if self.capacity * 9 * LANES >= 2**31:
+            raise ValueError(
+                f"capacity {self.capacity} overflows the int32 event index "
+                f"space (capacity * 9 * {LANES} must be < 2^31); shard the "
+                f"engine instead (parallel.mesh)"
+            )
 
     @property
     def num_buckets(self) -> int:
@@ -514,6 +522,30 @@ def _jitted_drain_bits(params: NeighborParams):
 # --- host-facing engine ------------------------------------------------------
 
 
+_async_copy_supported: dict[str, bool] = {}
+
+
+def start_host_copy(arr: jax.Array) -> None:
+    """Begin the device→host copy of a packed result, if the platform can.
+
+    Capability is probed once per platform (ADVICE r2: do not classify
+    JaxRuntimeError by message substring — wording drifts across jaxlib
+    versions). If the probe call raises, async copies are disabled for that
+    platform and the copy simply happens synchronously in ``collect()``,
+    where any real device-side error surfaces on the blocking read.
+    """
+    try:
+        platform = arr.devices().pop().platform
+    except Exception:
+        platform = "unknown"
+    if not _async_copy_supported.get(platform, True):
+        return
+    try:
+        arr.copy_to_host_async()
+    except (NotImplementedError, jax.errors.JaxRuntimeError):
+        _async_copy_supported[platform] = False
+
+
 class PendingStep:
     """An in-flight tick: dispatched to the device, result not yet fetched.
 
@@ -531,16 +563,7 @@ class PendingStep:
         self._pager = pager  # pager(which, remaining, start_flat) -> pairs
         self._out = out
         self._collected = False
-        try:
-            out.copy_to_host_async()
-        except NotImplementedError:
-            pass  # platforms without async host copies just block in collect()
-        except jax.errors.JaxRuntimeError as err:
-            # Only "unimplemented on this platform" may be deferred to
-            # collect(); a real device-side failure must surface here, not be
-            # misattributed to the later blocking fetch.
-            if "unimplemented" not in str(err).lower():
-                raise
+        start_host_copy(out)
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         """Fetch (enter_pairs, leave_pairs, dropped); one blocking read."""
@@ -653,6 +676,8 @@ class NeighborEngine:
         """
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
+        if self.backend != "jnp":
+            check_space_ids(space, active)
         # jnp.array (not asarray): the arrays become next tick's PREVIOUS
         # state, so they must not alias the caller's numpy buffers — on the
         # CPU backend a zero-copy view would silently mutate history when
@@ -695,6 +720,19 @@ class NeighborEngine:
         chunks beyond the inline max_events.
         """
         return self.step_async(pos, active, space, radius).collect()
+
+
+def check_space_ids(space: np.ndarray, active: np.ndarray) -> None:
+    """The Pallas path carries space ids as f32 cell features; ids >= 2^24
+    lose integer precision and distinct spaces could silently compare equal
+    (cross-space enter events — ADVICE r2). Reject them loudly."""
+    s = np.asarray(space)
+    a = np.asarray(active)
+    if a.any() and int(s[a].max()) >= (1 << 24):
+        raise ValueError(
+            f"space id {int(s[a].max())} not exactly representable as f32 "
+            f"(>= 2^24); the pallas backend requires space ids < {1 << 24}"
+        )
 
 
 def check_radius(params: NeighborParams, radius: np.ndarray, active: np.ndarray) -> None:

@@ -43,6 +43,7 @@ from goworld_tpu.ops.neighbor import (
     _epoch_mask,
     _gather_cands,
     check_radius,
+    start_host_copy,
 )
 
 SHARD_AXIS = "shard"
@@ -201,13 +202,7 @@ class ShardedPendingStep:
         self._leave_ids = leave_ids
         self._out = out
         self._collected = False
-        try:
-            out.copy_to_host_async()
-        except NotImplementedError:
-            pass
-        except jax.errors.JaxRuntimeError as err:
-            if "unimplemented" not in str(err).lower():
-                raise
+        start_host_copy(out)
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         assert not self._collected, "ShardedPendingStep already collected"

@@ -1,0 +1,127 @@
+"""Per-phase on-chip profiling of the Pallas neighbor step.
+
+Times each stage of ops/neighbor._step_pallas in isolation (jitted
+separately, block_until_ready between) at the headline bench config, to
+attribute the tick budget (VERDICT r2 next-step #8: name the phase that owns
+the p99 gap). Run on the chip:  python tools/profile_neighbor.py
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def timeit(fn, *args, iters=5, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        ts.append(time.perf_counter() - t0)
+    return min(ts) * 1000.0  # ms
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops import neighbor as nb
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
+    cell = float(sys.argv[2]) if len(sys.argv) > 2 else 300.0
+    grid = int(sys.argv[3]) if len(sys.argv) > 3 else 44
+    p = nb.NeighborParams(
+        capacity=n, cell_size=cell, grid_x=grid, grid_z=grid,
+        space_slots=4, cell_capacity=128, max_events=131072,
+    )
+    print(f"backend={jax.default_backend()} n={n} cell={cell} grid={grid}",
+          flush=True)
+
+    rng = np.random.default_rng(0)
+    world = grid * cell
+    pos = jnp.asarray(rng.uniform(0, world, (n, 2)).astype(np.float32))
+    ppos = jnp.asarray(
+        np.asarray(pos) + rng.normal(0, 3, (n, 2)).astype(np.float32)
+    )
+    act = jnp.ones(n, bool)
+    spc = jnp.zeros(n, jnp.int32)
+    rad = jnp.full(n, 100.0, jnp.float32)
+
+    # --- phase 1: bins + table build ---
+    @jax.jit
+    def phase_table(pos, act, spc):
+        cx, cz, sm = nb._bins(p, pos, spc)
+        buc = (sm * p.grid_z + cz) * p.grid_x + cx
+        return nb._build_table(p, buc, act, nb.LANES)
+
+    t_table = timeit(phase_table, pos, act, spc)
+    table_c, slot_c, dropped_c, order_c, dst_c = jax.block_until_ready(
+        phase_table(pos, act, spc))
+
+    # --- phase 2: feature scatter ---
+    @jax.jit
+    def phase_scatter(order, dst, pos, ppos, spc, rad, slot):
+        av = (slot >= 0).astype(jnp.float32)
+        cur = (pos[:, 0], pos[:, 1], spc, rad, av)
+        prv = (ppos[:, 0], ppos[:, 1], spc, rad, av)
+        return nb._scatter_feats(p, order, dst, cur, prv)
+
+    t_scatter = timeit(phase_scatter, order_c, dst_c, pos, ppos, spc, rad, slot_c)
+    cells = jax.block_until_ready(
+        phase_scatter(order_c, dst_c, pos, ppos, spc, rad, slot_c))
+
+    # --- phase 3: the Pallas kernel ---
+    kernel = nb._compiled_event_kernel(p, False)
+    jkernel = jax.jit(kernel)
+    t_kernel = timeit(jkernel, cells)
+    packed_cells = jax.block_until_ready(jkernel(cells))
+
+    # --- phase 4: per-entity gather + popcount ---
+    w = 9 * nb.LANES // nb._PACK
+
+    @jax.jit
+    def phase_gather(packed_cells, slot):
+        flat = packed_cells.reshape(-1, w)
+        safe = jnp.maximum(slot, 0)
+        pe = jnp.where((slot >= 0)[:, None], flat[safe], 0)
+        return pe, jnp.sum(jax.lax.population_count(pe))
+
+    t_gather = timeit(phase_gather, packed_cells, slot_c)
+    packed_e, n_e = jax.block_until_ready(phase_gather(packed_cells, slot_c))
+    print(f"events in mask: {int(n_e)}")
+
+    # --- phase 5: drain (nonzero compaction) ---
+    cx, cz, sm = nb._bins(p, pos, spc)
+
+    @jax.jit
+    def phase_drain(packed_e, cx, cz, sm, table):
+        return nb._drain_bits(p, packed_e, cx, cz, sm, table, jnp.int32(0))
+
+    t_drain = timeit(phase_drain, packed_e, cx, cz, sm, table_c)
+
+    # --- full step for reference ---
+    step = nb._jitted_step_packed(p, "pallas")
+    t_full = timeit(step, ppos, act, spc, rad, pos, act, spc, rad,
+                    iters=3, warmup=1)
+
+    total2 = 2 * (t_table + t_scatter + t_kernel) + t_gather + 2 * t_drain
+    print(f"table build   : {t_table:8.1f} ms  (x2 per tick)")
+    print(f"feat scatter  : {t_scatter:8.1f} ms  (x2)")
+    print(f"pallas kernel : {t_kernel:8.1f} ms  (x2)")
+    print(f"gather+count  : {t_gather:8.1f} ms  (x1)")
+    print(f"drain nonzero : {t_drain:8.1f} ms  (x2)")
+    print(f"sum (est tick): {total2:8.1f} ms")
+    print(f"full step     : {t_full:8.1f} ms")
+
+
+if __name__ == "__main__":
+    main()
