@@ -182,6 +182,16 @@ class Avatar(Entity):
             self.attrs.delete("enteringNilSpace")
             self.call_client("OnEnterRandomNilSpace")
 
+    def on_enter_space(self):
+        # The reference protocol pushes a client-side space object on every
+        # space switch (ClientBot.go:485-496 createSpace → OnEnterSpace);
+        # this framework's wire protocol is entity-only, so the test server
+        # acks space entry explicitly — the bot harness keys its
+        # DoEnterRandomSpace completion off this (bot_runner.py).
+        super().on_enter_space()
+        kind = self.space.kind if self.space is not None else 0
+        self.call_client("OnEnterSpace", kind)
+
     # --- chat (Avatar.go:233-245) ------------------------------------------
 
     def Say_Client(self, channel: str, content: str):
@@ -476,6 +486,15 @@ class MailService(Entity):
         self.last_mail_id = -1
 
     def on_created(self):
+        self._load_last_mail_id()
+
+    def on_restored(self):
+        # Freeze/restore skips on_created; without this reload the restored
+        # shard would reject every SendMail forever (the reference shares
+        # this hole — its CI runs with DoSendMail disabled).
+        self._load_last_mail_id()
+
+    def _load_last_mail_id(self):
         def loaded(old_val, err=None):
             self.last_mail_id = int(old_val) if old_val else 0
 
@@ -498,6 +517,12 @@ class MailService(Entity):
         return self.last_mail_id
 
     def SendMail(self, sender_id: str, sender_name: str, target_id: str, data):
+        if self.last_mail_id < 0:
+            # id counter still loading (fresh create or just restored):
+            # retry shortly instead of failing the client's send.
+            self.add_callback(0.2, "SendMail", sender_id, sender_name,
+                              target_id, data)
+            return
         mail_id = self._gen_mail_id()
         mail_key = self._mail_key(mail_id, target_id)
         mail = {

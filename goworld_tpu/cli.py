@@ -125,6 +125,7 @@ def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
     logf = open(log_path, "ab")
     logf.write(f"\n--- spawn {time.strftime('%F %T')}: {' '.join(argv)}\n".encode())
     logf.flush()
+    offset = logf.tell()  # only log content from THIS spawn satisfies the tag
     proc = subprocess.Popen(
         argv, stdout=logf, stderr=subprocess.STDOUT, cwd=run_dir,
         start_new_session=True,  # survives the CLI exiting (daemon-ish)
@@ -133,16 +134,19 @@ def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
     start = _proc_starttime(proc.pid)
     with open(_pidfile(run_dir, name), "w") as f:
         f.write(str(proc.pid) if start is None else f"{proc.pid} {start}")
-    _wait_tag(run_dir, name, tag, proc)
+    _wait_tag(run_dir, name, tag, proc, offset)
 
 
-def _wait_tag(run_dir: str, name: str, tag: str, proc=None) -> None:
-    """Scan the child's log for its supervisor tag (start.go:98-126)."""
+def _wait_tag(run_dir: str, name: str, tag: str, proc=None, offset: int = 0) -> None:
+    """Scan the child's log (from this spawn's offset — logs append across
+    restarts so reload forensics keep the pre-freeze half) for its
+    supervisor tag (start.go:98-126)."""
     log_path = _logfile(run_dir, name)
     deadline = time.monotonic() + START_TIMEOUT
     while time.monotonic() < deadline:
         try:
             with open(log_path, "rb") as f:
+                f.seek(offset)
                 if tag.encode() in f.read():
                     print(f"  {name}: started ok")
                     return
@@ -291,7 +295,9 @@ def cmd_reload(args) -> int:
     configfile = os.path.abspath(args.configfile) if args.configfile else ""
     cfg_argv = ["-configfile", configfile] if configfile else []
     for name, _, i in frozen:
-        _truncate_log(run_dir, name)
+        # No truncation on reload: the pre-freeze log half is the forensic
+        # record of what led into the swap (_wait_tag scans from the new
+        # spawn marker, so stale tags can't satisfy the wait).
         _spawn(run_dir, name,
                [sys.executable, "-m", args.server_module, "-gid", str(i), "-restore"] + cfg_argv,
                consts.GAME_STARTED_TAG)

@@ -1,0 +1,72 @@
+"""Standalone bot-army entry point.
+
+Reference parity: ``examples/test_client/test_client.go:35-84`` — flags
+``-N`` (bot count), ``-strict``, ``-duration`` seconds, gates resolved from
+the deployment ini (bots pick gates round-robin, ClientBot.go:82-85).
+
+    python -m goworld_tpu.client -N 200 -strict -duration 300
+
+Exit code 0 = clean run; 1 = strict failure or any bot error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+
+from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m goworld_tpu.client")
+    ap.add_argument("-N", type=int, default=10, help="number of bots")
+    ap.add_argument("-strict", action="store_true",
+                    help="promote any protocol error/timeout to fatal")
+    ap.add_argument("-duration", type=float, default=30.0,
+                    help="seconds to run scenarios")
+    ap.add_argument("-configfile", default="goworld.ini",
+                    help="deployment ini to resolve gate addresses from")
+    ap.add_argument("-gate", action="append", default=[],
+                    help="explicit gate host:port (repeatable; overrides ini)")
+    ap.add_argument("-ws", action="store_true", help="connect over WebSocket")
+    ap.add_argument("-tls", action="store_true", help="TLS client link")
+    ap.add_argument("-compress", action="store_true",
+                    help="compressed client link")
+    ap.add_argument("-seed", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    gates: list[tuple[str, int]] = []
+    for spec in args.gate:
+        host, _, port = spec.rpartition(":")
+        gates.append((host or "127.0.0.1", int(port)))
+    if not gates:
+        from goworld_tpu.config import read_config
+
+        read_config.set_config_file(args.configfile)
+        cfg = read_config.get()
+        if args.ws:
+            for g in cfg.gates.values():
+                if g.ws_addr:
+                    host, _, port = g.ws_addr.rpartition(":")
+                    gates.append((host or "127.0.0.1", int(port)))
+        else:
+            gates = [(g.host, g.port) for g in cfg.gates.values()]
+    if not gates:
+        print("no gates found (use -gate host:port or -configfile)",
+              file=sys.stderr)
+        return 2
+
+    report = asyncio.run(
+        run_fleet(
+            args.N, gates, args.duration,
+            strict=args.strict, ws=args.ws, tls=args.tls,
+            compress=args.compress, seed=args.seed,
+        )
+    )
+    print(format_report(report))
+    return 1 if report["errors"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,409 @@
+"""N-bot stress harness: the framework's distributed correctness+perf gate.
+
+Reference parity: ``examples/test_client/test_client.go:35-84`` (spawn N
+bots, wait, report) and ``ClientEntity.go:160-242`` (one weighted-random
+"thing" at a time per bot, 5 s timeout each, ``-strict`` promotes timeouts
+and protocol errors to fatal). The CI gate shape is
+``.travis.yml:22-34``: 200 bots, strict, 300 s, across a hot reload.
+
+Run:  python -m goworld_tpu.client -N 200 -strict -duration 300
+
+Design differences from the reference (asyncio-native, not a port): all
+bots share one event loop; each bot is a task driving a ClientBot; position
+sync runs as a background 100 ms random-walk while the bot is in a space
+(ClientBot.go:225-237's sync tick).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+from typing import Optional
+
+from goworld_tpu.client.client import ClientBot, StrictError
+from goworld_tpu.utils import gwlog
+
+THING_TIMEOUT = 5.0
+
+# (method name, weight, timeout_fatal_in_strict). Mirrors the reference's
+# _DO_THINGS table (ClientEntity.go:166-180): prof-channel chat may have no
+# listener with few bots, so its timeout never escalates; mail and pubsub
+# are enabled here (the reference lists them commented out but the server
+# supports them end-to-end).
+THINGS = [
+    ("DoEnterRandomSpace", 1, True),
+    ("DoEnterRandomNilSpace", 1, True),
+    ("DoSayInWorldChannel", 1, True),
+    ("DoSayInProfChannel", 1, False),
+    ("DoTestListField", 1, True),
+    ("DoTestAOI", 1, True),
+    ("DoTestCallAll", 1, True),
+    ("DoTestComplexAttr", 1, True),
+    ("DoTestPublish", 1, True),
+    ("DoSendMail", 1, True),
+    ("DoGetMails", 1, True),
+]
+
+# Things safe to re-send mid-budget (see _do_one_thing).
+RETRYABLE_THINGS = {
+    "DoTestPublish", "DoEnterRandomSpace", "DoEnterRandomNilSpace",
+}
+
+
+class ScenarioBot:
+    """One bot: login → loop weighted random scenarios until the deadline."""
+
+    def __init__(
+        self,
+        index: int,
+        host: str,
+        port: int,
+        *,
+        strict: bool = False,
+        n_clients: int = 1,
+        ws: bool = False,
+        tls: bool = False,
+        compress: bool = False,
+        seed: Optional[int] = None,
+        thing_timeout: float = THING_TIMEOUT,
+    ) -> None:
+        self.index = index
+        self.thing_timeout = thing_timeout
+        self.host = host
+        self.port = port
+        self.ws = ws
+        self.n_clients = n_clients
+        self.rng = random.Random(seed)
+        self.bot = ClientBot(
+            name=f"bot{index}", strict=strict,
+            heartbeat_interval=2.0, tls=tls, compress=compress,
+        )
+        self.space_kind = 0
+        self.current_thing: Optional[str] = None
+        self._done: Optional[asyncio.Future] = None
+        self.stats: dict[str, list[float]] = {}
+        self.timeouts: dict[str, int] = {}
+        self.retries: dict[str, int] = {}
+        self._install_handlers()
+
+    # --- completion plumbing -------------------------------------------------
+
+    def _thing_done(self, thing: str) -> None:
+        if self.current_thing == thing and self._done and not self._done.done():
+            self._done.set_result(thing)
+
+    def _install_handlers(self) -> None:
+        h = self.bot.rpc_handlers
+        h[(None, "OnLogin")] = lambda e, ok: None
+        h[(None, "OnEnterSpace")] = self._on_enter_space
+        h[(None, "OnEnterRandomNilSpace")] = (
+            lambda e: self._thing_done("DoEnterRandomNilSpace")
+        )
+        h[(None, "OnSay")] = self._on_say
+        h[(None, "OnTestListField")] = (
+            lambda e, lst: self._thing_done("DoTestListField")
+        )
+        h[(None, "OnTestAOI")] = lambda e, tid: self._thing_done("DoTestAOI")
+        h[(None, "OnTestCallAll")] = lambda e: self._thing_done("DoTestCallAll")
+        h[(None, "TestCallAllPlzEcho")] = self._on_call_all_echo
+        h[(None, "OnTestComplexAttrStep1")] = self._on_complex_step1
+        h[(None, "OnTestComplexAttrClear")] = self._on_complex_clear
+        h[(None, "OnTestPublish")] = self._on_publish
+        h[(None, "OnSendMail")] = lambda e, ok: self._thing_done("DoSendMail")
+        h[(None, "OnGetMails")] = lambda e, ok: self._thing_done("DoGetMails")
+
+    def _on_enter_space(self, e, kind: int) -> None:
+        self.space_kind = int(kind)
+        self._thing_done("DoEnterRandomSpace")
+
+    def _on_say(self, e, eid: str, name: str, channel: str, content: str) -> None:
+        if self.bot.player is not None and eid == self.bot.player.id:
+            if channel == "world":
+                self._thing_done("DoSayInWorldChannel")
+            elif channel == "prof":
+                self._thing_done("DoSayInProfChannel")
+
+    def _on_call_all_echo(self, e, eid: str) -> None:
+        # AllClients echo countdown: every client echoes back to the server
+        # (Avatar.TestCallAllEcho_AllClients decrements the caller's counter).
+        if self.bot.player is not None:
+            self.bot.player.call_server("TestCallAllEcho_AllClients", eid)
+
+    def _on_complex_step1(self, e) -> None:
+        # Strict check: the nested attr tree must have synced to the mirror
+        # before the clear lands (ClientEntity.go DoTestComplexAttr).
+        attrs = self.bot.player.attrs if self.bot.player else {}
+        node = attrs.get("complexAttr", {})
+        try:
+            final = node["key1"]["key2"][1][0]["finalkey"]
+        except (KeyError, IndexError, TypeError):
+            final = None
+        if final != "iamhere":
+            self.bot.error(
+                f"complexAttr desync: expected finalkey, got {node!r}"
+            )
+
+    def _on_complex_clear(self, e) -> None:
+        attrs = self.bot.player.attrs if self.bot.player else {}
+        if attrs.get("complexAttr"):
+            self.bot.error(
+                f"complexAttr not cleared: {attrs.get('complexAttr')!r}"
+            )
+        self._thing_done("DoTestComplexAttr")
+
+    def _on_publish(self, e, publisher: str, subject: str, content: str) -> None:
+        if self.bot.player is not None and publisher == self.bot.player.id:
+            self._thing_done("DoTestPublish")
+
+    # --- things --------------------------------------------------------------
+
+    def _start_thing(self, thing: str) -> None:
+        p = self.bot.player
+        assert p is not None
+        if thing == "DoEnterRandomSpace":
+            # Space-kind pool scales with fleet size (ClientEntity.go:247-252).
+            # Never the *current* kind: the server early-returns on a same-kind
+            # enter (Avatar._enter_space_kind) and no ack would ever arrive.
+            kind_max = max(2, self.n_clients // 400)
+            kind = 1 + self.rng.randrange(kind_max)
+            if kind == self.space_kind:
+                kind = 1 + (kind % kind_max)
+            p.call_server("EnterSpace_Client", kind)
+        elif thing == "DoEnterRandomNilSpace":
+            p.call_server("EnterRandomNilSpace_Client")
+        elif thing == "DoSayInWorldChannel":
+            p.call_server("Say_Client", "world", f"hello from {self.bot.name}")
+        elif thing == "DoSayInProfChannel":
+            p.call_server("Say_Client", "prof", f"prof ping {self.bot.name}")
+        elif thing == "DoTestListField":
+            p.call_server("TestListField_Client")
+        elif thing == "DoTestAOI":
+            p.call_server("TestAOI_Client")
+        elif thing == "DoTestCallAll":
+            p.call_server("TestCallAll_Client")
+        elif thing == "DoTestComplexAttr":
+            p.call_server("TestComplexAttr_Client")
+        elif thing == "DoTestPublish":
+            p.call_server("TestPublish_Client")
+        elif thing == "DoSendMail":
+            p.call_server("SendMail_Client", p.id, {"text": "stress mail"})
+        elif thing == "DoGetMails":
+            p.call_server("GetMails_Client")
+        else:  # pragma: no cover
+            raise ValueError(thing)
+
+    def _choose_thing(self) -> tuple[str, bool]:
+        if self.space_kind == 0:
+            # Not in a real space yet: must enter one first (doSomething's
+            # forced first thing).
+            return "DoEnterRandomSpace", True
+        import os
+
+        only = os.environ.get("STRESS_THINGS", "")
+        things = THINGS
+        if only:
+            allow = set(only.split(","))
+            things = [t for t in THINGS if t[0] in allow] or THINGS
+        total = sum(w for _, w, _ in things)
+        r = self.rng.randrange(total)
+        for method, w, fatal in things:
+            if r < w:
+                return method, fatal
+            r -= w
+        raise AssertionError("unreachable")
+
+    async def _do_one_thing(self) -> None:
+        thing, timeout_fatal = self._choose_thing()
+        self.current_thing = thing
+        self._done = asyncio.get_running_loop().create_future()
+        t0 = time.perf_counter()
+        self._start_thing(thing)
+        try:
+            if thing in RETRYABLE_THINGS:
+                # Scenario-idempotent things are re-sent within the budget:
+                # - DoTestPublish races the avatar's own ack-less async
+                #   subscriptions right after login (a publish processed
+                #   before the subscribe lands is delivered to nobody; the
+                #   reference sidesteps this by disabling DoTestPublish in
+                #   its CI mix, ClientEntity.go:175);
+                # - the enter-space scenarios lose their server-side pending
+                #   request when the requesting game freezes mid-migration
+                #   (the request is deliberately not part of freeze data) —
+                #   re-requesting after the restore is the recovery path.
+                deadline = t0 + self.thing_timeout
+                while True:
+                    budget = min(2.5, deadline - time.perf_counter())
+                    if budget <= 0:
+                        raise asyncio.TimeoutError
+                    try:
+                        await asyncio.wait_for(
+                            asyncio.shield(self._done), budget
+                        )
+                        break
+                    except asyncio.TimeoutError:
+                        if time.perf_counter() >= deadline:
+                            raise
+                        self.retries[thing] = self.retries.get(thing, 0) + 1
+                        self._start_thing(thing)
+            else:
+                await asyncio.wait_for(self._done, self.thing_timeout)
+            self.stats.setdefault(thing, []).append(time.perf_counter() - t0)
+        except asyncio.TimeoutError:
+            self.timeouts[thing] = self.timeouts.get(thing, 0) + 1
+            if timeout_fatal:
+                self.bot.error(
+                    f"{thing} TIMEOUT after {self.thing_timeout:.0f}s"
+                )
+        finally:
+            self.current_thing = None
+            self._done = None
+
+    async def _sync_loop(self) -> None:
+        """100 ms position random walk while in a space (the AOI/sync-plane
+        load, ClientBot.go:225-237)."""
+        while True:
+            await asyncio.sleep(0.1)
+            p = self.bot.player
+            if p is not None and self.space_kind > 0 and p.typename == "Avatar":
+                x = p.x + self.rng.uniform(-10, 10)
+                z = p.z + self.rng.uniform(-10, 10)
+                p.sync_position(x, p.y, z, self.rng.uniform(0, 360))
+
+    # --- lifecycle -----------------------------------------------------------
+
+    async def run(self, duration: float) -> None:
+        if self.ws:
+            await self.bot.connect_ws(self.host, self.port)
+        else:
+            await self.bot.connect(self.host, self.port)
+        sync_task: Optional[asyncio.Task] = None
+        try:
+            acct = await self.bot.wait_player(timeout=30)
+            acct.call_server(
+                "Login_Client", f"stress_{self.index}", "123456"
+            )
+            deadline = time.monotonic() + duration
+            while self.bot.player is None or self.bot.player.typename != "Avatar":
+                if time.monotonic() > deadline:
+                    self.bot.error("login never completed")
+                    return
+                await asyncio.sleep(0.05)
+            # World-ready barrier: on a cold cluster the first server-side
+            # space entry (on_client_connected → SpaceService) can be dropped
+            # while the sharded services are still spinning up, so actively
+            # re-request entry every few seconds — the client-side retry the
+            # reference gets from its forced first DoEnterRandomSpace
+            # (ClientEntity.go doSomething when space kind == 0).
+            t0 = time.monotonic()
+            kind_max = max(2, self.n_clients // 400)
+            while self.space_kind == 0 and time.monotonic() - t0 < 30.0:
+                self.bot.player.call_server(
+                    "EnterSpace_Client", 1 + self.rng.randrange(kind_max)
+                )
+                t1 = time.monotonic()
+                while self.space_kind == 0 and time.monotonic() - t1 < 4.0:
+                    await asyncio.sleep(0.05)
+            if self.space_kind == 0:
+                self.bot.error("initial space entry never completed")
+                return
+            sync_task = asyncio.get_running_loop().create_task(self._sync_loop())
+            while time.monotonic() < deadline:
+                if self.bot.player is None or self.bot.player.typename != "Avatar":
+                    # Player mirror mid-recreate (migration/GiveClientTo).
+                    await asyncio.sleep(0.05)
+                    continue
+                await self._do_one_thing()
+                await asyncio.sleep(self.rng.uniform(0.0, 0.1))
+        finally:
+            if sync_task is not None:
+                sync_task.cancel()
+            await self.bot.close()
+
+
+async def run_fleet(
+    n: int,
+    gates: list[tuple[str, int]],
+    duration: float,
+    *,
+    strict: bool = False,
+    ws: bool = False,
+    tls: bool = False,
+    compress: bool = False,
+    seed: Optional[int] = None,
+    spawn_interval: float = 0.02,
+    thing_timeout: float = THING_TIMEOUT,
+) -> dict:
+    """Spawn ``n`` bots round-robin over ``gates``; gather a fleet report.
+
+    Returns {"bots", "errors", "timeouts", "things": {name: {count, avg_ms,
+    max_ms}}}. In strict mode the first StrictError propagates after all
+    bots have been cancelled (the reference's fatal semantics).
+    """
+    rng = random.Random(seed)
+    bots = [
+        ScenarioBot(
+            i, *gates[i % len(gates)], strict=strict, n_clients=n,
+            ws=ws, tls=tls, compress=compress, seed=rng.randrange(2**31),
+            thing_timeout=thing_timeout,
+        )
+        for i in range(n)
+    ]
+
+    async def staggered(i: int, bot: ScenarioBot):
+        await asyncio.sleep(i * spawn_interval)  # avoid an accept() stampede
+        await bot.run(duration)
+
+    results = await asyncio.gather(
+        *(staggered(i, b) for i, b in enumerate(bots)),
+        return_exceptions=True,
+    )
+    first_err: Optional[BaseException] = None
+    errors: list[str] = []
+    for bot, res in zip(bots, results):
+        errors.extend(bot.bot.errors)
+        if isinstance(res, BaseException) and first_err is None:
+            first_err = res
+    if first_err is not None and strict:
+        raise first_err
+    things: dict[str, dict] = {}
+    timeouts: dict[str, int] = {}
+    for bot in bots:
+        for thing, times in bot.stats.items():
+            agg = things.setdefault(thing, {"count": 0, "_sum": 0.0, "max_ms": 0.0})
+            agg["count"] += len(times)
+            agg["_sum"] += sum(times)
+            agg["max_ms"] = max(agg["max_ms"], max(times) * 1000.0)
+        for thing, cnt in bot.timeouts.items():
+            timeouts[thing] = timeouts.get(thing, 0) + cnt
+    retries: dict[str, int] = {}
+    for bot in bots:
+        for thing, cnt in bot.retries.items():
+            retries[thing] = retries.get(thing, 0) + cnt
+    for agg in things.values():
+        agg["avg_ms"] = round(agg.pop("_sum") / max(agg["count"], 1) * 1000.0, 1)
+        agg["max_ms"] = round(agg["max_ms"], 1)
+    return {
+        "bots": n,
+        "errors": errors,
+        "timeouts": timeouts,
+        "retries": retries,
+        "things": things,
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [f"bots={report['bots']} errors={len(report['errors'])}"]
+    for thing in sorted(report["things"]):
+        agg = report["things"][thing]
+        t = report["timeouts"].get(thing, 0)
+        lines.append(
+            f"  {thing:24s} x{agg['count']:<6d} avg {agg['avg_ms']:7.1f} ms"
+            f"  max {agg['max_ms']:8.1f} ms  timeouts {t}"
+        )
+    for thing, t in sorted(report["timeouts"].items()):
+        if thing not in report["things"]:
+            lines.append(f"  {thing:24s} x0      (all {t} timed out)")
+    for err in report["errors"][:10]:
+        lines.append(f"  ERROR: {err}")
+    return "\n".join(lines)

@@ -28,7 +28,11 @@ DISPATCHER_MESSAGE_QUEUE_LEN = 10_000
 # --- timeouts ---------------------------------------------------------------
 DISPATCHER_MIGRATE_TIMEOUT = 60.0  # consts.go (1 min migrate window)
 DISPATCHER_LOAD_TIMEOUT = 60.0
-DISPATCHER_FREEZE_GAME_TIMEOUT = 10.0
+# Freeze buffering window (reference: 10 s, consts.go FREEZE_GAME_TIMEOUT).
+# A restarting game here is a fresh Python interpreter (~2-4 s import cost
+# per game, restarted sequentially by the CLI); 10 s leaves no headroom on a
+# loaded box and an expired block DROPS packets instead of buffering them.
+DISPATCHER_FREEZE_GAME_TIMEOUT = 30.0
 RECONNECT_INTERVAL = 1.0  # DispatcherConnMgr reconnect backoff
 CLIENT_HEARTBEAT_TIMEOUT = 30.0  # gate kills silent clients
 

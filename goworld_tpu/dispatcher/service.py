@@ -289,12 +289,20 @@ class DispatcherService:
         # Reconnect reconciliation: reject entities homed elsewhere
         # (DispatcherService.go:376-398).
         rejected: list[str] = []
+        now = self._now()
         for eid in entity_ids:
             info = self.entities.get(eid)
             if info is not None and info.gameid not in (0, gameid):
                 rejected.append(eid)
             else:
-                self._entity(eid).gameid = gameid
+                info = self._entity(eid)
+                info.gameid = gameid
+                # The game just proved this entity LIVES there: any migrate
+                # block (whose REAL_MIGRATE died with the pre-restore
+                # process) is stale — without this, a lost migration leaves
+                # the entity's RPC stream buffered for the full 60 s window.
+                if info.blocked(now) or info.pending:
+                    self._flush_entity_pending(info)
         proxy.send_set_game_id_ack(
             online_games=sorted(
                 gid for gid, g in self.games.items() if g.connected
@@ -429,13 +437,29 @@ class DispatcherService:
 
     # --- migration (DispatcherService.go:850-907) -----------------------------
 
+    def _ack_requester(self, proxy: GoWorldConnection, msgtype: int, p: Packet) -> None:
+        """Send a migration ack back to the requesting game THROUGH its
+        buffered dispatch: a raw proxy write to a game that is mid-freeze
+        lands in a socket its process never reads again, while the buffered
+        path survives until the restore (a restored entity simply ignores a
+        stale ack via _enter_space_request_valid)."""
+        gameid = self._gameid_of(proxy)
+        if gameid:
+            self._game(gameid).dispatch(msgtype, p, self._now())
+        else:
+            proxy.send(msgtype, p)
+
     def _handle_query_space_gameid_for_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
         spaceid = packet.read_entity_id()
         eid = packet.read_entity_id()
         space_info = self.entities.get(spaceid)
         gameid = space_info.gameid if space_info is not None else 0
         # Ack goes back to the entity's current game (the requester).
-        proxy.send_query_space_gameid_for_migrate_ack(spaceid, eid, gameid)
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_entity_id(eid)
+        p.append_uint16(gameid)
+        self._ack_requester(proxy, MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK, p)
 
     def _handle_migrate_request(self, proxy: GoWorldConnection, packet: Packet) -> None:
         eid = packet.read_entity_id()
@@ -443,7 +467,11 @@ class DispatcherService:
         space_gameid = packet.read_uint16()
         info = self._entity(eid)
         info.block(self._now(), consts.DISPATCHER_MIGRATE_TIMEOUT)
-        proxy.send_migrate_request_ack(eid, spaceid, space_gameid)
+        p = Packet()
+        p.append_entity_id(eid)
+        p.append_entity_id(spaceid)
+        p.append_uint16(space_gameid)
+        self._ack_requester(proxy, MsgType.MIGRATE_REQUEST_ACK, p)
 
     def _handle_real_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
         eid = packet.read_entity_id()

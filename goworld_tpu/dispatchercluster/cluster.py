@@ -91,6 +91,16 @@ class DispatcherConnMgr:
     async def stop(self) -> None:
         self._stopped = True
         if self.proxy is not None:
+            # Drain before close: the process exits right after stop() during
+            # freeze/terminate, and packets still in the asyncio transport
+            # buffer would be silently dropped — including REAL_MIGRATE of an
+            # avatar that just migrated out, which then exists on NO game.
+            try:
+                await asyncio.wait_for(
+                    self.proxy.conn.drain(hard=True), timeout=5.0
+                )
+            except Exception:
+                pass  # peer already gone; nothing to preserve
             self.proxy.close()
         if self._task is not None:
             self._task.cancel()

@@ -525,8 +525,18 @@ class Entity:
         from goworld_tpu.entity import entity_manager
 
         if self._enter_space_request is not None:
-            gwlog.errorf("%s: enter_space while another enter is pending", self)
-            return
+            # Pending requests expire by TIME, like the reference's
+            # isEnteringSpace (Entity.go:1000-1004): if an ack was lost (the
+            # requester's dispatcher link blipped), a dangling request must
+            # not wedge the entity's space-hopping forever.
+            from goworld_tpu import consts
+
+            _, _, t0 = self._enter_space_request
+            if entity_manager.runtime.now() - t0 <= consts.DISPATCHER_MIGRATE_TIMEOUT:
+                gwlog.errorf("%s: enter_space while another enter is pending", self)
+                return
+            gwlog.warnf("%s: dropping expired enter-space request", self)
+            self.cancel_enter_space()
         space = entity_manager.get_space(spaceid)
         if space is not None:
             entity_manager.runtime.post(lambda: self._enter_local_space(space, pos))

@@ -181,6 +181,13 @@ def _new_entity(
     _entities[e.id] = e
     if isinstance(e, Space):
         _spaces[e.id] = e
+    elif space is None:
+        # Default membership: every entity lives in the nil space until it
+        # enters a real one (EntityManager.go:250 `entity.Space = nilSpace`;
+        # pointer-only, no AOI/entity-set bookkeeping). Without this a
+        # freshly loaded Avatar answers GetSpaceID with "" and the Account
+        # re-login flow dies on enter_space("").
+        e.space = get_nil_space()
     gwutils.run_panicless(e.on_init)
     if isinstance(e, Space):
         e._maybe_restore_aoi()

@@ -54,6 +54,7 @@ class PublishSubscribeService(Entity):
         targets |= self._exact.get(subject, set())
         for i in range(len(subject) + 1):
             targets |= self._wildcard.get(subject[:i], set())
+        gwlog.debugf("%s publish %r -> %d targets", self, subject, len(targets))
         for eid in targets:
             self.call(eid, "OnPublish", subject, content)
 
@@ -95,6 +96,7 @@ class PublishSubscribeService(Entity):
         return subject, False
 
     def _subscribe(self, eid: str, subject: str, wildcard: bool) -> None:
+        gwlog.debugf("%s subscribe %s -> %r (wildcard=%s)", self, eid, subject, wildcard)
         if wildcard:
             self._wildcard.setdefault(subject, set()).add(eid)
             self._by_entity_wild.setdefault(eid, set()).add(subject)

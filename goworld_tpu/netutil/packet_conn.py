@@ -107,9 +107,18 @@ class PacketConnection:
         except Exception:
             self._closed = True
 
-    async def drain(self) -> None:
+    async def drain(self, hard: bool = False) -> None:
+        """Flush queued packets into the transport and wait for it to drain.
+
+        ``hard=True`` waits until the transport buffer is completely empty
+        (write-buffer limits dropped to zero) — required before process exit
+        (freeze/terminate), where normal drain() can return with bytes still
+        in the user-space buffer that die with the process.
+        """
         self.flush()
         try:
+            if hard:
+                self._writer.transport.set_write_buffer_limits(0, 0)
             await self._writer.drain()
         except Exception:
             self._closed = True

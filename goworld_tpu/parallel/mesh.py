@@ -271,11 +271,15 @@ class ShardedNeighborEngine:
     def reset(self) -> None:
         n = self.params.capacity
         put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        # device_put from NUMPY, never from an intermediate jax array: a jax
+        # array can carry a sharding over the same device set in a different
+        # order, which trips jax's different-device-order reshard path
+        # (dispatch.py _different_device_order_reshard asserts NamedSharding).
         self._state = (
-            put(jnp.zeros((n, 2), jnp.float32)),
-            put(jnp.zeros((n,), jnp.bool_)),
-            put(jnp.zeros((n,), jnp.int32)),
-            put(jnp.zeros((n,), jnp.float32)),
+            put(np.zeros((n, 2), np.float32)),
+            put(np.zeros((n,), bool)),
+            put(np.zeros((n,), np.int32)),
+            put(np.zeros((n,), np.float32)),
         )
 
     def _page(
@@ -315,13 +319,14 @@ class ShardedNeighborEngine:
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
         put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
-        # jnp.array (not asarray): state must not alias caller buffers — see
-        # NeighborEngine.step_async.
+        # np.array (copying, not asarray): state must not alias caller
+        # buffers — see NeighborEngine.step_async. Numpy (not jnp) inputs by
+        # design: see reset().
         cur = (
-            put(jnp.array(pos, jnp.float32)),
-            put(jnp.array(active, jnp.bool_)),
-            put(jnp.array(space, jnp.int32)),
-            put(jnp.array(radius, jnp.float32)),
+            put(np.array(pos, np.float32)),
+            put(np.array(active, bool)),
+            put(np.array(space, np.int32)),
+            put(np.array(radius, np.float32)),
         )
         enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
         self._state = cur

@@ -38,6 +38,71 @@ _gameid: int = 0
 _check_handle = None
 _started = False
 
+# Calls issued before the target shard finished registering (cold start,
+# post-restore window). The reference drops these with an error log
+# (service.go:262-266), which silently breaks anything fired from an early
+# OnCreated (e.g. pubsub subscribes — the subscription is then missing for
+# the entity's whole life). We queue and replay them on the next reconcile
+# instead; undeliverable calls are dropped loudly after a TTL.
+PENDING_CALL_TTL = 30.0
+PENDING_RETRY_INTERVAL = 0.5
+MAX_PENDING_CALLS = 10000
+_pending_calls: list = []  # (deadline, label, attempt() -> bool)
+_flush_handle = None
+
+
+def _defer(label: str, attempt) -> None:
+    if len(_pending_calls) >= MAX_PENDING_CALLS:
+        gwlog.errorf("service: pending-call queue full, dropping %s", label)
+        return
+    _pending_calls.append(
+        (entity_manager.now() + PENDING_CALL_TTL, label, attempt)
+    )
+    # Reconcile passes flush the queue too, but they stop firing once
+    # registration settles (next periodic is up to CHECK_INTERVAL away) —
+    # a call deferred after the last kvreg update needs its own retry tick.
+    _schedule_flush()
+
+
+def _schedule_flush() -> None:
+    global _flush_handle
+    if _flush_handle is not None:
+        return
+
+    def fire() -> None:
+        global _flush_handle
+        _flush_handle = None
+        _flush_pending()
+        if _pending_calls:
+            _schedule_flush()
+
+    _flush_handle = entity_manager.runtime.timer_service.add_callback(
+        PENDING_RETRY_INTERVAL, fire
+    )
+
+
+def _flush_pending() -> None:
+    global _pending_calls
+    if not _pending_calls:
+        return
+    now = entity_manager.now()
+    remaining = []
+    for deadline, label, attempt in _pending_calls:
+        try:
+            if attempt():
+                continue
+        except Exception:
+            gwlog.trace_error("service: pending call %s raised", label)
+            continue
+        if now >= deadline:
+            gwlog.errorf(
+                "service: %s undeliverable for %gs, dropped",
+                label, PENDING_CALL_TTL,
+            )
+        else:
+            remaining.append((deadline, label, attempt))
+    _pending_calls = remaining
+
 
 def _service_id(name: str, shard: int) -> str:
     return f"{name}{SHARD_SEP}{shard}"
@@ -167,6 +232,9 @@ def check_services() -> None:
                 lambda sid=sid: kvreg.register(_reg_key(sid), f"game{_gameid}", False),
             )
 
+    # Newly-registered shards may unblock queued early calls.
+    _flush_pending()
+
 
 def _create_service_entity(sid: str) -> None:
     name, _shard = _split_service_id(sid)
@@ -182,40 +250,72 @@ def _eids(name: str) -> list[str]:
     return _service_map.get(name, [])
 
 
-def call_service_any(name: str, method: str, *args) -> None:
+def _try_any(name: str, method: str, args: tuple) -> bool:
     eids = [e for e in _eids(name) if e]
     if not eids:
-        gwlog.errorf("call_service_any %s.%s: no service entity", name, method)
-        return
+        return False
     entity_manager.call_entity(random.choice(eids), method, *args)
+    return True
+
+
+def call_service_any(name: str, method: str, *args) -> None:
+    if not _try_any(name, method, args):
+        _defer(f"any {name}.{method}",
+               lambda: _try_any(name, method, args))
+
+
+def _try_all(name: str, method: str, args: tuple) -> bool:
+    # All shards must be live: a partial broadcast would silently skip the
+    # still-registering shards, so wait for full readiness instead.
+    if not check_service_entities_ready(name):
+        return False
+    for eid in _eids(name):
+        entity_manager.call_entity(eid, method, *args)
+    return True
 
 
 def call_service_all(name: str, method: str, *args) -> None:
+    if not _try_all(name, method, args):
+        _defer(f"all {name}.{method}",
+               lambda: _try_all(name, method, args))
+
+
+def _try_shard(name: str, shard: int, method: str, args: tuple) -> bool:
     eids = _eids(name)
-    if not eids:
-        gwlog.errorf("call_service_all %s.%s: no service entity", name, method)
-        return
-    for shard, eid in enumerate(eids):
-        if not eid:
-            gwlog.errorf("call_service_all %s.%s: shard %d is nil", name, method, shard)
-            continue
-        entity_manager.call_entity(eid, method, *args)
+    if not 0 <= shard < len(eids):
+        count = _registered.get(name, 0)
+        if not 0 <= shard < count:  # permanently out of range: drop loudly
+            gwlog.errorf(
+                "call_service_shard %s.%s: bad shard %d", name, method, shard
+            )
+            return True
+        return False
+    if not eids[shard]:
+        return False
+    entity_manager.call_entity(eids[shard], method, *args)
+    return True
 
 
 def call_service_shard_index(name: str, shard: int, method: str, *args) -> None:
-    eids = _eids(name)
-    if not 0 <= shard < len(eids) or not eids[shard]:
-        gwlog.errorf("call_service_shard_index %s.%s: bad shard %d", name, method, shard)
-        return
-    entity_manager.call_entity(eids[shard], method, *args)
+    if not _try_shard(name, shard, method, args):
+        _defer(f"shard {name}#{shard}.{method}",
+               lambda: _try_shard(name, shard, method, args))
 
 
 def call_service_shard_key(name: str, key: str, method: str, *args) -> None:
-    eids = _eids(name)
-    if not eids:
-        gwlog.errorf("call_service_shard_key %s.%s: no service entities", name, method)
+    count = _registered.get(name, 0) or len(_eids(name))
+    if not count:
+        # Service name unknown on this game (not registered here): the shard
+        # count is undiscoverable, so defer until the map reveals it.
+        def attempt() -> bool:
+            eids = _eids(name)
+            if not eids:
+                return False
+            return _try_shard(name, shard_by_key(key, len(eids)), method, args)
+
+        _defer(f"key {name}.{method}", attempt)
         return
-    call_service_shard_index(name, shard_by_key(key, len(eids)), method, *args)
+    call_service_shard_index(name, shard_by_key(key, count), method, *args)
 
 
 def shard_by_key(key: str, shard_count: int) -> int:
@@ -239,9 +339,13 @@ def check_service_entities_ready(name: str) -> bool:
 
 
 def clear_for_tests() -> None:
-    global _service_map, _gameid, _check_handle, _started
+    global _service_map, _gameid, _check_handle, _started, _flush_handle
     _registered.clear()
     _service_map = {}
+    _pending_calls.clear()
+    if _flush_handle is not None:
+        _flush_handle.cancel()
+    _flush_handle = None
     _gameid = 0
     if _check_handle is not None:
         _check_handle.cancel()

@@ -1,0 +1,169 @@
+"""Bot-army stress gate over a real multi-process cluster.
+
+The reference's de-facto distributed gate (SURVEY.md §4.3, .travis.yml:22-34)
+is: start a full deployment → N strict bots for D seconds → hot reload under
+load → N strict bots again → stop. This file is that gate scaled to CI time:
+a 2-dispatcher × 2-game × 2-gate cluster from the ops CLI, dozens of strict
+bots running weighted random scenarios (bot_runner.THINGS mirrors
+ClientEntity.go:166-180), with a live ``goworld reload`` in the middle.
+
+The full manual gate is:
+
+    python -m goworld_tpu.cli start examples.test_game
+    python -m goworld_tpu.client -N 200 -strict -duration 300
+    python -m goworld_tpu.cli reload examples.test_game
+    python -m goworld_tpu.client -N 200 -strict -duration 300
+    python -m goworld_tpu.cli stop examples.test_game
+
+Scale knobs: STRESS_BOTS / STRESS_DURATION env vars.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_BOTS = int(os.environ.get("STRESS_BOTS", "24"))
+DURATION = float(os.environ.get("STRESS_DURATION", "20"))
+
+INI = """\
+[deployment]
+dispatchers = 2
+games = 2
+gates = 2
+
+[dispatcher_common]
+
+[dispatcher1]
+port = {disp1}
+
+[dispatcher2]
+port = {disp2}
+
+[game_common]
+boot_entity = Account
+save_interval = 600
+
+[game1]
+[game2]
+
+[gate_common]
+heartbeat_timeout = 60
+compress_connection = true
+
+[gate1]
+port = {gate1}
+
+[gate2]
+port = {gate2}
+
+[storage]
+type = filesystem
+directory = {dir}/es
+
+[kvdb]
+type = sqlite
+directory = {dir}/kv
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def cli(run_dir, *args, timeout=120):
+    env = dict(os.environ, PYTHONPATH=REPO)
+    return subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.cli", *args],
+        cwd=run_dir, env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    d = str(tmp_path)
+    ports = {
+        "disp1": free_port(), "disp2": free_port(),
+        "gate1": free_port(), "gate2": free_port(),
+    }
+    with open(os.path.join(d, "goworld.ini"), "w") as f:
+        f.write(INI.format(dir=d, **ports))
+    r = cli(d, "start", "examples.test_game")
+    assert r.returncode == 0, r.stdout + r.stderr
+    yield d, [("127.0.0.1", ports["gate1"]), ("127.0.0.1", ports["gate2"])]
+    cli(d, "kill", "examples.test_game")
+
+
+def _dump_cluster(d: str, note: str) -> None:
+    """Preserve the cluster's logs for post-mortem (tmp_path is reaped)."""
+    import shutil
+
+    dst = "/tmp/stress_fail"
+    shutil.rmtree(dst, ignore_errors=True)
+    os.makedirs(dst)
+    for f in os.listdir(d):
+        if f.endswith(".out.log") or f == "goworld.ini":
+            shutil.copy(os.path.join(d, f), dst)
+    with open(os.path.join(dst, "note.txt"), "w") as fh:
+        fh.write(note)
+
+
+def test_bot_army_with_hot_reload(cluster):
+    """~N strict bots across both gates, hot reload mid-run, zero errors."""
+    d, gates = cluster
+    from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+    async def scenario():
+        half = DURATION / 2
+        fleet = asyncio.create_task(
+            run_fleet(
+                N_BOTS, gates, DURATION,
+                strict=True, compress=True, seed=42,
+                # The mid-run freeze/restore pauses both games for seconds;
+                # in-flight scenarios must outwait that window. The reference
+                # CI reloads BETWEEN its two bot runs — reload-under-fire is
+                # a stronger gate, paid for with a freeze-tolerant budget.
+                thing_timeout=20.0,
+            )
+        )
+        # Hot reload both games mid-run: freeze → restart -restore while the
+        # bots keep their gate sockets (reference reload-under-load gate).
+        await asyncio.sleep(half)
+        t0 = asyncio.get_running_loop().time()
+        r = await asyncio.to_thread(cli, d, "reload", "examples.test_game")
+        reload_secs = asyncio.get_running_loop().time() - t0
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "reload complete" in r.stdout
+        report = await fleet
+        report["reload_secs"] = round(reload_secs, 1)
+        return report
+
+    try:
+        report = asyncio.run(scenario())
+    except Exception as exc:
+        _dump_cluster(d, f"fleet raised: {exc!r}")
+        raise
+    text = format_report(report) + f"\nreload took {report['reload_secs']}s"
+    if report["errors"]:
+        _dump_cluster(d, text)
+    assert report["errors"] == [], text
+    # The fleet must actually have exercised the scenario mix, and the
+    # fatal-timeout scenarios must all have completed.
+    done = sum(a["count"] for a in report["things"].values())
+    assert done >= N_BOTS * 3, text
+    fatal_timeouts = {
+        t: n for t, n in report["timeouts"].items()
+        if t != "DoSayInProfChannel"
+    }
+    assert not fatal_timeouts, text

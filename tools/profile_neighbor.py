@@ -17,17 +17,22 @@ import numpy as np
 sys.path.insert(0, ".")
 
 
-def timeit(fn, *args, iters=5, warmup=2):
+def timeit(name, fn, *args, iters=3, warmup=1):
     import jax
 
+    t0 = time.perf_counter()
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))
+    print(f"[{name}] warmup+compile {time.perf_counter() - t0:.1f}s",
+          flush=True)
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(fn(*args))
         ts.append(time.perf_counter() - t0)
-    return min(ts) * 1000.0  # ms
+    ms = min(ts) * 1000.0
+    print(f"[{name}] {ms:.1f} ms", flush=True)
+    return ms
 
 
 def main():
@@ -63,7 +68,7 @@ def main():
         buc = (sm * p.grid_z + cz) * p.grid_x + cx
         return nb._build_table(p, buc, act, nb.LANES)
 
-    t_table = timeit(phase_table, pos, act, spc)
+    t_table = timeit("table", phase_table, pos, act, spc)
     table_c, slot_c, dropped_c, order_c, dst_c = jax.block_until_ready(
         phase_table(pos, act, spc))
 
@@ -75,14 +80,14 @@ def main():
         prv = (ppos[:, 0], ppos[:, 1], spc, rad, av)
         return nb._scatter_feats(p, order, dst, cur, prv)
 
-    t_scatter = timeit(phase_scatter, order_c, dst_c, pos, ppos, spc, rad, slot_c)
+    t_scatter = timeit("scatter", phase_scatter, order_c, dst_c, pos, ppos, spc, rad, slot_c)
     cells = jax.block_until_ready(
         phase_scatter(order_c, dst_c, pos, ppos, spc, rad, slot_c))
 
     # --- phase 3: the Pallas kernel ---
     kernel = nb._compiled_event_kernel(p, False)
     jkernel = jax.jit(kernel)
-    t_kernel = timeit(jkernel, cells)
+    t_kernel = timeit("kernel", jkernel, cells)
     packed_cells = jax.block_until_ready(jkernel(cells))
 
     # --- phase 4: per-entity gather + popcount ---
@@ -95,7 +100,7 @@ def main():
         pe = jnp.where((slot >= 0)[:, None], flat[safe], 0)
         return pe, jnp.sum(jax.lax.population_count(pe))
 
-    t_gather = timeit(phase_gather, packed_cells, slot_c)
+    t_gather = timeit("gather", phase_gather, packed_cells, slot_c)
     packed_e, n_e = jax.block_until_ready(phase_gather(packed_cells, slot_c))
     print(f"events in mask: {int(n_e)}")
 
@@ -106,11 +111,11 @@ def main():
     def phase_drain(packed_e, cx, cz, sm, table):
         return nb._drain_bits(p, packed_e, cx, cz, sm, table, jnp.int32(0))
 
-    t_drain = timeit(phase_drain, packed_e, cx, cz, sm, table_c)
+    t_drain = timeit("drain", phase_drain, packed_e, cx, cz, sm, table_c)
 
     # --- full step for reference ---
     step = nb._jitted_step_packed(p, "pallas")
-    t_full = timeit(step, ppos, act, spc, rad, pos, act, spc, rad,
+    t_full = timeit("full", step, ppos, act, spc, rad, pos, act, spc, rad,
                     iters=3, warmup=1)
 
     total2 = 2 * (t_table + t_scatter + t_kernel) + t_gather + 2 * t_drain
