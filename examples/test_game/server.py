@@ -110,6 +110,7 @@ class Avatar(Entity):
         desc.define_attr("enteringNilSpace")
         desc.define_attr("testCallAllN")
         desc.define_attr("complexAttr", "Client")
+        desc.define_attr("pingCount")
 
     def on_attrs_ready(self):
         a = self.attrs
@@ -142,6 +143,11 @@ class Avatar(Entity):
     def _enter_space_kind(self, kind: int):
         if self.space is not None and self.space.kind == kind:
             return
+        # Remember the LATEST intent: with queued-until-ready service calls
+        # (service._defer) a cold-start enter can be delivered late, and its
+        # DoEnterSpace routing must not stomp a newer enter the client has
+        # since requested.
+        self._pending_enter_kind = kind
         goworld.call_service_shard_key("SpaceService", str(kind), "EnterSpace", self.id, kind)
 
     def on_client_connected(self):
@@ -159,6 +165,8 @@ class Avatar(Entity):
         self._enter_space_kind(int(kind))
 
     def DoEnterSpace(self, kind: int, space_id: str):
+        if getattr(self, "_pending_enter_kind", None) != kind:
+            return  # stale routing from a superseded enter intent
         self.enter_space(space_id, _random_position())
 
     def GetSpaceID(self, caller_id: str):
@@ -189,6 +197,7 @@ class Avatar(Entity):
         # acks space entry explicitly — the bot harness keys its
         # DoEnterRandomSpace completion off this (bot_runner.py).
         super().on_enter_space()
+        self._pending_enter_kind = None
         kind = self.space.kind if self.space is not None else 0
         self.call_client("OnEnterSpace", kind)
 
@@ -207,6 +216,38 @@ class Avatar(Entity):
 
     def Move_Client(self, x: float, y: float, z: float):
         self.set_position(Vector3(x, y, z))
+
+    # --- migration test probes (no reference analog; used by
+    # tests/test_migration.py to observe cross-game hops from the client) ---
+
+    def ReportGame_Client(self):
+        self.call_client(
+            "OnReportGame",
+            goworld.get_game_id(),
+            self.space.id if self.space is not None else "",
+            self.space.kind if self.space is not None else -1,
+        )
+
+    def EnterSpaceByID_Client(self, space_id: str):
+        self.enter_space(space_id, _random_position())
+
+    def ReportAOI_Client(self):
+        self.call_client(
+            "OnReportAOI",
+            sorted(e.id for e in self.interested_in),
+            float(self.position.x), float(self.position.z),
+        )
+
+    def StartPing_Client(self, period: float):
+        self.add_timer(float(period), "PingTimer")
+
+    def PingTimer(self):
+        # Counter lives in attrs so a cross-game hop must carry it: the
+        # post-migration ping sequence continuing from the pre-migration
+        # value proves BOTH the repeat timer and the attrs migrated.
+        n = self.attrs.get_int("pingCount") + 1
+        self.attrs.set("pingCount", n)
+        self.call_client("OnPing", n)
 
     # --- mail (Avatar.go:185-231) ------------------------------------------
 

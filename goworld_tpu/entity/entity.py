@@ -470,12 +470,16 @@ class Entity:
         self.interested_in.add(other)
         other.interested_by.add(self)
         if self.client is not None:
+            gwlog.debugf("%s interest %s -> create on client %s",
+                         self, other, self.client)
             self.client.send_create_entity(other, is_player=False)
 
     def uninterest(self, other: "Entity") -> None:
         self.interested_in.discard(other)
         other.interested_by.discard(self)
         if self.client is not None:
+            gwlog.debugf("%s uninterest %s -> destroy on client %s",
+                         self, other, self.client)
             self.client.send_destroy_entity(other)
 
     def is_interested_in(self, other: "Entity") -> bool:

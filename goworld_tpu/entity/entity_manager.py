@@ -202,6 +202,8 @@ def _new_entity(
         gwutils.run_panicless(e.on_space_created)
     if space is not None:
         space._enter(e, pos or Vector3())
+    gwlog.debugf("created %r in space %s", e,
+                 e.space.id if not isinstance(e, Space) and e.space else "-")
     return e
 
 
