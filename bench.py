@@ -57,8 +57,10 @@ def _probe_tpu(diag: dict) -> tuple[bool, str]:
     works is replicated in-process. All child stderr tails are recorded in
     the output JSON so a future failure is diagnosable.
     """
+    # The axon tunnel is single-client and can stay wedged for MINUTES after
+    # a killed session; several attempts with growing backoff ride that out.
     timeout = float(os.environ.get("BENCH_TPU_PROBE_TIMEOUT", "180"))
-    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "2"))
+    attempts = int(os.environ.get("BENCH_TPU_PROBE_ATTEMPTS", "4"))
     code = (
         "import jax; d = jax.devices(); print('PLATFORM=' + d[0].platform);"
         "print('NDEV=%d' % len(d)); print('DEV0=' + str(d[0]));"
@@ -75,7 +77,7 @@ def _probe_tpu(diag: dict) -> tuple[bool, str]:
     probe_log: list[str] = []
     for attempt in range(attempts):
         if attempt:
-            time.sleep(5.0 * attempt)
+            time.sleep(30.0 * attempt)
         for name, env in strategies:
             try:
                 r = subprocess.run(

@@ -359,33 +359,33 @@ def _event_kernel(p: NeighborParams, cells_hbm, out_ref, scratch, sem):
         _FX_B, _FZ_B, _FS_B, _FR_B, _FAV_B
     )
 
-    # Bit-pack 16 candidate bits per i32 word via one MXU matmul:
-    # P[c, w] = 2^(c mod 16) if c // 16 == w else 0. Products are exact in
-    # bf16 (single-bit mantissas) and sums < 2^16 are exact in f32.
+    # Bit-pack 16 candidate bits per i32 word with integer shift-adds on the
+    # VPU — exact by construction. (Round 2 packed via an exp2 MXU matmul;
+    # f32 dot emulation loses the LSB of sums near 2^16, silently flipping
+    # one event bit per full word — and the matmul was ~70x more work than
+    # this elementwise reduce anyway.)
     w_words = 9 * LANES // _PACK
-    c_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 0)
-    w_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 1)
-    pmat = jnp.where(
-        c_iota // _PACK == w_iota,
-        jnp.exp2(jnp.mod(c_iota, _PACK).astype(jnp.float32)),
-        0.0,
-    )
-    packed = jnp.dot(
-        mask.astype(jnp.float32), pmat, preferred_element_type=jnp.float32
-    )  # [LANES, W]
-    out_ref[0, 0, 0] = packed.astype(jnp.int32)
+    m = mask.astype(jnp.int32).reshape(LANES, w_words, _PACK)
+    weights = (jnp.int32(1) << jnp.arange(_PACK, dtype=jnp.int32))
+    out_ref[0, 0, 0] = jnp.sum(m * weights[None, None, :], axis=-1)
 
 
 @functools.lru_cache(maxsize=None)
-def _compiled_event_kernel(p: NeighborParams, interpret: bool):
+def _compiled_event_kernel(p: NeighborParams, interpret: bool,
+                           rows: int | None = None):
+    """``rows`` limits the kernel to a slab of grid rows (cells input is then
+    the slab plus its 2 halo rows): the sharded engine launches one slab per
+    device (parallel/mesh.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if rows is None:
+        rows = p.grid_z
     w_words = 9 * LANES // _PACK
     kernel = functools.partial(_event_kernel, p)
     return pl.pallas_call(
         kernel,
-        grid=(p.space_slots, p.grid_z, p.grid_x),
+        grid=(p.space_slots, rows, p.grid_x),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(
             (1, 1, 1, LANES, w_words),
@@ -393,7 +393,7 @@ def _compiled_event_kernel(p: NeighborParams, interpret: bool):
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (p.space_slots, p.grid_z, p.grid_x, LANES, w_words), jnp.int32
+            (p.space_slots, rows, p.grid_x, LANES, w_words), jnp.int32
         ),
         scratch_shapes=[
             pltpu.VMEM((3, 3, _F, LANES), jnp.float32),
@@ -416,16 +416,19 @@ def _drain_bits(
     cx, cz, sm,  # i32[N] bin coords of the pass's grid
     table: jax.Array,  # i32[num_buckets * LANES] id table of the pass's grid
     start_flat: jax.Array,
+    max_events: int | None = None,
 ):
     """Pallas-path drain: page (entity, other) pairs out of the packed event
     bits. Flat index space is [N * 9 * LANES); candidate c of entity i maps
     to halo cell c // LANES (row-major 3x3) and lane c % LANES."""
+    if max_events is None:
+        max_events = p.max_events
     n = p.capacity
     cw = 9 * LANES
     total = n * cw
     flat = _unpack_bits(packed_e).reshape(-1)
     mask = flat & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
-    (idx,) = jnp.nonzero(mask, size=p.max_events, fill_value=total)
+    (idx,) = jnp.nonzero(mask, size=max_events, fill_value=total)
     idx = idx.astype(jnp.int32)
     valid = idx < total
     safe = jnp.minimum(idx, total - 1)

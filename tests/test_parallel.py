@@ -32,10 +32,11 @@ def to_sets(pairs, n):
     return out
 
 
-def test_sharded_matches_single_device():
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_sharded_matches_single_device(backend):
     mesh = make_mesh(8)
     single = NeighborEngine(PARAMS, backend="jnp")
-    sharded = ShardedNeighborEngine(PARAMS, mesh)
+    sharded = ShardedNeighborEngine(PARAMS, mesh, backend=backend)
     single.reset()
     sharded.reset()
 
@@ -81,14 +82,15 @@ def test_sharded_pipeline_matches_sync():
     assert sync_stream == pipe_stream
 
 
-def test_sharded_chunked_drain_small_buffer():
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_sharded_chunked_drain_small_buffer(backend):
     p = NeighborParams(
         capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64, max_events=128,
     )
     mesh = make_mesh(8)
     single = NeighborEngine(PARAMS, backend="jnp")  # big buffer reference
-    sharded = ShardedNeighborEngine(p, mesh)  # tiny buffer, must chunk
+    sharded = ShardedNeighborEngine(p, mesh, backend=backend)  # tiny buffer, must chunk
     single.reset()
     sharded.reset()
     pos, active, space, radius = make_world(512, 400, seed=11)
