@@ -206,13 +206,53 @@ def dial_mongo(url: str, dbname: str, callback: AsyncCallback = None):
     raise NotImplementedError("mongo backend pending a pymongo-equipped image")
 
 
-def dial_redis(url: str, callback: AsyncCallback = None):
-    """Gated: requires redis-py (not shipped in this image)."""
-    try:
-        import redis  # noqa: F401
-    except ImportError as exc:
-        raise RuntimeError(
-            "gwredis requires redis-py, which is not installed in this "
-            "environment; use goworld_tpu.ext.db.DocDB (sqlite) instead"
-        ) from exc
-    raise NotImplementedError("redis backend pending a redis-equipped image")
+class GwRedis:
+    """Async redis helper over the in-repo RESP2 client (gwredis.go:16-44):
+    every call runs on a serial worker and posts ``callback(result, err)``
+    back to the game loop."""
+
+    def __init__(self) -> None:
+        self._client = None
+        self._group = f"{_ASYNC_JOB_GROUP}:redis:{id(self)}"
+
+    def _submit(self, routine: Callable, callback: AsyncCallback) -> None:
+        async_jobs.append_job(self._group, routine, callback)
+
+    def dial(self, url: str, callback: AsyncCallback = None) -> None:
+        from goworld_tpu.netutil.resp import RespClient, parse_redis_url
+
+        def routine():
+            self._client = RespClient(**parse_redis_url(url))
+            self._client.ping()
+            return self
+
+        self._submit(routine, callback)
+
+    def command(self, *args, callback: AsyncCallback = None) -> None:
+        """Run any redis command (gwredis exposes the raw Do)."""
+        self._submit(lambda: self._client.execute(*args), callback)
+
+    def get(self, key: str, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.get(key), callback)
+
+    def set(self, key: str, val: str, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.set(key, val), callback)
+
+    def delete(self, key: str, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.delete(key), callback)
+
+    def close(self, callback: AsyncCallback = None) -> None:
+        def routine():
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+        self._submit(routine, callback)
+
+
+def dial_redis(url: str, callback: AsyncCallback = None) -> GwRedis:
+    """Connect a :class:`GwRedis` (async; callback fires on the game loop
+    with (client, err) — gwredis.go dial shape)."""
+    r = GwRedis()
+    r.dial(url, callback)
+    return r

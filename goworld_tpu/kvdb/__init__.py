@@ -33,7 +33,13 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.kvdb.sqlite import SQLiteKVDB
 
         return SQLiteKVDB(cfg.directory)
-    raise ValueError(f"unknown kvdb type {kind!r} (available: filesystem, sqlite)")
+    if kind == "redis":
+        from goworld_tpu.kvdb.redis import RedisKVDB
+
+        return RedisKVDB(cfg.url)
+    raise ValueError(
+        f"unknown kvdb type {kind!r} (available: filesystem, sqlite, redis)"
+    )
 
 
 def set_backend(backend) -> None:

@@ -39,7 +39,13 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.storage.sqlite import SQLiteEntityStorage
 
         return SQLiteEntityStorage(cfg.directory)
-    raise ValueError(f"unknown storage type {kind!r} (available: filesystem, sqlite)")
+    if kind == "redis":
+        from goworld_tpu.storage.redis import RedisEntityStorage
+
+        return RedisEntityStorage(cfg.url)
+    raise ValueError(
+        f"unknown storage type {kind!r} (available: filesystem, sqlite, redis)"
+    )
 
 
 def set_backend(backend) -> None:

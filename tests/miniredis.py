@@ -1,0 +1,150 @@
+"""Minimal in-process RESP2 server for hermetic backend tests.
+
+The reference CI provisions real mongodb/redis/mysql services for its
+backend contract suites (SURVEY.md §4.1, .travis.yml:11-17); this image has
+none, so the redis-protocol backends are tested against this dict-backed
+server speaking enough RESP2 for the client's command set: PING, AUTH,
+SELECT, GET, SET, SETNX, DEL, EXISTS, MGET, SCAN (cursorless: one page).
+
+Test infrastructure only — the production client (netutil/resp.py) knows
+nothing about it and runs unchanged against a real redis.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import socket
+import threading
+
+
+class MiniRedis:
+    def __init__(self) -> None:
+        self._dbs: dict[int, dict[bytes, bytes]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stopping = False
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # --- wire ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        db = 0
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, rest = buf.split(b"\r\n", 1)
+            buf = rest
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            data, buf = buf[:n], buf[n:]
+            return data
+
+        try:
+            while True:
+                line = read_line()
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    return
+                args = []
+                for _ in range(int(line[1:])):
+                    hdr = read_line()
+                    assert hdr.startswith(b"$")
+                    args.append(read_exact(int(hdr[1:])))
+                    read_exact(2)
+                reply, db = self._dispatch(args, db)
+                conn.sendall(reply)
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # --- commands -----------------------------------------------------------
+
+    @staticmethod
+    def _bulk(v: bytes | None) -> bytes:
+        return b"$-1\r\n" if v is None else b"$%d\r\n%s\r\n" % (len(v), v)
+
+    def _dispatch(self, args: list[bytes], db: int) -> tuple[bytes, int]:
+        cmd = args[0].upper()
+        with self._lock:
+            store = self._dbs.setdefault(db, {})
+            if cmd == b"PING":
+                return b"+PONG\r\n", db
+            if cmd == b"AUTH":
+                return b"+OK\r\n", db
+            if cmd == b"SELECT":
+                return b"+OK\r\n", int(args[1])
+            if cmd == b"SET":
+                store[args[1]] = args[2]
+                return b"+OK\r\n", db
+            if cmd == b"GET":
+                return self._bulk(store.get(args[1])), db
+            if cmd == b"SETNX":
+                if args[1] in store:
+                    return b":0\r\n", db
+                store[args[1]] = args[2]
+                return b":1\r\n", db
+            if cmd == b"DEL":
+                n = sum(1 for k in args[1:] if store.pop(k, None) is not None)
+                return b":%d\r\n" % n, db
+            if cmd == b"EXISTS":
+                n = sum(1 for k in args[1:] if k in store)
+                return b":%d\r\n" % n, db
+            if cmd == b"MGET":
+                parts = [b"*%d\r\n" % (len(args) - 1)]
+                parts += [self._bulk(store.get(k)) for k in args[1:]]
+                return b"".join(parts), db
+            if cmd == b"SCAN":
+                pattern = b"*"
+                for i, a in enumerate(args):
+                    if a.upper() == b"MATCH":
+                        pattern = args[i + 1]
+                keys = [
+                    k for k in store
+                    if fnmatch.fnmatchcase(
+                        k.decode("utf-8", "replace"),
+                        pattern.decode("utf-8", "replace"),
+                    )
+                ]
+                parts = [b"*2\r\n$1\r\n0\r\n", b"*%d\r\n" % len(keys)]
+                parts += [self._bulk(k) for k in keys]
+                return b"".join(parts), db
+            return b"-ERR unknown command '%s'\r\n" % cmd, db

@@ -4,6 +4,8 @@ Mirrors the reference's approach of running one shared Set/Find suite over
 every backend (kvdb_backend_test.go:19-115, SURVEY.md §4.1).
 """
 
+import os
+
 import pytest
 
 from goworld_tpu import kvdb, storage
@@ -11,17 +13,36 @@ from goworld_tpu.config.read_config import KVDBConfig, StorageConfig
 from goworld_tpu.utils import post
 
 
-@pytest.fixture(params=["filesystem", "sqlite"])
-def entity_backend(request, tmp_path):
-    cfg = StorageConfig(type=request.param, directory=str(tmp_path / "es"))
+@pytest.fixture
+def redis_url():
+    """A real server if GOWORLD_REDIS_URL is set (the reference's CI-service
+    mode), else the in-repo MiniRedis speaking RESP2 on a loopback port."""
+    url = os.environ.get("GOWORLD_REDIS_URL")
+    if url:
+        yield url
+        return
+    from miniredis import MiniRedis
+
+    srv = MiniRedis()
+    yield f"redis://127.0.0.1:{srv.port}/0"
+    srv.stop()
+
+
+@pytest.fixture(params=["filesystem", "sqlite", "redis"])
+def entity_backend(request, tmp_path, redis_url):
+    cfg = StorageConfig(
+        type=request.param, directory=str(tmp_path / "es"), url=redis_url
+    )
     backend = storage.make_backend(request.param, cfg)
     yield backend
     backend.close()
 
 
-@pytest.fixture(params=["filesystem", "sqlite"])
-def kv_backend(request, tmp_path):
-    cfg = KVDBConfig(type=request.param, directory=str(tmp_path / "kv"))
+@pytest.fixture(params=["filesystem", "sqlite", "redis"])
+def kv_backend(request, tmp_path, redis_url):
+    cfg = KVDBConfig(
+        type=request.param, directory=str(tmp_path / "kv"), url=redis_url
+    )
     backend = kvdb.make_backend(request.param, cfg)
     yield backend
     backend.close()
