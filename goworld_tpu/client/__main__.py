@@ -30,6 +30,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-gate", action="append", default=[],
                     help="explicit gate host:port (repeatable; overrides ini)")
     ap.add_argument("-ws", action="store_true", help="connect over WebSocket")
+    ap.add_argument("-rudp", action="store_true",
+                    help="connect over reliable UDP (the reference's kcp mode)")
     ap.add_argument("-tls", action="store_true", help="TLS client link")
     ap.add_argument("-compress", action="store_true",
                     help="compressed client link")
@@ -60,7 +62,7 @@ def main(argv: list[str] | None = None) -> int:
     report = asyncio.run(
         run_fleet(
             args.N, gates, args.duration,
-            strict=args.strict, ws=args.ws, tls=args.tls,
+            strict=args.strict, ws=args.ws, rudp=args.rudp, tls=args.tls,
             compress=args.compress, seed=args.seed,
         )
     )

@@ -63,6 +63,7 @@ class ScenarioBot:
         strict: bool = False,
         n_clients: int = 1,
         ws: bool = False,
+        rudp: bool = False,
         tls: bool = False,
         compress: bool = False,
         seed: Optional[int] = None,
@@ -73,6 +74,7 @@ class ScenarioBot:
         self.host = host
         self.port = port
         self.ws = ws
+        self.rudp = rudp
         self.n_clients = n_clients
         self.rng = random.Random(seed)
         self.bot = ClientBot(
@@ -277,6 +279,8 @@ class ScenarioBot:
     async def run(self, duration: float) -> None:
         if self.ws:
             await self.bot.connect_ws(self.host, self.port)
+        elif self.rudp:
+            await self.bot.connect_rudp(self.host, self.port)
         else:
             await self.bot.connect(self.host, self.port)
         sync_task: Optional[asyncio.Task] = None
@@ -330,6 +334,7 @@ async def run_fleet(
     *,
     strict: bool = False,
     ws: bool = False,
+    rudp: bool = False,
     tls: bool = False,
     compress: bool = False,
     seed: Optional[int] = None,
@@ -346,8 +351,8 @@ async def run_fleet(
     bots = [
         ScenarioBot(
             i, *gates[i % len(gates)], strict=strict, n_clients=n,
-            ws=ws, tls=tls, compress=compress, seed=rng.randrange(2**31),
-            thing_timeout=thing_timeout,
+            ws=ws, rudp=rudp, tls=tls, compress=compress,
+            seed=rng.randrange(2**31), thing_timeout=thing_timeout,
         )
         for i in range(n)
     ]

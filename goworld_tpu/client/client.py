@@ -163,6 +163,20 @@ class ClientBot:
         self.conn = GoWorldConnection(WSPacketConnection(ws))
         self._start_pumps()
 
+    async def connect_rudp(
+        self, host: str, port: int, loss_simulation: float = 0.0
+    ) -> None:
+        """Connect over the reliable-UDP transport (the reference's -mode
+        kcp; netutil/rudp.py). ``loss_simulation`` drops that fraction of
+        outgoing datagrams — the ARQ layer must recover (tests)."""
+        from goworld_tpu.netutil.rudp import connect_rudp
+
+        pconn = await connect_rudp(host, port, loss_simulation)
+        if self.compress:
+            pconn.enable_compression()
+        self.conn = GoWorldConnection(pconn)
+        self._start_pumps()
+
     def _start_pumps(self) -> None:
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._recv_loop()))

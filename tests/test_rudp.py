@@ -1,0 +1,149 @@
+"""Reliable-UDP transport: ARQ protocol units + lossy gate e2e.
+
+The reference gates KCP behind the same client protocol as TCP
+(GateService.go:134-165); here the from-scratch ARQ (netutil/rudp.py) must
+deliver the framed stream exactly, in order, under heavy simulated loss,
+and a bot must complete login + RPC + AOI over a 5%-loss link end to end
+(VERDICT r2 missing #3 done-criterion).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+
+import pytest
+
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.netutil.rudp import (
+    _HDR,
+    MSS,
+    RUDPEndpoint,
+    RUDPPacketConnection,
+)
+
+from test_gate import (  # the in-process 1x1x1 e2e stack
+    clean_entities,  # noqa: F401  (fixture re-export)
+    connect_bot,
+    start_stack,
+    stop_stack,
+    wait_for,
+)
+
+
+def _pipe_pair(loss_a=0.0, loss_b=0.0):
+    """Two endpoints joined by an in-memory datagram pipe with optional
+    per-direction loss (loss is applied by the endpoints themselves)."""
+    ref = {}
+
+    def to_b(data):
+        conv, cmd, seq, ack = _HDR.unpack_from(data, 0)
+        asyncio.get_running_loop().call_soon(
+            ref["b"].on_datagram, cmd, seq, ack, data[_HDR.size:]
+        )
+
+    def to_a(data):
+        conv, cmd, seq, ack = _HDR.unpack_from(data, 0)
+        asyncio.get_running_loop().call_soon(
+            ref["a"].on_datagram, cmd, seq, ack, data[_HDR.size:]
+        )
+
+    a = RUDPEndpoint(7, to_b)
+    b = RUDPEndpoint(7, to_a)
+    a.loss_simulation = loss_a
+    b.loss_simulation = loss_b
+    ref["a"], ref["b"] = a, b
+    return a, b
+
+
+def _frame(msgtype: int, payload: bytes) -> bytes:
+    body = struct.pack("<H", msgtype) + payload
+    return struct.pack("<I", len(body)) + body
+
+
+def test_rudp_ordered_delivery_under_loss():
+    async def run():
+        a, b = _pipe_pair(loss_a=0.2, loss_b=0.2)
+        msgs = [(i, bytes([i % 251]) * (37 * i % 4000)) for i in range(1, 60)]
+        for mt, payload in msgs:
+            a.send_bytes(_frame(mt, payload))
+        got = []
+        async def collect():
+            while len(got) < len(msgs):
+                got.append(await b.recv_packet())
+        await asyncio.wait_for(collect(), 30)
+        assert [(mt, p.payload) for mt, p in got] == msgs
+        a.close(); b.close()
+
+    asyncio.run(run())
+
+
+def test_rudp_large_message_fragmentation():
+    async def run():
+        a, b = _pipe_pair(loss_a=0.1, loss_b=0.1)
+        big = bytes(range(256)) * 256  # 64 KiB → ~55 segments
+        a.send_bytes(_frame(9, big))
+        mt, p = await asyncio.wait_for(b.recv_packet(), 30)
+        assert mt == 9 and p.payload == big
+        assert len(big) > MSS * 10
+        a.close(); b.close()
+
+    asyncio.run(run())
+
+
+def test_rudp_packet_connection_compression_roundtrip():
+    async def run():
+        a, b = _pipe_pair()
+        ca, cb = RUDPPacketConnection(a), RUDPPacketConnection(b)
+        ca.enable_compression()
+        pkt = Packet(b"Z" * 5000)  # compressible
+        ca.send_packet(42, pkt)
+        mt, p = await asyncio.wait_for(cb.recv_packet(), 10)
+        assert (mt, p.payload) == (42, b"Z" * 5000)
+        ca.close(); cb.close()
+
+    asyncio.run(run())
+
+
+@pytest.mark.slow
+def test_rudp_gate_e2e_with_5pct_loss(clean_entities, tmp_path):  # noqa: F811
+    """A bot over reliable UDP with 5% loss in BOTH directions completes
+    login, RPC round trips, and the AOI scenario beside a TCP bot."""
+
+    async def run():
+        from goworld_tpu.client import ClientBot
+
+        disp, game, game_task, gate = await start_stack(tmp_path)
+        gate._rudp_listener.loss_simulation = 0.05  # server→client loss
+        bots = []
+        try:
+            tcp_bot = await connect_bot(gate, name="tcp")
+            bots.append(tcp_bot)
+
+            udp_bot = ClientBot(name="udp", strict=True, heartbeat_interval=1.0)
+            await udp_bot.connect_rudp(
+                "127.0.0.1", gate.port, loss_simulation=0.05
+            )
+            bots.append(udp_bot)
+            player = await udp_bot.wait_player(timeout=20)
+
+            # RPC + AllClients attr round trip over the lossy link.
+            player.call_server("SetName_Client", "lossy")
+            assert await wait_for(
+                lambda: player.attrs.get("name") == "lossy", 20
+            )
+
+            # AOI: both avatars enter the arena; the lossy client must see
+            # the TCP avatar's mirror created by the AOI plane.
+            tcp_bot.player.call_server("EnterArena_Client")
+            udp_bot.player.call_server("EnterArena_Client")
+            assert await wait_for(
+                lambda: tcp_bot.player.id in udp_bot.entities, 20
+            ), "udp bot never saw the tcp avatar via AOI"
+            assert await wait_for(
+                lambda: udp_bot.player.id in tcp_bot.entities, 20
+            ), "tcp bot never saw the udp avatar via AOI"
+        finally:
+            await stop_stack(disp, game, game_task, gate, bots)
+
+    asyncio.run(run())
