@@ -41,8 +41,11 @@ Two execution paths with identical semantics:
   ``[space_slot, gz, gx, F, 128]`` (the boids layout, ops/boids.py); one
   program per cell DMAs its 3×3 halo block HBM→VMEM, evaluates the pairwise
   predicates for 128 × 1152 pairs on the VPU, and bit-packs the event mask
-  16-bits-per-word via an MXU matmul — no [N, candidates] float intermediate
-  ever reaches HBM (round 1 shipped ~200 MB × several per tick).
+  16-bits-per-word with integer shift-adds — no [N, candidates] float
+  intermediate ever reaches HBM (round 1 shipped ~200 MB × several per
+  tick). Around the kernel everything is gathers, cumsums and sorts — no
+  large TPU scatters (round 2's feature scatter and nonzero-based drain
+  were both scatter-bound).
 - **jnp reference** (CPU tests / oracle): the same two-grid pairwise math
   over gathered candidate id matrices.
 
@@ -63,7 +66,7 @@ import jax.numpy as jnp
 import numpy as np
 
 LANES = 128  # Pallas cell capacity = one TPU lane dimension
-_PACK = 16  # event-mask bits packed per i32 word (exact in f32 matmul)
+_PACK = 16  # event-mask bits packed per i32 word
 _F = 16  # padded feature count (sublane multiple of 8)
 
 # Feature rows in the dense cell layout. Epoch A = the epoch whose positions
@@ -296,20 +299,28 @@ def _step_packed_jnp(p: NeighborParams, ppos, pact, pspc, prad, pos, act, spc, r
 # --- Pallas path -------------------------------------------------------------
 
 
-def _scatter_feats(p: NeighborParams, order, dst, feats_a, feats_b):
-    """Scatter per-entity features into the dense cell layout and wrap-pad.
+def _scatter_feats(p: NeighborParams, table, feats_a, feats_b):
+    """Build the dense cell feature layout by GATHERING through the slot
+    table (``table[slot] = entity or sentinel N`` is already the inverse of
+    the scatter round 2 did here — and TPU gathers are far cheaper than the
+    10 scatters per pass they replace).
 
     feats_a = (x, z, space, radius, av) of the epoch the grid is binned by;
-    feats_b = the same five for the other epoch. Returns
-    f32[space_slots, gz+2, gx+2, F, LANES].
+    feats_b = the same five for the other epoch. The ``av`` rows are gated
+    to 0 on empty slots; other rows may carry garbage there, which the
+    kernel's av test masks out. Returns f32[space_slots, gz+2, gx+2, F,
+    LANES] with a torus halo ring.
     """
-    flat_size = p.num_buckets * LANES
+    n = p.capacity
+    safe = jnp.minimum(table, n - 1)
+    present = table < n
 
-    def scatter(values):
-        flat = jnp.zeros((flat_size,), jnp.float32)
-        return flat.at[dst].set(values[order].astype(jnp.float32), mode="drop")
+    def gather(values, gate: bool = False):
+        out = values[safe].astype(jnp.float32)
+        return jnp.where(present, out, 0.0) if gate else out
 
-    rows = [scatter(v) for v in feats_a] + [scatter(v) for v in feats_b]
+    rows = [gather(v, gate=i == 4) for i, v in enumerate(feats_a)]
+    rows += [gather(v, gate=i == 4) for i, v in enumerate(feats_b)]
     feats = jnp.stack(rows)  # [10, flat]
     feats = jnp.pad(feats, ((0, _F - len(rows)), (0, 0)))
     cells = feats.reshape(_F, p.space_slots, p.grid_z, p.grid_x, LANES)
@@ -415,36 +426,65 @@ def _drain_bits(
     packed_e: jax.Array,  # i32[N, W] per-entity packed event mask
     cx, cz, sm,  # i32[N] bin coords of the pass's grid
     table: jax.Array,  # i32[num_buckets * LANES] id table of the pass's grid
-    start_flat: jax.Array,
-    max_events: int | None = None,
+    start_flat: jax.Array,  # EVENT RANK to resume from (name kept for the
+    max_events: int | None = None,  # shared pager call signature)
 ):
-    """Pallas-path drain: page (entity, other) pairs out of the packed event
-    bits. Flat index space is [N * 9 * LANES); candidate c of entity i maps
-    to halo cell c // LANES (row-major 3x3) and lane c % LANES."""
+    """Pallas-path drain: extract the (entity, other) pairs for event RANKS
+    [start_rank, start_rank + max_events) out of the packed bit mask.
+
+    Hierarchical rank-select instead of ``jnp.nonzero`` (round 2): nonzero's
+    ``bincount(cumsum(mask))`` lowering scatter-adds over the full
+    N * 9 * LANES flat space (118M elements at the headline config — a
+    multi-second TPU scatter). Here the only full-size ops are popcounts
+    and per-axis cumsums; each requested event then finds its row by binary
+    search, its word by a 72-wide prefix compare, and its bit by a 16-wide
+    prefix compare — ~max_events * 90 lanes of work, no scatter.
+
+    Candidate c of entity i maps to halo cell c // LANES (row-major 3x3) and
+    lane c % LANES. Returns (pairs i32[max_events, 2], row_counts' total) —
+    paging resumes at start_rank + max_events.
+    """
     if max_events is None:
         max_events = p.max_events
+    start_rank = start_flat
     n = p.capacity
-    cw = 9 * LANES
-    total = n * cw
-    flat = _unpack_bits(packed_e).reshape(-1)
-    mask = flat & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
-    (idx,) = jnp.nonzero(mask, size=max_events, fill_value=total)
-    idx = idx.astype(jnp.int32)
-    valid = idx < total
-    safe = jnp.minimum(idx, total - 1)
-    ent = safe // cw
-    c = safe % cw
+    pc = jax.lax.population_count(packed_e)  # [N, W]
+    row_counts = jnp.sum(pc, axis=1)  # [N]
+    row_cum = jnp.cumsum(row_counts)  # inclusive
+    row_starts = row_cum - row_counts  # exclusive
+    total = row_cum[-1]
+
+    j = start_rank + jnp.arange(max_events, dtype=jnp.int32)
+    valid = j < total
+    row = jnp.searchsorted(row_starts, j, side="right").astype(jnp.int32) - 1
+    row = jnp.clip(row, 0, n - 1)
+    k = j - row_starts[row]  # event rank within its row
+
+    row_pc = pc[row]  # [E, W]
+    word_cum = jnp.cumsum(row_pc, axis=1)  # inclusive
+    w = jnp.sum((word_cum <= k[:, None]).astype(jnp.int32), axis=1)
+    w = jnp.minimum(w, row_pc.shape[1] - 1)
+    word_start = word_cum[jnp.arange(max_events), w] - row_pc[jnp.arange(max_events), w]
+    kk = k - word_start  # set-bit rank within the word
+
+    word = packed_e[row, w]
+    bits = (word[:, None] >> jnp.arange(_PACK, dtype=jnp.int32)) & 1
+    bcum = jnp.cumsum(bits, axis=1)  # inclusive set-bit counts
+    b = jnp.sum((bcum <= kk[:, None]).astype(jnp.int32), axis=1)
+    b = jnp.minimum(b, _PACK - 1)
+
+    c = w * _PACK + b  # candidate index within the row's 3x3 halo
     hc = c // LANES
     lane = c % LANES
     dzo = hc // 3 - 1
     dxo = hc % 3 - 1
-    czz = jnp.mod(cz[ent] + dzo, p.grid_z)
-    cxx = jnp.mod(cx[ent] + dxo, p.grid_x)
-    bucket = (sm[ent] * p.grid_z + czz) * p.grid_x + cxx
+    czz = jnp.mod(cz[row] + dzo, p.grid_z)
+    cxx = jnp.mod(cx[row] + dxo, p.grid_x)
+    bucket = (sm[row] * p.grid_z + czz) * p.grid_x + cxx
     other = table[bucket * LANES + lane]
-    ent = jnp.where(valid, ent, n)
+    ent = jnp.where(valid, row, n)
     other = jnp.where(valid, other, n)
-    return jnp.stack([ent, other], axis=1), idx
+    return jnp.stack([ent, other], axis=1), total
 
 
 def _step_pallas(
@@ -467,8 +507,8 @@ def _step_pallas(
 
     cur_feats = (pos[:, 0], pos[:, 1], spc, rad, av_c)
     prev_feats = (ppos[:, 0], ppos[:, 1], pspc, prad, av_p)
-    cells_c = _scatter_feats(p, order_c, dst_c, cur_feats, prev_feats)
-    cells_p = _scatter_feats(p, order_p, dst_p, prev_feats, cur_feats)
+    cells_c = _scatter_feats(p, table_c, cur_feats, prev_feats)
+    cells_p = _scatter_feats(p, table_p, prev_feats, cur_feats)
 
     packed_cells_e = kernel(cells_c)  # enter mask, rows = current grid
     packed_cells_l = kernel(cells_p)  # leave mask, rows = previous grid
@@ -485,9 +525,18 @@ def _step_pallas(
     n_enters = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
     n_leaves = jnp.sum(jax.lax.population_count(packed_l)).astype(jnp.int32)
 
-    ep, ei = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0))
-    lp, li = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0))
-    out = _pack_out(p, ep, ei, lp, li, n_enters, n_leaves, dropped_c)
+    ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0))
+    lp, _ = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0))
+    # Rank-based paging resumes at max_events, so the cursor row is unused.
+    zero = jnp.int32(0)
+    header = jnp.stack(
+        [
+            jnp.stack([n_enters, n_leaves]),
+            jnp.stack([dropped_c, zero]),
+            jnp.stack([zero, zero]),
+        ]
+    ).astype(jnp.int32)
+    out = jnp.concatenate([header, ep, lp], axis=0)
     # Paging context: everything _drain_bits needs for overflow chunks.
     enter_ctx = (packed_e, cxc, czc, smc, table_c)
     leave_ctx = (packed_l, cxp, czp, smp, table_p)
@@ -581,13 +630,19 @@ class PendingStep:
         enter_last, leave_last = int(out[2, 0]), int(out[2, 1])
         enters = out[3:3 + min(n_e, e)]
         leaves = out[3 + e:3 + e + min(n_l, e)]
-        if n_e > e:  # mass-spawn storm: page the rest (rare)
+        # Storm paging (rare): the pallas drain pages by event RANK (resume
+        # at e), the jnp drain by flat matrix index (resume after the last
+        # drained position).
+        rank_paging = eng.backend != "jnp"
+        if n_e > e:
             enters = np.concatenate(
-                [enters, self._pager("enter", n_e - e, enter_last + 1)]
+                [enters,
+                 self._pager("enter", n_e - e, e if rank_paging else enter_last + 1)]
             )
         if n_l > e:
             leaves = np.concatenate(
-                [leaves, self._pager("leave", n_l - e, leave_last + 1)]
+                [leaves,
+                 self._pager("leave", n_l - e, e if rank_paging else leave_last + 1)]
             )
         eng.last_grid_dropped = dropped
         if dropped:
@@ -655,13 +710,14 @@ class NeighborEngine:
     def _page(self, ctx, remaining: int, start_flat: int) -> np.ndarray:
         chunks = []
         start = jnp.int32(start_flat)
+        rank_paging = self.backend != "jnp"
         while remaining > 0:
-            pairs, idx = self._jit_drain(*ctx, start_flat=start)
+            pairs, aux = self._jit_drain(*ctx, start_flat=start)
             take = min(self.params.max_events, remaining)
             chunks.append(np.asarray(pairs[:take]))
             remaining -= take
             if remaining > 0:
-                start = idx[take - 1] + 1
+                start = start + take if rank_paging else aux[take - 1] + 1
         return np.concatenate(chunks)
 
     def step_async(

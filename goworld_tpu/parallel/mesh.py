@@ -199,7 +199,7 @@ def _sharded_step_pallas(
         av_b = (slot_b >= 0).astype(jnp.float32)
         feats_a = (apos[:, 0], apos[:, 1], aspc, arad, av_a)
         feats_b = (bpos[:, 0], bpos[:, 1], bspc, brad, av_b)
-        cells = _scatter_feats(p, order, dst, feats_a, feats_b)
+        cells = _scatter_feats(p, table, feats_a, feats_b)
         slab = jax.lax.dynamic_slice_in_dim(cells, lo, rows + 2, axis=1)
         packed_cells = kernel(slab)  # [S, rows, gx, LANES, W]
 
@@ -221,15 +221,16 @@ def _sharded_step_pallas(
         ppos, pact, pspc, prad, pos, act, spc, rad
     )
 
-    ep, ei = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0),
-                         max_events=events_inline)
-    lp, li = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0),
-                         max_events=events_inline)
+    ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0),
+                        max_events=events_inline)
+    lp, _ = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0),
+                        max_events=events_inline)
+    zero = jnp.int32(0)
     header = jnp.stack(
         [
             jnp.stack([n_enters, n_leaves]),
-            jnp.stack([dropped_c, jnp.int32(0)]),
-            jnp.stack([ei[events_inline - 1], li[events_inline - 1]]),
+            jnp.stack([dropped_c, zero]),
+            jnp.stack([zero, zero]),  # rank paging resumes at events_inline
         ]
     ).astype(jnp.int32)
     out = jnp.concatenate([header, ep, lp], axis=0)
@@ -241,14 +242,14 @@ def _sharded_step_pallas(
 def _sharded_drain_bits(
     p: NeighborParams, events_inline: int,
     packed_l, cx_l, cz_l, sm_l, table_l,  # per-shard drain context
-    start_l: jax.Array,  # [1] resume cursor
+    start_l: jax.Array,  # [1] resume RANK
 ):
     """Pallas-path storm paging: rows are global entity ids already."""
-    pairs, idx = _drain_bits(
+    pairs, total = _drain_bits(
         p, packed_l, cx_l, cz_l, sm_l, table_l, start_l[0],
         max_events=events_inline,
     )
-    return pairs, idx[None]
+    return pairs, total[None]
 
 
 def _sharded_drain(
@@ -356,6 +357,7 @@ class ShardedPendingStep:
         enter_starts = np.zeros(eng.n_devices, np.int32)
         leave_starts = np.zeros(eng.n_devices, np.int32)
         dropped = 0
+        rank_paging = eng.backend != "jnp"
         for d in range(eng.n_devices):
             o = out[d * block:(d + 1) * block]
             n_e, n_l = int(o[0, 0]), int(o[0, 1])
@@ -364,8 +366,11 @@ class ShardedPendingStep:
             leaves.append(o[3 + e:3 + e + min(n_l, e)])
             enter_deficit[d] = max(0, n_e - e)
             leave_deficit[d] = max(0, n_l - e)
-            enter_starts[d] = int(o[2, 0]) + 1
-            leave_starts[d] = int(o[2, 1]) + 1
+            if rank_paging:  # resume by event rank
+                enter_starts[d] = leave_starts[d] = e
+            else:  # resume after the last drained flat index
+                enter_starts[d] = int(o[2, 0]) + 1
+                leave_starts[d] = int(o[2, 1]) + 1
         if enter_deficit.any():
             enters += eng._page(self._enter_ctx, enter_deficit, enter_starts)
         if leave_deficit.any():
@@ -457,12 +462,13 @@ class ShardedNeighborEngine:
         chunks: list[np.ndarray] = []
         starts = starts.copy()
         deficit = deficit.copy()
+        rank_paging = self.backend != "jnp"
         while deficit.any():
-            pairs, idx = self._jit_drain(
+            pairs, aux = self._jit_drain(
                 *ctx, jax.device_put(np.asarray(starts, np.int32), self._sharding)
             )
             pairs = np.asarray(pairs)
-            idx = np.asarray(idx)
+            aux = np.asarray(aux)
             e = self.events_inline
             for d in range(self.n_devices):
                 take = int(min(e, deficit[d]))
@@ -471,7 +477,9 @@ class ShardedNeighborEngine:
                 chunks.append(pairs[d * e:d * e + take])
                 deficit[d] -= take
                 if deficit[d] > 0:
-                    starts[d] = idx[d, take - 1] + 1
+                    starts[d] = (
+                        starts[d] + take if rank_paging else aux[d, take - 1] + 1
+                    )
                 else:
                     starts[d] = self._flat_end
         return chunks

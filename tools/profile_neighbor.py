@@ -74,15 +74,15 @@ def main():
 
     # --- phase 2: feature scatter ---
     @jax.jit
-    def phase_scatter(order, dst, pos, ppos, spc, rad, slot):
+    def phase_scatter(table, pos, ppos, spc, rad, slot):
         av = (slot >= 0).astype(jnp.float32)
         cur = (pos[:, 0], pos[:, 1], spc, rad, av)
         prv = (ppos[:, 0], ppos[:, 1], spc, rad, av)
-        return nb._scatter_feats(p, order, dst, cur, prv)
+        return nb._scatter_feats(p, table, cur, prv)
 
-    t_scatter = timeit("scatter", phase_scatter, order_c, dst_c, pos, ppos, spc, rad, slot_c)
+    t_scatter = timeit("scatter", phase_scatter, table_c, pos, ppos, spc, rad, slot_c)
     cells = jax.block_until_ready(
-        phase_scatter(order_c, dst_c, pos, ppos, spc, rad, slot_c))
+        phase_scatter(table_c, pos, ppos, spc, rad, slot_c))
 
     # --- phase 3: the Pallas kernel ---
     kernel = nb._compiled_event_kernel(p, False)
