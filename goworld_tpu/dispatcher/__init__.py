@@ -22,11 +22,17 @@ def run(dispid: int | None = None) -> int:
     parser.add_argument("-dispid", type=int, default=dispid or 1)
     parser.add_argument("-configfile", type=str, default="")
     parser.add_argument("-log", type=str, default="")
+    parser.add_argument("-d", action="store_true", help="daemonize")
     args, _ = parser.parse_known_args()
     if args.configfile:
         set_config_file(args.configfile)
     cfg = get_config()
     disp_cfg = cfg.dispatchers.get(args.dispid)
+    if args.d:
+        from goworld_tpu.utils.binutil import daemonize
+
+        daemonize((disp_cfg.log_file if disp_cfg else None)
+                  or f"dispatcher{args.dispid}.daemon.log")
     gwlog.setup(
         level=(args.log or (disp_cfg.log_level if disp_cfg else "info")),
         logfile=(disp_cfg.log_file if disp_cfg else None) or None,

@@ -419,11 +419,18 @@ def run(gameid: int | None = None, restore: bool | None = None) -> int:
     parser.add_argument("-configfile", type=str, default="")
     parser.add_argument("-log", type=str, default="")
     parser.add_argument("-restore", action="store_true", default=bool(restore))
+    parser.add_argument("-d", action="store_true",
+                        help="daemonize (binutil.Daemonize, game.go:70-77)")
     args, _ = parser.parse_known_args()
     if args.configfile:
         set_config_file(args.configfile)
     cfg = get_config()
     game_cfg = cfg.games.get(args.gid)
+    if args.d:
+        from goworld_tpu.utils.binutil import daemonize
+
+        daemonize((game_cfg.log_file if game_cfg else None)
+                  or f"game{args.gid}.daemon.log")
     gwlog.setup(
         level=(args.log or (game_cfg.log_level if game_cfg else "info")),
         logfile=(game_cfg.log_file if game_cfg else None) or None,

@@ -437,11 +437,17 @@ def run(gateid: int | None = None) -> int:
     parser.add_argument("-gid", type=int, default=gateid or 1)
     parser.add_argument("-configfile", type=str, default="")
     parser.add_argument("-log", type=str, default="")
+    parser.add_argument("-d", action="store_true", help="daemonize")
     args, _ = parser.parse_known_args()
     if args.configfile:
         set_config_file(args.configfile)
     cfg = get_config()
     gate_cfg = cfg.gates.get(args.gid)
+    if args.d:
+        from goworld_tpu.utils.binutil import daemonize
+
+        daemonize((gate_cfg.log_file if gate_cfg else None)
+                  or f"gate{args.gid}.daemon.log")
     gwlog.setup(
         level=(args.log or (gate_cfg.log_level if gate_cfg else "info")),
         logfile=(gate_cfg.log_file if gate_cfg else None) or None,

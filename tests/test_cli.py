@@ -87,6 +87,51 @@ async def _login_bot(gate_port: int):
     return bot
 
 
+def test_daemonize_mode(run_dir):
+    """-d detaches the process (binutil's go-daemon slot): the launcher
+    returns immediately while the daemon keeps serving its port."""
+    import signal
+    import socket
+
+    d, _ = run_dir
+    r = subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.dispatcher", "-dispid", "1",
+         "-configfile", os.path.join(d, "goworld.ini"), "-d"],
+        cwd=d, env=dict(os.environ, PYTHONPATH=REPO),
+        capture_output=True, text=True, timeout=30,
+    )
+    assert r.returncode == 0  # parent exits immediately
+    import configparser
+
+    ini = configparser.ConfigParser()
+    ini.read(os.path.join(d, "goworld.ini"))
+    port = int(ini["dispatcher1"]["port"])
+    daemon_pid = None
+    try:
+        ok = False
+        for _ in range(100):
+            try:
+                with socket.create_connection(("127.0.0.1", port), 1.0):
+                    ok = True
+                    break
+            except OSError:
+                time.sleep(0.1)
+        assert ok, "daemonized dispatcher never served its port"
+    finally:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit():
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmd = f.read().decode(errors="replace")
+            except OSError:
+                continue
+            if "goworld_tpu.dispatcher" in cmd and d in cmd:
+                daemon_pid = int(pid)
+                os.kill(daemon_pid, signal.SIGTERM)
+    assert daemon_pid is not None, "daemon process not found"
+
+
 def test_cli_full_cycle(run_dir):
     d, gate_port = run_dir
 
