@@ -147,7 +147,8 @@ def _resolve_platform(diag: dict) -> str:
 
 
 def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
-              label: str = "aoi") -> dict:
+              label: str = "aoi", cell_override: float | None = None,
+              grid_override: int | None = None) -> dict:
     """The production AOI loop (BatchAOIService path): pipelined step_async +
     single packed readback per tick. n_spaces>1 = BASELINE config 3 (batched
     cross-space AOI in one launch)."""
@@ -173,6 +174,10 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
     else:
         cell, cap = 100.0, 64
         grid = max(8, int(round(128 * (n / 102400.0) ** 0.5 / 8)) * 8)
+    if cell_override is not None:
+        cell = cell_override
+    if grid_override is not None:
+        grid = grid_override
     params = NeighborParams(
         capacity=n,
         cell_size=cell,
@@ -290,6 +295,91 @@ def bench_boids() -> dict:
     }
 
 
+def bench_phase_profile(n: int = 102400, cell: float = 300.0,
+                        grid: int = 44) -> dict:
+    """Attribute the tick budget: time each stage of the Pallas step in
+    isolation (VERDICT r2 #8 — name the phase that owns the p99 gap)."""
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops import neighbor as nb
+
+    p = nb.NeighborParams(
+        capacity=n, cell_size=cell, grid_x=grid, grid_z=grid,
+        space_slots=4, cell_capacity=128, max_events=131072,
+    )
+    rng = np.random.default_rng(0)
+    world = grid * cell
+    pos = jnp.asarray(rng.uniform(0, world, (n, 2)).astype(np.float32))
+    ppos = jnp.asarray(
+        np.asarray(pos) + rng.normal(0, 3, (n, 2)).astype(np.float32)
+    )
+    act = jnp.ones(n, bool)
+    spc = jnp.zeros(n, jnp.int32)
+    rad = jnp.full(n, 100.0, jnp.float32)
+
+    def t(fn, *args, iters=3):
+        jax.block_until_ready(fn(*args))  # compile + warm
+        best = None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(*args))
+            dt = time.perf_counter() - t0
+            best = dt if best is None else min(best, dt)
+        return round(best * 1000.0, 2)
+
+    @jax.jit
+    def phase_table(pos, act, spc):
+        cx, cz, sm = nb._bins(p, pos, spc)
+        buc = (sm * p.grid_z + cz) * p.grid_x + cx
+        return nb._build_table(p, buc, act, nb.LANES)
+
+    out = {}
+    out["table_ms"] = t(phase_table, pos, act, spc)
+    table, slot, _, _, _ = jax.block_until_ready(phase_table(pos, act, spc))
+
+    @jax.jit
+    def phase_feats(table, pos, ppos, spc, rad, slot):
+        av = (slot >= 0).astype(jnp.float32)
+        return nb._scatter_feats(
+            p, table, (pos[:, 0], pos[:, 1], spc, rad, av),
+            (ppos[:, 0], ppos[:, 1], spc, rad, av),
+        )
+
+    out["feats_ms"] = t(phase_feats, table, pos, ppos, spc, rad, slot)
+    cells = jax.block_until_ready(phase_feats(table, pos, ppos, spc, rad, slot))
+
+    kernel = jax.jit(nb._compiled_event_kernel(p, False))
+    out["kernel_ms"] = t(kernel, cells)
+    packed_cells = jax.block_until_ready(kernel(cells))
+    w = 9 * nb.LANES // nb._PACK
+
+    @jax.jit
+    def phase_gather(packed_cells, slot):
+        flat = packed_cells.reshape(-1, w)
+        safe = jnp.maximum(slot, 0)
+        pe = jnp.where((slot >= 0)[:, None], flat[safe], 0)
+        return pe, jnp.sum(jax.lax.population_count(pe))
+
+    out["gather_ms"] = t(phase_gather, packed_cells, slot)
+    packed_e, cnt = jax.block_until_ready(phase_gather(packed_cells, slot))
+    out["events_in_mask"] = int(cnt)
+    cx, cz, sm = nb._bins(p, pos, spc)
+
+    @jax.jit
+    def phase_drain(packed_e, cx, cz, sm, table):
+        return nb._drain_bits(p, packed_e, cx, cz, sm, table, jnp.int32(0))
+
+    out["drain_ms"] = t(phase_drain, packed_e, cx, cz, sm, table)
+    step = nb._jitted_step_packed(p, "pallas")
+    out["full_step_ms"] = t(step, ppos, act, spc, rad, pos, act, spc, rad)
+    out["est_tick_ms"] = round(
+        2 * (out["table_ms"] + out["feats_ms"] + out["kernel_ms"]
+             + out["drain_ms"]) + out["gather_ms"], 2
+    )
+    return out
+
+
 # --- main --------------------------------------------------------------------
 
 
@@ -336,6 +426,36 @@ def main() -> int:
                     configs["boids_50k"] = {
                         "error": traceback.format_exc(limit=2).splitlines()[-1]
                     }
+                # Per-phase attribution + cell-size sweep (same world span,
+                # 13200 units) — VERDICT r2 #8.
+                try:
+                    result["phases"] = bench_phase_profile()
+                except Exception:
+                    result["phases"] = {
+                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                    }
+                sweep = {}
+                saved_steps = os.environ.get("BENCH_STEPS")
+                os.environ["BENCH_STEPS"] = os.environ.get(
+                    "BENCH_SWEEP_STEPS", "12"
+                )
+                for cell, grid in ((100.0, 132), (150.0, 88), (300.0, 44)):
+                    try:
+                        r = bench_aoi(label=f"cell{int(cell)}",
+                                      cell_override=cell, grid_override=grid)
+                        sweep[f"cell_{int(cell)}"] = {
+                            "updates_per_sec": r["value"],
+                            "diff_latency_p99_ms": r["diff_latency_p99_ms"],
+                        }
+                    except Exception:
+                        sweep[f"cell_{int(cell)}"] = {
+                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        }
+                if saved_steps is None:
+                    os.environ.pop("BENCH_STEPS", None)
+                else:
+                    os.environ["BENCH_STEPS"] = saved_steps
+                configs["cell_sweep"] = sweep
             else:
                 # Pallas interpret mode at 50k agents takes hours on CPU —
                 # an explicit hardware-gated skip, not silent truncation.
