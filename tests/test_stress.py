@@ -32,8 +32,8 @@ pytestmark = pytest.mark.slow
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-N_BOTS = int(os.environ.get("STRESS_BOTS", "24"))
-DURATION = float(os.environ.get("STRESS_DURATION", "20"))
+N_BOTS = int(os.environ.get("STRESS_BOTS", "50"))
+DURATION = float(os.environ.get("STRESS_DURATION", "60"))
 
 INI = """\
 [deployment]
