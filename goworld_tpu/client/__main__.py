@@ -36,6 +36,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-compress", action="store_true",
                     help="compressed client link")
     ap.add_argument("-seed", type=int, default=None)
+    ap.add_argument("-timeout", type=float, default=5.0,
+                    help="per-scenario completion budget in seconds "
+                         "(retries happen within it); large fleets on "
+                         "loaded hosts need more than the reference's 5")
     args = ap.parse_args(argv)
 
     gates: list[tuple[str, int]] = []
@@ -64,6 +68,7 @@ def main(argv: list[str] | None = None) -> int:
             args.N, gates, args.duration,
             strict=args.strict, ws=args.ws, rudp=args.rudp, tls=args.tls,
             compress=args.compress, seed=args.seed,
+            thing_timeout=args.timeout,
         )
     )
     print(format_report(report))

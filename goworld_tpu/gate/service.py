@@ -42,7 +42,7 @@ from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
 from goworld_tpu.proto.msgtypes import FilterOp, MsgType, is_gate_redirect
-from goworld_tpu.utils import gwlog
+from goworld_tpu.utils import gwlog, opmon
 
 _CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
 
@@ -254,7 +254,11 @@ class GateService:
             kind, cp, msgtype, packet = await self._queue.get()
             try:
                 if kind == "packet":
+                    # opmon wraps gate packet handling like the reference
+                    # (GateService.go:431-438); slow ops warn at 100 ms.
+                    op = opmon.Operation("gate.handleClientPacket")
                     self._handle_client_packet(cp, msgtype, packet)
+                    op.finish(warn_threshold=0.1)
                 elif kind == "connect":
                     self._on_new_client(cp)
                 elif kind == "disconnect":

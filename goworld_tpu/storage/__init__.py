@@ -16,7 +16,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Optional
 
-from goworld_tpu.utils import async_jobs, gwlog
+from goworld_tpu.utils import async_jobs, gwlog, opmon
 
 _GROUP = "storage"
 _SAVE_RETRY_INTERVAL = 1.0
@@ -70,7 +70,9 @@ def save(typename: str, eid: str, data: dict, callback: Optional[Callable] = Non
     def routine():
         while True:
             try:
+                op = opmon.Operation("storage.save")
                 _backend.write(typename, eid, data)
+                op.finish(warn_threshold=1.0)  # storage.go:194,234
                 return None
             except Exception as e:  # noqa: BLE001
                 gwlog.errorf("storage: save %s.%s failed (%s); retrying", typename, eid, e)
