@@ -16,9 +16,17 @@ class SQLiteKVDB:
     def __init__(self, directory: str, filename: str = "kvdb.sqlite") -> None:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, filename)
-        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            self.path, check_same_thread=False, timeout=10.0
+        )
         self._lock = threading.Lock()
         with self._lock:
+            # WAL lets the other game processes read while one writes;
+            # busy_timeout rides out cross-process write contention (every
+            # game in a deployment shares this file, like the reference's
+            # shared kvdb service).
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA busy_timeout=10000")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS kv (k TEXT PRIMARY KEY, v TEXT NOT NULL)"
             )

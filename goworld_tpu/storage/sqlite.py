@@ -20,9 +20,15 @@ class SQLiteEntityStorage:
     def __init__(self, directory: str, filename: str = "entities.sqlite") -> None:
         os.makedirs(directory, exist_ok=True)
         self.path = os.path.join(directory, filename)
-        self._conn = sqlite3.connect(self.path, check_same_thread=False)
+        self._conn = sqlite3.connect(
+            self.path, check_same_thread=False, timeout=10.0
+        )
         self._lock = threading.Lock()
         with self._lock:
+            # WAL + busy_timeout: every game process in a deployment shares
+            # this file (see kvdb/sqlite.py).
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA busy_timeout=10000")
             self._conn.execute(
                 "CREATE TABLE IF NOT EXISTS entities ("
                 " typename TEXT NOT NULL, eid TEXT NOT NULL, data TEXT NOT NULL,"
