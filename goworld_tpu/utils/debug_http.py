@@ -57,7 +57,10 @@ class DebugHTTPServer:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            status, ctype, body = self._route(path.split("?")[0])
+            if path.split("?")[0] == "/profile":
+                status, ctype, body = await self._profile(path)
+            else:
+                status, ctype, body = self._route(path.split("?")[0])
             head = (
                 f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
@@ -71,6 +74,31 @@ class DebugHTTPServer:
                 writer.close()
             except Exception:
                 pass
+
+    async def _profile(self, path: str) -> tuple[str, str, bytes]:
+        """CPU-profile the process for ?seconds=N (pprof's /profile slot):
+        cProfile runs on the main thread, so everything the game/gate/
+        dispatcher loop does in the window is captured."""
+        import cProfile
+        import io
+        import pstats
+
+        seconds = 5.0
+        if "?" in path:
+            for kv in path.split("?", 1)[1].split("&"):
+                k, _, v = kv.partition("=")
+                if k == "seconds":
+                    try:
+                        seconds = min(60.0, max(0.1, float(v)))
+                    except ValueError:
+                        pass
+        pr = cProfile.Profile()
+        pr.enable()
+        await asyncio.sleep(seconds)
+        pr.disable()
+        buf = io.StringIO()
+        pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(80)
+        return "200 OK", "text/plain", buf.getvalue().encode()
 
     def _route(self, path: str) -> tuple[str, str, bytes]:
         if path == "/healthz":

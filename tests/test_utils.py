@@ -174,6 +174,8 @@ def test_debug_http_server_endpoints():
         assert status == 200 and isinstance(json.loads(body), dict)
         status, body = await asyncio.to_thread(fetch, "/stack")
         assert status == 200 and b"thread" in body
+        status, body = await asyncio.to_thread(fetch, "/profile?seconds=0.2")
+        assert status == 200 and b"cumulative" in body
         try:
             await asyncio.to_thread(fetch, "/nope")
         except urllib.error.HTTPError as e:
