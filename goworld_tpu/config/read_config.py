@@ -154,7 +154,8 @@ def reload() -> GoWorldConfig:
 
 
 def _load(path: Optional[str]) -> GoWorldConfig:
-    cp = configparser.ConfigParser()
+    # Inline `;` comments, like the reference's go-ini (read_config.go:20).
+    cp = configparser.ConfigParser(inline_comment_prefixes=(";",))
     if path is not None:
         read = cp.read(path)
         if not read:
