@@ -1,0 +1,74 @@
+"""Fast on-chip validation: run the moment the axon tunnel recovers.
+
+One process, ~2-4 min: (1) oracle-equality smoke at 512 slots, (2) a small
+pipelined bench at 25.6k entities, (3) per-phase timings at the same size.
+Prints progress lines; safe to ctrl-C between stages (but NOT mid-stage —
+a killed chip process can wedge the tunnel, see BENCH_NOTES.md).
+
+    python -u tools/quick_chip_check.py
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+def main() -> int:
+    import jax
+
+    t0 = time.time()
+    devs = jax.devices()
+    print(f"devices: {devs} ({time.time() - t0:.1f}s)", flush=True)
+    if jax.default_backend() != "tpu":
+        print("NOT a TPU backend; aborting")
+        return 1
+
+    from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
+
+    # 1) oracle equality on hardware
+    p = NeighborParams(capacity=512, cell_size=100.0, grid_x=8, grid_z=8,
+                       space_slots=2, cell_capacity=32, max_events=4096)
+    tpu = NeighborEngine(p, backend="pallas")
+    cpu = NeighborEngine(p, backend="jnp")
+    tpu.reset(); cpu.reset()
+    rng = np.random.default_rng(7)
+    pos = rng.uniform(0, 800, (512, 2)).astype(np.float32)
+    act = np.ones(512, bool)
+    spc = (np.arange(512) % 2).astype(np.int32)
+    rad = np.full(512, 100.0, np.float32)
+    for tick in range(3):
+        e1, l1, d1 = tpu.step(pos, act, spc, rad)
+        e2, l2, d2 = cpu.step(pos, act, spc, rad)
+        c = lambda x: sorted(map(tuple, np.asarray(x).tolist()))  # noqa: E731
+        assert c(e1) == c(e2) and c(l1) == c(l2) and d1 == d2, f"tick {tick} diverged"
+        pos = np.clip(pos + rng.normal(0, 15, pos.shape), 0, 800).astype(np.float32)
+    print(f"smoke: on-chip == oracle over 3 ticks ({time.time() - t0:.1f}s)",
+          flush=True)
+
+    # 2) small pipelined bench
+    import os
+
+    os.environ["BENCH_N"] = "25600"
+    os.environ["BENCH_STEPS"] = "20"
+    os.environ["BENCH_PLATFORM"] = "tpu"
+    from bench import bench_aoi, bench_phase_profile
+
+    r = bench_aoi(label="quick")
+    print(f"bench 25.6k: {r['value']:.0f} upd/s, diff p99 "
+          f"{r['diff_latency_p99_ms']:.2f} ms ({time.time() - t0:.1f}s)",
+          flush=True)
+
+    # 3) phase attribution at the same scale
+    ph = bench_phase_profile(n=25600, cell=300.0, grid=24)
+    print("phases:", ph, flush=True)
+    print(f"total {time.time() - t0:.1f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
