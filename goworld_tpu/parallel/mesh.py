@@ -185,20 +185,25 @@ def _sharded_step_pallas(
         gather(ppos_l), gather(pact_l), gather(pspc_l), gather(prad_l),
     )
 
-    def one_pass(apos, aact, aspc, arad, bpos, bact, bspc, brad):
+    # Build both epochs' grids ONCE; each pass then shares them (the enter
+    # pass's candidate grid is the leave pass's B-visibility grid and vice
+    # versa — building per pass would do 4 argsorts where 2 suffice).
+    def one_grid(xpos, xact, xspc):
+        cx, cz, sm = _bins(p, xpos, xspc)
+        buc = (sm * p.grid_z + cz) * p.grid_x + cx
+        table, slot, dropped, _, _ = _build_table(p, buc, xact, LANES)
+        return cx, cz, sm, table, slot, dropped
+
+    cxc, czc, smc, table_c, slot_c, dropped_c = one_grid(pos, act, spc)
+    cxp, czp, smp, table_p, slot_p, _ = one_grid(ppos, pact, pspc)
+    av_c = (slot_c >= 0).astype(jnp.float32)
+    av_p = (slot_p >= 0).astype(jnp.float32)
+    cur_feats = (pos[:, 0], pos[:, 1], spc, rad, av_c)
+    prev_feats = (ppos[:, 0], ppos[:, 1], pspc, prad, av_p)
+
+    def one_pass(feats_a, feats_b, cx, cz, sm, table, slot):
         """Events for pairs valid under epoch A but not epoch B, binned by
         epoch A's grid (ops/neighbor._step_pallas, slab-sharded)."""
-        cx, cz, sm = _bins(p, apos, aspc)
-        buc = (sm * p.grid_z + cz) * p.grid_x + cx
-        table, slot, dropped, order, dst = _build_table(p, buc, aact, LANES)
-        av_a = (slot >= 0).astype(jnp.float32)
-        # Epoch-B visibility must fold B's own grid drops, like _step_pallas.
-        cxb, czb, smb = _bins(p, bpos, bspc)
-        bucb = (smb * p.grid_z + czb) * p.grid_x + cxb
-        _, slot_b, _, _, _ = _build_table(p, bucb, bact, LANES)
-        av_b = (slot_b >= 0).astype(jnp.float32)
-        feats_a = (apos[:, 0], apos[:, 1], aspc, arad, av_a)
-        feats_b = (bpos[:, 0], bpos[:, 1], bspc, brad, av_b)
         cells = _scatter_feats(p, table, feats_a, feats_b)
         slab = jax.lax.dynamic_slice_in_dim(cells, lo, rows + 2, axis=1)
         packed_cells = kernel(slab)  # [S, rows, gx, LANES, W]
@@ -212,13 +217,13 @@ def _sharded_step_pallas(
         safe = jnp.clip(local_flat, 0, flat.shape[0] - 1)
         packed_e = jnp.where(mine[:, None], flat[safe], 0)  # i32[N, W]
         count = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
-        return packed_e, cx, cz, sm, table, count, dropped
+        return packed_e, count
 
-    packed_e, cxc, czc, smc, table_c, n_enters, dropped_c = one_pass(
-        pos, act, spc, rad, ppos, pact, pspc, prad
+    packed_e, n_enters = one_pass(
+        cur_feats, prev_feats, cxc, czc, smc, table_c, slot_c
     )
-    packed_l, cxp, czp, smp, table_p, n_leaves, _ = one_pass(
-        ppos, pact, pspc, prad, pos, act, spc, rad
+    packed_l, n_leaves = one_pass(
+        prev_feats, cur_feats, cxp, czp, smp, table_p, slot_p
     )
 
     ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0),
