@@ -378,7 +378,15 @@ def bench_phase_profile(n: int = 102400, cell: float = 300.0,
 
     out["drain_ms"] = t(phase_drain, packed_e, cx, cz, sm, table)
     step = nb._jitted_step_packed(p, "pallas")
-    out["full_step_ms"] = t(step, ppos, act, spc, rad, pos, act, spc, rad)
+    cxp, czp, smp = nb._bins(p, ppos, spc)
+    bucp = (smp * p.grid_z + czp) * p.grid_x + cxp
+    table_p, slot_p, _, _, _ = jax.jit(
+        lambda b, a: nb._build_table(p, b, a, nb.LANES)
+    )(bucp, act)
+    out["full_step_ms"] = t(
+        step, ppos, act, spc, rad, cxp, czp, smp, table_p, slot_p,
+        pos, act, spc, rad,
+    )
     out["est_tick_ms"] = round(
         2 * (out["table_ms"] + out["feats_ms"] + out["kernel_ms"]
              + out["drain_ms"]) + out["gather_ms"], 2

@@ -489,19 +489,22 @@ def _drain_bits(
 
 def _step_pallas(
     p: NeighborParams, interpret: bool,
-    ppos, pact, pspc, prad, pos, act, spc, rad,
+    ppos, pact, pspc, prad,  # previous-tick inputs
+    pcx, pcz, psm, ptable, pslot,  # previous tick's CARRIED grid artifacts
+    pos, act, spc, rad,  # current-tick inputs
 ):
     """Two Pallas passes (enter on the current grid, leave on the previous
-    grid) + XLA postlude. Returns device arrays for the packed readback and
-    the paging context."""
+    grid) + XLA postlude. The previous grid's bins/table/slot are carried
+    in engine state (they were this tick's current grid last tick), so only
+    ONE argsort+table build runs per tick. Returns the paging contexts, the
+    packed readback, and the current grid artifacts for the next carry."""
     kernel = _compiled_event_kernel(p, interpret)
 
     cxc, czc, smc = _bins(p, pos, spc)
-    cxp, czp, smp = _bins(p, ppos, pspc)
+    cxp, czp, smp = pcx, pcz, psm
     buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
-    buc_p = (smp * p.grid_z + czp) * p.grid_x + cxp
-    table_c, slot_c, dropped_c, order_c, dst_c = _build_table(p, buc_c, act, LANES)
-    table_p, slot_p, _, order_p, dst_p = _build_table(p, buc_p, pact, LANES)
+    table_c, slot_c, dropped_c, _, _ = _build_table(p, buc_c, act, LANES)
+    table_p, slot_p = ptable, pslot
     av_c = (slot_c >= 0).astype(jnp.float32)
     av_p = (slot_p >= 0).astype(jnp.float32)
 
@@ -540,7 +543,8 @@ def _step_pallas(
     # Paging context: everything _drain_bits needs for overflow chunks.
     enter_ctx = (packed_e, cxc, czc, smc, table_c)
     leave_ctx = (packed_l, cxp, czp, smp, table_p)
-    return enter_ctx, leave_ctx, out
+    next_grid = (cxc, czc, smc, table_c, slot_c)
+    return enter_ctx, leave_ctx, out, next_grid
 
 
 # --- jit wrappers ------------------------------------------------------------
@@ -554,6 +558,9 @@ def _jitted_step_packed(params: NeighborParams, backend: str):
         fn = functools.partial(
             _step_pallas, params, backend == "pallas_interpret"
         )
+    # Only the previous-tick INPUT arrays are donated. The pallas path's
+    # carried grid artifacts (args 4-8) must NOT be: the still-pending
+    # previous step's paging context references those exact buffers.
     return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
 
 
@@ -706,6 +713,18 @@ class NeighborEngine:
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), jnp.float32),
         )
+        if self.backend != "jnp":
+            # Carried grid artifacts of the (all-inactive) previous tick:
+            # sentinel table, -1 slots — exactly what _build_table returns
+            # for active=False everywhere; bins are irrelevant then.
+            table_size = self.params.num_buckets * LANES
+            self._state = self._state + (
+                jnp.zeros((n,), jnp.int32),  # pcx
+                jnp.zeros((n,), jnp.int32),  # pcz
+                jnp.zeros((n,), jnp.int32),  # psm
+                jnp.full((table_size,), n, jnp.int32),  # ptable
+                jnp.full((n,), -1, jnp.int32),  # pslot
+            )
 
     def _page(self, ctx, remaining: int, start_flat: int) -> np.ndarray:
         chunks = []
@@ -749,20 +768,23 @@ class NeighborEngine:
         )
         if self.backend == "jnp":
             enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
-            n = self.params.capacity
+            next_state = cur
 
             def pager(which, remaining, start):
                 ids = enter_ids if which == "enter" else leave_ids
                 return self._page((ids,), remaining, start)
 
         else:
-            enter_ctx, leave_ctx, out = self._jit_step(*self._state, *cur)
+            enter_ctx, leave_ctx, out, next_grid = self._jit_step(
+                *self._state, *cur
+            )
+            next_state = cur + next_grid
 
             def pager(which, remaining, start):
                 ctx = enter_ctx if which == "enter" else leave_ctx
                 return self._page(ctx, remaining, start)
 
-        self._state = cur
+        self._state = next_state
         return PendingStep(self, pager, out)
 
     def step(

@@ -115,7 +115,13 @@ def main():
 
     # --- full step for reference ---
     step = nb._jitted_step_packed(p, "pallas")
-    t_full = timeit("full", step, ppos, act, spc, rad, pos, act, spc, rad,
+    cxp, czp, smp = nb._bins(p, ppos, spc)
+    bucp = (smp * p.grid_z + czp) * p.grid_x + cxp
+    table_p, slot_p, _, _, _ = jax.jit(
+        lambda b, a: nb._build_table(p, b, a, nb.LANES)
+    )(bucp, act)
+    t_full = timeit("full", step, ppos, act, spc, rad,
+                    cxp, czp, smp, table_p, slot_p, pos, act, spc, rad,
                     iters=3, warmup=1)
 
     total2 = 2 * (t_table + t_scatter + t_kernel) + t_gather + 2 * t_drain
