@@ -362,7 +362,62 @@ def test_batched_aoi_sharded_engine_wired():
     assert a.leave_events == [b]
 
 
-# --- migration data round-trip (migarte_test.go:18-49) ----------------------
+def test_aoi_backends_agree_on_random_trace():
+    """Drive an identical random world (moves, enters, leaves, two spaces)
+    through the CPU xzlist manager and the batched engine; at every settled
+    checkpoint the interest sets must be IDENTICAL. This is the manager-
+    level oracle the engine-level tests can't give (slot recycling,
+    pipelined delivery, space isolation and destroy interplay)."""
+    import random
+
+    def play(backend: str) -> list[dict]:
+        em.cleanup_for_tests()
+        em.register_space(MySpace)
+        em.register_entity(Avatar)
+        em.runtime.aoi_backend = backend
+        if backend == "batched":
+            from goworld_tpu.ops.neighbor import NeighborParams
+
+            em.runtime.aoi_params = NeighborParams(
+                capacity=128, cell_size=100.0, grid_x=8, grid_z=8,
+                space_slots=4, cell_capacity=32, max_events=8192,
+            )
+        rng = random.Random(4242)
+        spaces = [_setup_space(), em.create_space_locally(kind=2)]
+        spaces[1].enable_aoi(100.0)
+        ents: list = []
+        seq: dict[str, int] = {}  # entity id → creation index (run-stable)
+        checkpoints: list[dict] = []
+        for step in range(60):
+            roll = rng.random()
+            if roll < 0.35 and len(ents) < 40:
+                e = em.create_entity_locally("Avatar")
+                seq[e.id] = len(seq)
+                sp = spaces[rng.randrange(2)]
+                sp._enter(e, Vector3(rng.uniform(0, 700), 0, rng.uniform(0, 700)))
+                ents.append(e)
+            elif roll < 0.5 and ents:
+                e = ents.pop(rng.randrange(len(ents)))
+                e.destroy()
+            elif ents:
+                e = ents[rng.randrange(len(ents))]
+                e.set_position(Vector3(rng.uniform(0, 700), 0, rng.uniform(0, 700)))
+            # Settle: two ticks flush the pipelined dispatch+deliver.
+            em.runtime.tick()
+            em.runtime.tick()
+            if step % 10 == 9:
+                checkpoints.append({
+                    seq[e.id]: sorted(seq[o.id] for o in e.interested_in)
+                    for e in ents
+                })
+        em.cleanup_for_tests()
+        return checkpoints
+
+    a = play("xzlist")
+    b = play("batched")
+    assert len(a) == len(b) == 6
+    assert any(any(v for v in cp.values()) for cp in a), "trace had no AOI at all"
+    assert a == b
 
 
 def test_migrate_data_roundtrip():
