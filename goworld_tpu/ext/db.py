@@ -194,16 +194,76 @@ class DocDB:
         self._submit(routine, callback)
 
 
-def dial_mongo(url: str, dbname: str, callback: AsyncCallback = None):
-    """Gated: requires pymongo (not shipped in this image)."""
-    try:
-        import pymongo  # noqa: F401
-    except ImportError as exc:
-        raise RuntimeError(
-            "gwmongo requires pymongo, which is not installed in this "
-            "environment; use goworld_tpu.ext.db.DocDB (sqlite) instead"
-        ) from exc
-    raise NotImplementedError("mongo backend pending a pymongo-equipped image")
+class GwMongo:
+    """Async mongo helper over the in-repo OP_MSG client (gwmongo.go:31-346
+    call shape): every call runs on a serial worker and posts
+    ``callback(result, err)`` back to the game loop."""
+
+    def __init__(self, dbname: str) -> None:
+        self._client = None
+        self._db = dbname
+        self._group = f"{_ASYNC_JOB_GROUP}:mongo:{id(self)}"
+
+    def _submit(self, routine: Callable, callback: AsyncCallback) -> None:
+        async_jobs.append_job(self._group, routine, callback)
+
+    def dial(self, url: str, callback: AsyncCallback = None) -> None:
+        from goworld_tpu.netutil.mongo import MongoClient, parse_mongo_url
+
+        def routine():
+            self._client = MongoClient(**parse_mongo_url(url))
+            self._client.ping()
+            return self
+
+        self._submit(routine, callback)
+
+    def insert(self, coll: str, doc: dict, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.insert(self._db, coll, [doc]), callback)
+
+    def upsert_id(self, coll: str, _id: str, doc: dict,
+                  callback: AsyncCallback = None) -> None:
+        doc = dict(doc, _id=_id)
+        self._submit(
+            lambda: self._client.upsert(self._db, coll, {"_id": _id}, doc),
+            callback,
+        )
+
+    def find_id(self, coll: str, _id: str, callback: AsyncCallback = None) -> None:
+        self._submit(
+            lambda: self._client.find_one(self._db, coll, {"_id": _id}), callback
+        )
+
+    def find_one(self, coll: str, query: dict, callback: AsyncCallback = None) -> None:
+        self._submit(
+            lambda: self._client.find_one(self._db, coll, query), callback
+        )
+
+    def find_all(self, coll: str, query: dict, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.find(self._db, coll, query), callback)
+
+    def remove_id(self, coll: str, _id: str, callback: AsyncCallback = None) -> None:
+        self._submit(
+            lambda: self._client.delete(self._db, coll, {"_id": _id}), callback
+        )
+
+    def command(self, command: dict, callback: AsyncCallback = None) -> None:
+        self._submit(lambda: self._client.command(self._db, command), callback)
+
+    def close(self, callback: AsyncCallback = None) -> None:
+        def routine():
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+        self._submit(routine, callback)
+
+
+def dial_mongo(url: str, dbname: str, callback: AsyncCallback = None) -> GwMongo:
+    """Connect a :class:`GwMongo` (async; callback fires on the game loop
+    with (client, err) — gwmongo.go dial shape)."""
+    m = GwMongo(dbname)
+    m.dial(url, callback)
+    return m
 
 
 class GwRedis:

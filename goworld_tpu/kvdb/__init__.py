@@ -37,8 +37,16 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.kvdb.redis import RedisKVDB
 
         return RedisKVDB(cfg.url)
+    if kind == "mongodb":
+        from goworld_tpu.kvdb.mongodb import MongoKVDB
+
+        return MongoKVDB(
+            cfg.url, db=getattr(cfg, "db", "goworld"),
+            collection=getattr(cfg, "collection", "kvdb"),
+        )
     raise ValueError(
-        f"unknown kvdb type {kind!r} (available: filesystem, sqlite, redis)"
+        f"unknown kvdb type {kind!r} "
+        f"(available: filesystem, sqlite, redis, mongodb)"
     )
 
 

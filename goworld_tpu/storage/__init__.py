@@ -43,8 +43,13 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.storage.redis import RedisEntityStorage
 
         return RedisEntityStorage(cfg.url)
+    if kind == "mongodb":
+        from goworld_tpu.storage.mongodb import MongoEntityStorage
+
+        return MongoEntityStorage(cfg.url, db=getattr(cfg, "db", "goworld"))
     raise ValueError(
-        f"unknown storage type {kind!r} (available: filesystem, sqlite, redis)"
+        f"unknown storage type {kind!r} "
+        f"(available: filesystem, sqlite, redis, mongodb)"
     )
 
 

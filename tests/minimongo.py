@@ -1,0 +1,167 @@
+"""Minimal in-process OP_MSG server for hermetic mongodb-backend tests.
+
+Counterpart to tests/miniredis.py: the reference CI provisions a real
+mongod; this dict-backed server speaks enough of the modern wire protocol
+(OP_MSG kind-0 sections) for the client's command set: ping/hello, insert
+(unique _id), update (upsert, whole-doc replace), delete, find with _id
+equality or {$gte,$lt} ranges, projection {_id: 1}, sort {_id: 1}.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import sys
+import threading
+import os
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from goworld_tpu.netutil import bson  # noqa: E402
+
+_HEADER = struct.Struct("<iiii")
+_OP_MSG = 2013
+
+
+class MiniMongo:
+    def __init__(self) -> None:
+        # dbs[db][coll] = {_id: doc}
+        self._dbs: dict[str, dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stopping = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # --- wire ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        def read_exact(n):
+            bufs = []
+            while n:
+                b = conn.recv(n)
+                if not b:
+                    raise ConnectionError
+                bufs.append(b)
+                n -= len(b)
+            return b"".join(bufs)
+
+        try:
+            while True:
+                length, req_id, _, opcode = _HEADER.unpack(read_exact(16))
+                payload = read_exact(length - 16)
+                assert opcode == _OP_MSG and payload[4] == 0
+                cmd = bson.decode(payload[5:])
+                reply = self._dispatch(cmd)
+                sections = b"\x00" + bson.encode(reply)
+                conn.sendall(
+                    _HEADER.pack(16 + 4 + len(sections), 0, req_id, _OP_MSG)
+                    + struct.pack("<i", 0) + sections
+                )
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # --- commands -----------------------------------------------------------
+
+    def _coll(self, db: str, name: str) -> dict:
+        return self._dbs.setdefault(db, {}).setdefault(name, {})
+
+    @staticmethod
+    def _matches(doc: dict, query: dict) -> bool:
+        for key, cond in query.items():
+            val = doc.get(key)
+            if isinstance(cond, dict):
+                for op, ref in cond.items():
+                    if op == "$gte":
+                        if not (val is not None and val >= ref):
+                            return False
+                    elif op == "$lt":
+                        if not (val is not None and val < ref):
+                            return False
+                    else:
+                        return False
+            elif val != cond:
+                return False
+        return True
+
+    def _dispatch(self, cmd: dict) -> dict:
+        db = cmd.get("$db", "test")
+        with self._lock:
+            if "ping" in cmd or "hello" in cmd or "ismaster" in cmd:
+                return {"ok": 1}
+            if "insert" in cmd:
+                coll = self._coll(db, cmd["insert"])
+                for doc in cmd.get("documents", []):
+                    _id = doc.get("_id")
+                    if _id in coll:
+                        return {"ok": 1, "n": 0, "writeErrors": [
+                            {"index": 0, "code": 11000,
+                             "errmsg": f"E11000 duplicate key: {_id!r}"}
+                        ]}
+                    coll[_id] = doc
+                return {"ok": 1, "n": len(cmd.get("documents", []))}
+            if "update" in cmd:
+                coll = self._coll(db, cmd["update"])
+                n = 0
+                for upd in cmd.get("updates", []):
+                    q, u = upd.get("q", {}), upd.get("u", {})
+                    hit = [d for d in coll.values() if self._matches(d, q)]
+                    if hit:
+                        coll[hit[0]["_id"]] = u
+                        n += 1
+                    elif upd.get("upsert"):
+                        coll[u.get("_id", q.get("_id"))] = u
+                        n += 1
+                return {"ok": 1, "n": n}
+            if "delete" in cmd:
+                coll = self._coll(db, cmd["delete"])
+                n = 0
+                for dl in cmd.get("deletes", []):
+                    q = dl.get("q", {})
+                    victims = [k for k, d in coll.items() if self._matches(d, q)]
+                    limit = dl.get("limit", 0)
+                    if limit:
+                        victims = victims[:limit]
+                    for k in victims:
+                        del coll[k]
+                        n += 1
+                return {"ok": 1, "n": n}
+            if "find" in cmd:
+                coll = self._coll(db, cmd["find"])
+                docs = [d for d in coll.values()
+                        if self._matches(d, cmd.get("filter", {}))]
+                if cmd.get("sort"):
+                    key = next(iter(cmd["sort"]))
+                    docs.sort(key=lambda d: d.get(key))
+                if cmd.get("projection"):
+                    keep = {k for k, v in cmd["projection"].items() if v}
+                    docs = [{k: d[k] for k in keep if k in d} for d in docs]
+                if cmd.get("limit"):
+                    docs = docs[:cmd["limit"]]
+                return {"ok": 1, "cursor": {"id": 0, "ns": "", "firstBatch": docs}}
+            if "getMore" in cmd:
+                return {"ok": 1, "cursor": {"id": 0, "ns": "", "nextBatch": []}}
+            return {"ok": 0, "errmsg": f"unknown command {sorted(cmd)[:3]}", "code": 59}

@@ -13,39 +13,65 @@ from goworld_tpu.config.read_config import KVDBConfig, StorageConfig
 from goworld_tpu.utils import post
 
 
-@pytest.fixture
-def redis_url():
-    """A real server if GOWORLD_REDIS_URL is set (the reference's CI-service
-    mode), else the in-repo MiniRedis speaking RESP2 on a loopback port."""
-    url = os.environ.get("GOWORLD_REDIS_URL")
-    if url:
-        yield url
-        return
-    from miniredis import MiniRedis
-
-    srv = MiniRedis()
-    yield f"redis://127.0.0.1:{srv.port}/0"
-    srv.stop()
+import contextlib
 
 
-@pytest.fixture(params=["filesystem", "sqlite", "redis"])
-def entity_backend(request, tmp_path, redis_url):
-    cfg = StorageConfig(
-        type=request.param, directory=str(tmp_path / "es"), url=redis_url
-    )
-    backend = storage.make_backend(request.param, cfg)
-    yield backend
-    backend.close()
+@contextlib.contextmanager
+def _net_server(kind: str):
+    """Network-backend URL: a real server when GOWORLD_REDIS_URL /
+    GOWORLD_MONGO_URL is set (the reference's CI-service mode), else the
+    in-repo protocol test server on a loopback port."""
+    if kind == "redis":
+        url = os.environ.get("GOWORLD_REDIS_URL")
+        if url:
+            yield url
+            return
+        from miniredis import MiniRedis
+
+        srv = MiniRedis()
+        try:
+            yield f"redis://127.0.0.1:{srv.port}/0"
+        finally:
+            srv.stop()
+    elif kind == "mongodb":
+        url = os.environ.get("GOWORLD_MONGO_URL")
+        if url:
+            yield url
+            return
+        from minimongo import MiniMongo
+
+        srv = MiniMongo()
+        try:
+            yield f"mongodb://127.0.0.1:{srv.port}"
+        finally:
+            srv.stop()
+    else:
+        yield ""
 
 
-@pytest.fixture(params=["filesystem", "sqlite", "redis"])
-def kv_backend(request, tmp_path, redis_url):
-    cfg = KVDBConfig(
-        type=request.param, directory=str(tmp_path / "kv"), url=redis_url
-    )
-    backend = kvdb.make_backend(request.param, cfg)
-    yield backend
-    backend.close()
+_BACKENDS = ["filesystem", "sqlite", "redis", "mongodb"]
+
+
+@pytest.fixture(params=_BACKENDS)
+def entity_backend(request, tmp_path):
+    with _net_server(request.param) as url:
+        cfg = StorageConfig(
+            type=request.param, directory=str(tmp_path / "es"), url=url
+        )
+        backend = storage.make_backend(request.param, cfg)
+        yield backend
+        backend.close()
+
+
+@pytest.fixture(params=_BACKENDS)
+def kv_backend(request, tmp_path):
+    with _net_server(request.param) as url:
+        cfg = KVDBConfig(
+            type=request.param, directory=str(tmp_path / "kv"), url=url
+        )
+        backend = kvdb.make_backend(request.param, cfg)
+        yield backend
+        backend.close()
 
 
 def test_entity_storage_contract(entity_backend):

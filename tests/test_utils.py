@@ -267,16 +267,70 @@ def test_ext_db_gwredis_roundtrip():
         srv.stop()
 
 
+def test_bson_roundtrip():
+    from goworld_tpu.netutil import bson
+
+    doc = {
+        "name": "hero", "level": 7, "big": 2**40, "hp": 7.5,
+        "dead": False, "alive": True, "nothing": None,
+        "bag": {"gold": 3, "items": ["sword", 2, {"deep": True}]},
+        "empty": {}, "list": [],
+    }
+    assert bson.decode(bson.encode(doc)) == doc
+    import pytest as _pytest
+
+    with _pytest.raises(TypeError):
+        bson.encode({"bad": object()})
+
+
+def test_ext_db_gwmongo_roundtrip():
+    """ext/db async mongo helper over the in-repo OP_MSG client
+    (gwmongo.go:31-346 call shape) against the MiniMongo test server."""
+    import time as _time
+
+    from minimongo import MiniMongo
+
+    from goworld_tpu.ext.db import dial_mongo
+    from goworld_tpu.utils import async_jobs, post
+
+    srv = MiniMongo()
+    try:
+        results = []
+
+        def cb(label):
+            return lambda res, err: results.append((label, res, err))
+
+        m = dial_mongo(f"mongodb://127.0.0.1:{srv.port}", "game", cb("dial"))
+        m.insert("avatars", {"_id": "a1", "name": "hero", "level": 3}, cb("ins"))
+        m.upsert_id("avatars", "a2", {"name": "mage"}, cb("ups"))
+        m.find_id("avatars", "a1", cb("find_id"))
+        m.find_one("avatars", {"name": "mage"}, cb("find_one"))
+        m.find_all("avatars", {}, cb("find_all"))
+        m.remove_id("avatars", "a2", cb("rm"))
+        m.find_all("avatars", {}, cb("find_all2"))
+        m.close(cb("close"))
+
+        assert async_jobs.wait_clear(10.0)
+        for _ in range(100):
+            post.tick()
+            if len(results) == 8:
+                break
+            _time.sleep(0.01)
+        by = {label: (res, err) for label, res, err in results}
+        assert by["find_id"][0]["name"] == "hero"
+        assert by["find_one"][0]["_id"] == "a2"
+        assert len(by["find_all"][0]) == 2
+        assert len(by["find_all2"][0]) == 1
+        assert all(err is None for _, err in by.values()), by
+    finally:
+        srv.stop()
+
+
 def test_ext_db_errors_and_gates(tmp_path):
     import time as _time
 
-    from goworld_tpu.ext.db import DocDB, dial_mongo, dial_redis
+    from goworld_tpu.ext.db import DocDB
     from goworld_tpu.utils import async_jobs, post
-
-    import pytest as _pytest
-
-    with _pytest.raises(RuntimeError, match="pymongo"):
-        dial_mongo("mongodb://x", "db")
 
     db = DocDB()
     db.dial(str(tmp_path / "doc.db"))
