@@ -96,7 +96,6 @@ class AOIConfig:
     """TPU compute-plane knobs (no reference analog; see SURVEY.md §7)."""
 
     backend: str = "auto"  # auto | xzlist | tpu
-    max_neighbors: int = 128
     cell_capacity: int = 64
     max_entities: int = 16384  # padded capacity of the batched engine
     mesh_shards: int = 1  # entity-shard axis over devices
@@ -240,7 +239,6 @@ def _load(path: Optional[str]) -> GoWorldConfig:
         s = cp["aoi"]
         cfg.aoi = AOIConfig(
             backend=s.get("backend", "auto"),
-            max_neighbors=int(s.get("max_neighbors", 128)),
             cell_capacity=int(s.get("cell_capacity", 64)),
             max_entities=int(s.get("max_entities", 16384)),
             mesh_shards=int(s.get("mesh_shards", 1)),
