@@ -44,9 +44,13 @@ def make_backend(kind: str, cfg):
             cfg.url, db=getattr(cfg, "db", "goworld"),
             collection=getattr(cfg, "collection", "kvdb"),
         )
+    if kind == "mysql":
+        from goworld_tpu.kvdb.mysql import MySQLKVDB
+
+        return MySQLKVDB(cfg.url)
     raise ValueError(
         f"unknown kvdb type {kind!r} "
-        f"(available: filesystem, sqlite, redis, mongodb)"
+        f"(available: filesystem, sqlite, redis, mongodb, mysql)"
     )
 
 

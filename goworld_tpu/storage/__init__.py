@@ -7,8 +7,9 @@ forever (:165-286); completion callbacks are posted back to the main loop.
 Backend SPI mirrors ``storage_common.go:6-13``: write/read/exists/list.
 
 Backends: filesystem (one JSON file per entity, the reference's de-facto
-"fake DB" for local runs, filesystem.go:22-121) and sqlite (stdlib; the
-TPU-native stand-in for the reference's mysql backend).
+"fake DB" for local runs, filesystem.go:22-121), sqlite (stdlib), and the
+reference's three network backends — redis, mongodb, mysql — over in-repo
+wire-protocol clients (netutil/{resp,mongo,mysql}.py; no drivers).
 """
 
 from __future__ import annotations
@@ -47,9 +48,13 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.storage.mongodb import MongoEntityStorage
 
         return MongoEntityStorage(cfg.url, db=getattr(cfg, "db", "goworld"))
+    if kind == "mysql":
+        from goworld_tpu.storage.mysql import MySQLEntityStorage
+
+        return MySQLEntityStorage(cfg.url)
     raise ValueError(
         f"unknown storage type {kind!r} "
-        f"(available: filesystem, sqlite, redis, mongodb)"
+        f"(available: filesystem, sqlite, redis, mongodb, mysql)"
     )
 
 

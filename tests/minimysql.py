@@ -1,0 +1,262 @@
+"""Minimal in-process MySQL server for hermetic mysql-backend tests.
+
+Counterpart to miniredis/minimongo: speaks the classic wire protocol
+(HandshakeV10, mysql_native_password auth accepted for any credentials,
+COM_QUERY/COM_PING/COM_QUIT) and pattern-matches exactly the statement
+shapes the backends issue: CREATE TABLE IF NOT EXISTS, REPLACE INTO,
+INSERT IGNORE INTO, DELETE, and SELECT with col lists, equality / range
+WHERE clauses and ORDER BY. Dict-backed; ~one table per regex family.
+"""
+
+from __future__ import annotations
+
+import re
+import socket
+import struct
+import threading
+
+
+def _lenenc(n: int) -> bytes:
+    if n < 251:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + n.to_bytes(3, "little")
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+class MiniMySQL:
+    def __init__(self) -> None:
+        # tables[name] = {primary_key_tuple: row_dict}
+        self._tables: dict[str, dict] = {}
+        self._schemas: dict[str, list[str]] = {}  # table → column names
+        self._keys: dict[str, list[str]] = {}  # table → primary key columns
+        self._lock = threading.Lock()
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stopping = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # --- wire ---------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        seq = [0]
+
+        def send(payload: bytes) -> None:
+            conn.sendall(len(payload).to_bytes(3, "little")
+                         + bytes([seq[0] & 0xFF]) + payload)
+            seq[0] += 1
+
+        def read_exact(n):
+            bufs = []
+            while n:
+                b = conn.recv(n)
+                if not b:
+                    raise ConnectionError
+                bufs.append(b)
+                n -= len(b)
+            return b"".join(bufs)
+
+        def read_packet():
+            hdr = read_exact(4)
+            seq[0] = hdr[3] + 1
+            return read_exact(int.from_bytes(hdr[:3], "little"))
+
+        def ok(affected=0):
+            send(b"\x00" + _lenenc(affected) + _lenenc(0)
+                 + struct.pack("<HH", 2, 0))
+
+        def err(msg, code=1064):
+            send(b"\xff" + struct.pack("<H", code) + b"#42000"
+                 + msg.encode("utf-8"))
+
+        def eof():
+            send(b"\xfe" + struct.pack("<HH", 0, 2))
+
+        def send_rows(cols, rows):
+            send(_lenenc(len(cols)))
+            for c in cols:
+                # Minimal column definition packet.
+                cb = c.encode()
+                pkt = (_lenenc(3) + b"def" + _lenenc(0) + _lenenc(0)
+                       + _lenenc(0) + _lenenc(len(cb)) + cb
+                       + _lenenc(len(cb)) + cb
+                       + bytes([0x0C]) + struct.pack("<HIBHB", 33, 255, 0xFD, 0, 0)
+                       + b"\x00\x00")
+                send(pkt)
+            eof()
+            for row in rows:
+                pkt = b""
+                for v in row:
+                    if v is None:
+                        pkt += b"\xfb"
+                    else:
+                        vb = str(v).encode("utf-8")
+                        pkt += _lenenc(len(vb)) + vb
+                send(pkt)
+            eof()
+
+        try:
+            # HandshakeV10 greeting with a 20-byte scramble.
+            scramble = b"0123456789abcdefghij"
+            greeting = (
+                b"\x0a" + b"8.0-mini\x00" + struct.pack("<I", 1)
+                + scramble[:8] + b"\x00"
+                + struct.pack("<H", 0xF7FF) + bytes([33])
+                + struct.pack("<H", 2) + struct.pack("<H", 0x81FF)
+                + bytes([21]) + b"\x00" * 10
+                + scramble[8:] + b"\x00" + b"mysql_native_password\x00"
+            )
+            send(greeting)
+            read_packet()  # handshake response: accept any credentials
+            seq[0] = 2
+            ok()
+            while True:
+                pkt = read_packet()
+                cmd = pkt[0]
+                if cmd == 0x01:  # COM_QUIT
+                    return
+                if cmd == 0x0E:  # COM_PING
+                    ok()
+                    continue
+                if cmd != 0x03:  # COM_QUERY
+                    err(f"unsupported command {cmd}")
+                    continue
+                sql = pkt[1:].decode("utf-8")
+                try:
+                    self._execute(sql, ok, send_rows, err)
+                except Exception as e:  # noqa: BLE001
+                    err(f"{type(e).__name__}: {e}")
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # --- SQL subset ---------------------------------------------------------
+
+    @staticmethod
+    def _unescape(s: str) -> str:
+        return (s.replace("\\0", "\x00").replace("\\n", "\n")
+                .replace("\\r", "\r").replace("\\'", "'")
+                .replace("\\\\", "\\"))
+
+    _VALS = re.compile(r"'((?:[^'\\]|\\.)*)'")
+
+    def _execute(self, sql: str, ok, send_rows, err) -> None:
+        sql = sql.strip()
+        with self._lock:
+            m = re.match(r"CREATE TABLE IF NOT EXISTS (\w+) \((.*)\)$",
+                         sql, re.S | re.I)
+            if m:
+                name, body = m.group(1), m.group(2)
+                keys: list[str] = []
+                # Extract the table-level PRIMARY KEY clause first: it
+                # contains commas of its own.
+                pk = re.search(r",?\s*PRIMARY KEY \(([^)]*)\)", body, re.I)
+                if pk:
+                    keys = [c.strip() for c in pk.group(1).split(",")]
+                    body = body[:pk.start()] + body[pk.end():]
+                cols = []
+                for part in body.split(","):
+                    part = part.strip()
+                    if not part:
+                        continue
+                    cname = part.split()[0]
+                    cols.append(cname)
+                    if "PRIMARY KEY" in part.upper() and not pk:
+                        keys = [cname]
+                self._tables.setdefault(name, {})
+                self._schemas[name] = cols
+                self._keys[name] = keys or cols[:1]
+                ok()
+                return
+            m = re.match(r"(REPLACE|INSERT IGNORE) INTO (\w+) VALUES \((.*)\)$",
+                         sql, re.S | re.I)
+            if m:
+                mode, name = m.group(1).upper(), m.group(2)
+                vals = [self._unescape(v) for v in self._VALS.findall(m.group(3))]
+                cols = self._schemas[name]
+                row = dict(zip(cols, vals))
+                key = tuple(row[k] for k in self._keys[name])
+                table = self._tables[name]
+                if mode == "INSERT IGNORE" and key in table:
+                    ok(affected=0)
+                    return
+                table[key] = row
+                ok(affected=1)
+                return
+            m = re.match(r"SELECT (.*?) FROM (\w+)(?: WHERE (.*?))?"
+                         r"(?: ORDER BY (\w+))?$", sql, re.S | re.I)
+            if m:
+                what, name, where, order = m.groups()
+                rows = list(self._tables.get(name, {}).values())
+                if where:
+                    for cond in re.split(r"\s+AND\s+", where, flags=re.I):
+                        cm = re.match(r"(\w+)\s*(>=|<=|<|>|=)\s*'((?:[^'\\]|\\.)*)'",
+                                      cond.strip())
+                        if not cm:
+                            err(f"bad condition {cond!r}")
+                            return
+                        col, op, ref = cm.group(1), cm.group(2), self._unescape(cm.group(3))
+                        cmp = {
+                            "=": lambda v, r: v == r,
+                            ">=": lambda v, r: v >= r,
+                            "<=": lambda v, r: v <= r,
+                            "<": lambda v, r: v < r,
+                            ">": lambda v, r: v > r,
+                        }[op]
+                        rows = [r for r in rows if cmp(r.get(col, ""), ref)]
+                if order:
+                    rows.sort(key=lambda r: r.get(order, ""))
+                cols = [c.strip() for c in what.split(",")]
+                if cols == ["1"]:
+                    send_rows(["1"], [["1"] for _ in rows])
+                    return
+                send_rows(cols, [[r.get(c) for c in cols] for r in rows])
+                return
+            m = re.match(r"DELETE FROM (\w+)(?: WHERE (.*))?$", sql, re.S | re.I)
+            if m:
+                name, where = m.groups()
+                table = self._tables.get(name, {})
+                if not where:
+                    n = len(table)
+                    table.clear()
+                    ok(affected=n)
+                    return
+                victims = []
+                for key, r in table.items():
+                    match = True
+                    for cond in re.split(r"\s+AND\s+", where, flags=re.I):
+                        cm = re.match(r"(\w+)\s*=\s*'((?:[^'\\]|\\.)*)'", cond.strip())
+                        if not cm or r.get(cm.group(1)) != self._unescape(cm.group(2)):
+                            match = False
+                            break
+                    if match:
+                        victims.append(key)
+                for key in victims:
+                    del table[key]
+                ok(affected=len(victims))
+                return
+            err(f"unsupported statement: {sql[:80]!r}")

@@ -45,11 +45,23 @@ def _net_server(kind: str):
             yield f"mongodb://127.0.0.1:{srv.port}"
         finally:
             srv.stop()
+    elif kind == "mysql":
+        url = os.environ.get("GOWORLD_MYSQL_URL")
+        if url:
+            yield url
+            return
+        from minimysql import MiniMySQL
+
+        srv = MiniMySQL()
+        try:
+            yield f"mysql://root@127.0.0.1:{srv.port}"
+        finally:
+            srv.stop()
     else:
         yield ""
 
 
-_BACKENDS = ["filesystem", "sqlite", "redis", "mongodb"]
+_BACKENDS = ["filesystem", "sqlite", "redis", "mongodb", "mysql"]
 
 
 @pytest.fixture(params=_BACKENDS)
