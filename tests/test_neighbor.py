@@ -207,22 +207,26 @@ def test_negative_coordinates():
     assert got == want
 
 
-def test_chunked_drain_small_buffer():
-    """max_events far below the first-tick enter storm: chunked drain must
-    still deliver every event exactly once."""
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_chunked_drain_small_buffer(backend):
+    """max_events far below the first-tick enter storm: the chunked drain
+    must page through MANY chunks (rank-based on the pallas path) and
+    deliver every event exactly once."""
     p = NeighborParams(
         capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64, max_events=64,
     )
-    eng = NeighborEngine(p, backend="jnp")
+    eng = NeighborEngine(p, backend=backend)
     eng.reset()
     pos, active, space, radius = make_world(256, 200, seed=0)
     enters, leaves, _ = eng.step(pos, active, space, radius)
     got = pairs_to_setlist(enters, 256)
     want = brute_force_sets(pos, active, space, radius)
     assert got == want
-    # No duplicates across chunks.
-    assert len(enters) == sum(len(s) for s in want)
+    # No duplicates across chunks, and the storm genuinely paged (>2 chunks).
+    total = sum(len(s) for s in want)
+    assert len(enters) == total
+    assert total > 3 * p.max_events
 
 
 def test_radius_exceeding_cell_size_rejected():
