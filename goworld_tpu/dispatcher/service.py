@@ -110,6 +110,7 @@ class DispatcherService:
         self._boot_rr = 0
         self._lbc = LBCHeap()
         self._server: Optional[asyncio.base_events.Server] = None
+        self._conns: set = set()  # all live peer connections
         self._queue: asyncio.Queue = asyncio.Queue(maxsize=consts.DISPATCHER_MESSAGE_QUEUE_LEN)
         self._tasks: list[asyncio.Task] = []
         # position-sync aggregation: gameid → bytearray of 32 B records
@@ -141,6 +142,11 @@ class DispatcherService:
         self._tasks.clear()
         if self._server is not None:
             self._server.close()
+            # Close live connections BEFORE wait_closed(): since 3.12.1
+            # Server.wait_closed() waits for connection handlers, which only
+            # exit once their sockets close — closing after would deadlock.
+            for proxy in list(self._conns):
+                proxy.close()
             await self._server.wait_closed()
         for gi in self.games.values():
             if gi.proxy is not None:
@@ -152,6 +158,7 @@ class DispatcherService:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         proxy = GoWorldConnection(PacketConnection(reader, writer))
+        self._conns.add(proxy)
         try:
             while True:
                 msgtype, packet = await proxy.recv()
@@ -159,6 +166,7 @@ class DispatcherService:
         except ConnectionClosed:
             await self._queue.put((proxy, -1, None))  # disconnect sentinel
         finally:
+            self._conns.discard(proxy)
             proxy.close()
 
     async def _logic_loop(self) -> None:

@@ -139,6 +139,11 @@ class GateService:
         self._tasks.clear()
         if self._server is not None:
             self._server.close()
+            # Close live client sockets BEFORE wait_closed(): since 3.12.1
+            # it waits for connection handlers, which only exit once their
+            # sockets close (same fix as DispatcherService.stop).
+            for cp in list(self.clients.values()):
+                cp.close()
             await self._server.wait_closed()
         if self._ws_server is not None:
             self._ws_server.close()

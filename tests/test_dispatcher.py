@@ -228,3 +228,59 @@ def test_reconnect_rejects_moved_entities():
         await _teardown(disp, c1, c2)
 
     asyncio.run(run())
+
+
+def test_dispatcher_restart_recovery():
+    """Elastic recovery (SURVEY.md §5.3): the dispatcher process dies and a
+    fresh one binds the same port; games' reconnect loops re-handshake with
+    their entity lists, the routing table rebuilds, and entity-routed calls
+    flow again — without the games restarting."""
+
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=0)
+        await disp.start()
+        port = disp.port
+        addr = ("127.0.0.1", port)
+
+        eid = gen_entity_id()
+        game1 = FakePeer()
+        c1 = make_game_cluster(addr, 1, game1, entity_ids=[eid])
+        c1.start()
+        await c1.wait_connected()
+        await game1.expect(MsgType.SET_GAME_ID_ACK)
+
+        # Route an entity call through the dispatcher (loops back to game1).
+        def call(tag: str):
+            p = Packet()
+            p.append_entity_id(eid)
+            p.append_varstr(tag)
+            p.append_args(())
+            c1.select(0).send(MsgType.CALL_ENTITY_METHOD, p)
+
+        call("Before")
+        pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD)
+        assert pkt.read_entity_id() == eid
+
+        # The dispatcher dies. The game stays up; its conn manager retries.
+        await disp.stop()
+        await asyncio.sleep(0.1)
+        disp2 = DispatcherService(1, desired_games=1, desired_gates=0)
+        for _ in range(50):  # the old socket may linger briefly
+            try:
+                await disp2.start(port=port)
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        else:
+            raise AssertionError("could not rebind dispatcher port")
+
+        # Reconnect + re-handshake (entity list) happens automatically.
+        await game1.expect(MsgType.SET_GAME_ID_ACK, timeout=10)
+        call("After")
+        pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD, timeout=10)
+        assert pkt.read_entity_id() == eid
+        assert pkt.read_varstr() == "After"
+
+        await _teardown(disp2, c1)
+
+    asyncio.run(run())
