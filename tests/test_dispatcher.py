@@ -284,3 +284,38 @@ def test_dispatcher_restart_recovery():
         await _teardown(disp2, c1)
 
     asyncio.run(run())
+
+
+def test_unplanned_game_death_cleanup():
+    """Failure detection (SURVEY.md §5.3, DispatcherService.go:592-640): a
+    game dying WITHOUT the freeze handshake loses its routing entries, the
+    survivors get NOTIFY_GAME_DISCONNECTED, and calls to the dead game's
+    entities are dropped instead of buffered forever."""
+
+    async def run():
+        disp = DispatcherService(1, desired_games=2, desired_gates=0)
+        await disp.start()
+        addr = ("127.0.0.1", disp.port)
+        game1, game2 = FakePeer(), FakePeer()
+        c1 = make_game_cluster(addr, 1, game1)
+        c2 = make_game_cluster(addr, 2, game2)
+        for c in (c1, c2):
+            c.start()
+            await c.wait_connected()
+        eid = gen_entity_id()
+        c1.select(0).send_notify_create_entity(eid)
+        await asyncio.sleep(0.05)
+        assert disp.entities[eid].gameid == 1
+
+        # game1 dies abruptly (no freeze handshake).
+        await c1.stop()
+        await game2.expect(MsgType.NOTIFY_GAME_DISCONNECTED, timeout=10)
+        assert eid not in disp.entities  # routes erased
+
+        # Calls to the dead entity drop (unknown entity), not buffer.
+        c2.select(0).send_call_entity_method(eid, "Ghost", ())
+        await asyncio.sleep(0.1)
+        assert not any(mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game2.received)
+        await _teardown(disp, c2)
+
+    asyncio.run(run())
