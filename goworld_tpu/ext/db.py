@@ -4,13 +4,13 @@ Reference parity: ``ext/db/gwmongo`` + ``ext/db/gwredis`` — thin wrappers
 that run driver calls on a dedicated serial async job group and post
 callbacks back to the game loop (gwmongo.go:31-346, gwredis.go:16-44).
 
-This image ships neither pymongo nor redis, so the production-shaped
-implementation is :class:`DocDB` over sqlite (one table per collection,
-JSON documents, indexable id) — same call shape as gwmongo's DB: every
-method is fire-and-forget with ``callback(result, err)`` marshalled back to
-the main loop via the async job group. ``dial_mongo`` / ``dial_redis``
-detect their drivers and raise a clear error when absent (gated, not
-stubbed silently).
+No DB drivers ship in this image, so all three helpers are real and
+driver-free: :class:`DocDB` over sqlite (one table per collection, JSON
+documents, indexable id), :class:`GwRedis` over the in-repo RESP2 client
+(netutil/resp.py) and :class:`GwMongo` over the in-repo OP_MSG client
+(netutil/mongo.py). Every method is fire-and-forget with
+``callback(result, err)`` marshalled back to the main loop via the async
+job group, matching gwmongo/gwredis call shapes.
 """
 
 from __future__ import annotations
