@@ -422,3 +422,29 @@ def test_pallas_cell_capacity_cap():
             ),
             backend="pallas_interpret",
         )
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_mid_run_reset_reenters_cleanly(backend):
+    """Freeze/restore re-entry: reset() mid-run must behave exactly like a
+    fresh engine — full enter storm, no stale carried state (the pallas
+    path carries the previous grid in engine state since round 3)."""
+    p = NeighborParams(
+        capacity=128, cell_size=100.0, grid_x=8, grid_z=8,
+        space_slots=2, cell_capacity=32, max_events=8192,
+    )
+    eng = NeighborEngine(p, backend=backend)
+    eng.reset()
+    pos, active, space, radius = make_world(128, 100, seed=3, world=700)
+    for _ in range(3):
+        eng.step(pos, active, space, radius)
+        pos = np.clip(pos + 11.0, 0, 700).astype(np.float32)
+
+    eng.reset()  # restore re-entry
+    e1, l1, _ = eng.step(pos, active, space, radius)
+
+    fresh = NeighborEngine(p, backend=backend)
+    fresh.reset()
+    e2, l2, _ = fresh.step(pos, active, space, radius)
+    assert pairs_to_setlist(e1, 128) == pairs_to_setlist(e2, 128)
+    assert len(l1) == len(l2) == 0  # nothing to leave after a reset
