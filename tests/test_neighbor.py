@@ -448,3 +448,37 @@ def test_mid_run_reset_reenters_cleanly(backend):
     e2, l2, _ = fresh.step(pos, active, space, radius)
     assert pairs_to_setlist(e1, 128) == pairs_to_setlist(e2, 128)
     assert len(l1) == len(l2) == 0  # nothing to leave after a reset
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_pipelined_step_async_matches_sync(backend):
+    """The bench's production loop: dispatch tick t+1 BEFORE collecting
+    tick t (one in-flight PendingStep). Must produce the identical stream —
+    in particular the pallas path's carried grid arrays are referenced by
+    the in-flight step's paging context and must not be clobbered."""
+    p = NeighborParams(
+        capacity=128, cell_size=100.0, grid_x=8, grid_z=8,
+        space_slots=2, cell_capacity=32, max_events=64,  # tiny → paging too
+    )
+    sync_eng = NeighborEngine(p, backend=backend)
+    pipe_eng = NeighborEngine(p, backend=backend)
+    sync_eng.reset()
+    pipe_eng.reset()
+    rng = np.random.default_rng(21)
+    pos, active, space, radius = make_world(128, 110, seed=21, world=700)
+    vel = rng.normal(0, 20, pos.shape).astype(np.float32)
+
+    sync_stream, pipe_stream = [], []
+    pending = None
+    for _ in range(6):
+        e1, l1, _ = sync_eng.step(pos, active, space, radius)
+        sync_stream.append((sorted(map(tuple, e1)), sorted(map(tuple, l1))))
+        nxt = pipe_eng.step_async(pos, active, space, radius)
+        if pending is not None:
+            e2, l2, _ = pending.collect()
+            pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+        pending = nxt
+        pos = np.clip(pos + vel, 0, 700).astype(np.float32)
+    e2, l2, _ = pending.collect()
+    pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+    assert sync_stream == pipe_stream
