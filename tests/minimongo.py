@@ -24,9 +24,12 @@ _OP_MSG = 2013
 
 
 class MiniMongo:
-    def __init__(self) -> None:
+    def __init__(self, batch_size: int = 1000) -> None:
         # dbs[db][coll] = {_id: doc}
         self._dbs: dict[str, dict[str, dict]] = {}
+        self._batch = batch_size  # server-side cap, exercises getMore
+        self._cursors: dict[int, list] = {}  # cursor id → remaining docs
+        self._next_cursor = 1000
         self._lock = threading.Lock()
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -161,7 +164,25 @@ class MiniMongo:
                     docs = [{k: d[k] for k in keep if k in d} for d in docs]
                 if cmd.get("limit"):
                     docs = docs[:cmd["limit"]]
-                return {"ok": 1, "cursor": {"id": 0, "ns": "", "firstBatch": docs}}
+                batch = min(self._batch, int(cmd.get("batchSize", self._batch)))
+                first, rest = docs[:batch], docs[batch:]
+                cid = 0
+                if rest:
+                    cid = self._next_cursor
+                    self._next_cursor += 1
+                    self._cursors[cid] = rest
+                return {"ok": 1, "cursor": {"id": cid, "ns": "",
+                                            "firstBatch": first}}
             if "getMore" in cmd:
-                return {"ok": 1, "cursor": {"id": 0, "ns": "", "nextBatch": []}}
+                cid = int(cmd["getMore"])
+                rest = self._cursors.get(cid, [])
+                batch = min(self._batch, int(cmd.get("batchSize", self._batch)))
+                out, rest = rest[:batch], rest[batch:]
+                if rest:
+                    self._cursors[cid] = rest
+                else:
+                    self._cursors.pop(cid, None)
+                    cid = 0
+                return {"ok": 1, "cursor": {"id": cid, "ns": "",
+                                            "nextBatch": out}}
             return {"ok": 0, "errmsg": f"unknown command {sorted(cmd)[:3]}", "code": 59}

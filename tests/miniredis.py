@@ -18,8 +18,9 @@ import threading
 
 
 class MiniRedis:
-    def __init__(self) -> None:
+    def __init__(self, scan_page: int = 256) -> None:
         self._dbs: dict[int, dict[bytes, bytes]] = {}
+        self._scan_page = scan_page  # force real cursor pagination
         self._lock = threading.Lock()
         self._srv = socket.socket()
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -134,17 +135,27 @@ class MiniRedis:
                 return b"".join(parts), db
             if cmd == b"SCAN":
                 pattern = b"*"
+                count = self._scan_page
                 for i, a in enumerate(args):
                     if a.upper() == b"MATCH":
                         pattern = args[i + 1]
-                keys = [
+                    elif a.upper() == b"COUNT":
+                        count = min(int(args[i + 1]), self._scan_page)
+                keys = sorted(
                     k for k in store
                     if fnmatch.fnmatchcase(
                         k.decode("utf-8", "replace"),
                         pattern.decode("utf-8", "replace"),
                     )
-                ]
-                parts = [b"*2\r\n$1\r\n0\r\n", b"*%d\r\n" % len(keys)]
-                parts += [self._bulk(k) for k in keys]
+                )
+                # Cursor = offset into the sorted snapshot: real pagination
+                # so clients must run the full SCAN loop.
+                start = int(args[1])
+                page = keys[start:start + count]
+                nxt = start + count if start + count < len(keys) else 0
+                nb = str(nxt).encode()
+                parts = [b"*2\r\n$%d\r\n%s\r\n" % (len(nb), nb),
+                         b"*%d\r\n" % len(page)]
+                parts += [self._bulk(k) for k in page]
                 return b"".join(parts), db
             return b"-ERR unknown command '%s'\r\n" % cmd, db

@@ -150,3 +150,35 @@ def test_async_kvdb_api(tmp_path):
     post.tick()
     assert results == ["put", "avatar9", "avatar9"]
     kvdb.set_backend(None)
+
+
+def test_network_backend_pagination():
+    """The wire clients' pagination loops (redis SCAN cursor, mongo getMore)
+    must walk multiple server pages without losing or duplicating keys."""
+    from miniredis import MiniRedis
+    from minimongo import MiniMongo
+
+    from goworld_tpu.storage.redis import RedisEntityStorage
+    from goworld_tpu.storage.mongodb import MongoEntityStorage
+
+    rsrv = MiniRedis(scan_page=7)
+    try:
+        b = RedisEntityStorage(f"redis://127.0.0.1:{rsrv.port}/0")
+        ids = [f"{i:016d}" for i in range(40)]
+        for eid in ids:
+            b.write("Avatar", eid, {"i": eid})
+        assert b.list_entity_ids("Avatar") == ids  # 6 SCAN pages
+        b.close()
+    finally:
+        rsrv.stop()
+
+    msrv = MiniMongo(batch_size=7)
+    try:
+        b = MongoEntityStorage(f"mongodb://127.0.0.1:{msrv.port}")
+        ids = [f"{i:016d}" for i in range(40)]
+        for eid in ids:
+            b.write("Avatar", eid, {"i": eid})
+        assert b.list_entity_ids("Avatar") == ids  # 6 getMore batches
+        b.close()
+    finally:
+        msrv.stop()
