@@ -67,13 +67,18 @@ import numpy as np
 
 LANES = 128  # Pallas cell capacity = one TPU lane dimension
 _PACK = 16  # event-mask bits packed per i32 word
-_F = 16  # padded feature count (sublane multiple of 8)
+_F = 8  # feature count (sublane multiple of 8)
 
 # Feature rows in the dense cell layout. Epoch A = the epoch whose positions
 # the grid is binned by; epoch B = the other epoch. The kernel computes
 # valid_A ∧ ¬valid_B, so the same kernel serves both passes with A/B swapped.
-_FX_A, _FZ_A, _FS_A, _FR_A, _FAV_A = 0, 1, 2, 3, 4
-_FX_B, _FZ_B, _FS_B, _FR_B, _FAV_B = 5, 6, 7, 8, 9
+# Empty slots carry NaN in their x rows instead of a separate occupancy row:
+# NaN poisons d2 for both the query and candidate side of any pair touching
+# an empty slot, and IEEE `NaN <= r2` is false — so 8 rows (one sublane
+# tile) do the work 10-gated-to-16 did in round 2, halving feats traffic
+# and the kernel's halo DMA.
+_FX_A, _FZ_A, _FS_A, _FR_A = 0, 1, 2, 3
+_FX_B, _FZ_B, _FS_B, _FR_B = 4, 5, 6, 7
 
 
 @dataclasses.dataclass(frozen=True)
@@ -299,52 +304,82 @@ def _step_packed_jnp(p: NeighborParams, ppos, pact, pspc, prad, pos, act, spc, r
 # --- Pallas path -------------------------------------------------------------
 
 
-def _scatter_feats(p: NeighborParams, table, feats_a, feats_b):
-    """Build the dense cell feature layout by GATHERING through the slot
-    table (``table[slot] = entity or sentinel N`` is already the inverse of
-    the scatter round 2 did here — and TPU gathers are far cheaper than the
-    10 scatters per pass they replace).
+def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b):
+    """Build the dense cell feature layout with ONE row-vector scatter.
 
-    feats_a = (x, z, space, radius, av) of the epoch the grid is binned by;
-    feats_b = the same five for the other epoch. The ``av`` rows are gated
-    to 0 on empty slots; other rows may carry garbage there, which the
-    kernel's av test masks out. Returns f32[space_slots, gz+2, gx+2, F,
-    LANES] with a torus halo ring.
+    ``order``/``dst`` come from _build_table: sorted entity order and each
+    sorted entity's flat slot (or table_size for dropped). All 8 feature
+    rows ride a single [N, F] scatter into a NaN-initialized [TS, F] flat
+    layout — measured 5x cheaper on-chip than 8 gathers through the table
+    (2026-07-30; empty slots inherit NaN x, which is exactly the occupancy
+    poisoning the kernel's validity math wants, see the _F comment).
+
+    feats_a = (x, z, space, radius) of the epoch the grid is binned by;
+    feats_b = the same four for the other epoch. Returns
+    f32[space_slots, gz+2, gx+2, F, LANES] with a torus halo ring.
     """
-    n = p.capacity
-    safe = jnp.minimum(table, n - 1)
-    present = table < n
-
-    def gather(values, gate: bool = False):
-        out = values[safe].astype(jnp.float32)
-        return jnp.where(present, out, 0.0) if gate else out
-
-    rows = [gather(v, gate=i == 4) for i, v in enumerate(feats_a)]
-    rows += [gather(v, gate=i == 4) for i, v in enumerate(feats_b)]
-    feats = jnp.stack(rows)  # [10, flat]
-    feats = jnp.pad(feats, ((0, _F - len(rows)), (0, 0)))
-    cells = feats.reshape(_F, p.space_slots, p.grid_z, p.grid_x, LANES)
-    cells = cells.transpose(1, 2, 3, 0, 4)  # [S, gz, gx, F, LANES]
+    table_size = p.num_buckets * LANES
+    vals = jnp.stack(
+        [f.astype(jnp.float32) for f in feats_a]
+        + [f.astype(jnp.float32) for f in feats_b],
+        axis=1,
+    )  # [N, F]
+    flat = jnp.full((table_size, _F), jnp.nan, jnp.float32)
+    flat = flat.at[dst].set(vals[order], mode="drop")
+    cells = flat.reshape(p.space_slots, p.grid_z, p.grid_x, LANES, _F)
+    cells = cells.transpose(0, 1, 2, 4, 3)  # [S, gz, gx, F, LANES]
     # Torus halo ring per space slab (spatial dims only).
     return jnp.pad(cells, ((0, 0), (1, 1), (1, 1), (0, 0), (0, 0)), mode="wrap")
 
 
-def _event_kernel(p: NeighborParams, cells_hbm, out_ref, scratch, sem):
+def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
+                  sem):
     """One program per grid cell: DMA the 3x3 halo block, evaluate
-    valid_A ∧ ¬valid_B for all 128 × 1152 pairs, bit-pack the mask."""
+    valid_A ∧ ¬valid_B for all 128 × 1152 pairs, bit-pack the mask.
+
+    ``dual`` additionally emits valid_B ∧ ¬valid_A (the leave mask) into the
+    second half of the output words — the single-launch fast path when every
+    epoch-B pair is guaranteed to sit inside epoch-A's 3x3 halo
+    (_step_pallas's displacement guard).
+
+    The halo DMA is double-buffered across grid steps: ~7.7k sequential
+    73 KB copies at the headline config are latency-bound, and the serial
+    start();wait() of round 2 made that latency ~half the kernel's runtime
+    (measured on-chip 2026-07-30); prefetching cell k+1 during cell k's
+    pair math hides it.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     s = pl.program_id(0)
     i = pl.program_id(1)
     j = pl.program_id(2)
-    dma = pltpu.make_async_copy(
-        cells_hbm.at[s, pl.ds(i, 3), pl.ds(j, 3)], scratch, sem
-    )
-    dma.start()
-    dma.wait()
+    rows = pl.num_programs(1)
+    gx = pl.num_programs(2)
+    lin = (s * rows + i) * gx + j
+    total = pl.num_programs(0) * rows * gx
+    slot = jax.lax.rem(lin, 2)
+    nslot = jax.lax.rem(lin + 1, 2)
 
-    c = scratch[:]  # [3, 3, F, LANES]
+    def halo_copy(idx_lin, buf):
+        s2 = idx_lin // (rows * gx)
+        r = jax.lax.rem(idx_lin, rows * gx)
+        return pltpu.make_async_copy(
+            cells_hbm.at[s2, pl.ds(r // gx, 3), pl.ds(jax.lax.rem(r, gx), 3)],
+            scratch.at[buf],
+            sem.at[buf],
+        )
+
+    @pl.when(lin == 0)
+    def _():
+        halo_copy(lin, slot).start()
+
+    @pl.when(lin + 1 < total)
+    def _():
+        halo_copy(lin + 1, nslot).start()
+
+    halo_copy(lin, slot).wait()
+    c = scratch[slot]  # [3, 3, F, LANES]
     cand = c.transpose(2, 0, 1, 3).reshape(_F, 9 * LANES)
     q = c[1, 1]  # [F, LANES]
 
@@ -353,47 +388,71 @@ def _event_kernel(p: NeighborParams, cells_hbm, out_ref, scratch, sem):
     cidx = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 1)
     not_self = cidx != 4 * LANES + lane
 
-    def valid(fx, fz, fs, fr, fav):
+    def valid(fx, fz, fs, fr):
+        # Empty slots have NaN x (see _F comment): d2 goes NaN for any pair
+        # touching one, and `NaN <= r2` is false — no occupancy rows needed.
         dx = cand[fx][None, :] - q[fx][:, None]
         dz = cand[fz][None, :] - q[fz][:, None]
         d2 = dx * dx + dz * dz
         r2 = (q[fr] * q[fr])[:, None]
         return (
-            (q[fav][:, None] > 0.0)
-            & (cand[fav][None, :] > 0.0)
-            & (q[fs][:, None] == cand[fs][None, :])
-            & (d2 <= r2)
-            & not_self
+            (q[fs][:, None] == cand[fs][None, :]) & (d2 <= r2) & not_self
         )
 
-    mask = valid(_FX_A, _FZ_A, _FS_A, _FR_A, _FAV_A) & ~valid(
-        _FX_B, _FZ_B, _FS_B, _FR_B, _FAV_B
+    v_a = valid(_FX_A, _FZ_A, _FS_A, _FR_A)
+    v_b = valid(_FX_B, _FZ_B, _FS_B, _FR_B)
+
+    # Bit-pack 16 candidate bits per i32 word via TWO half-word MXU matmuls.
+    # Round 2's single matmul (weights up to 2^15) lost the LSB of sums near
+    # 2^16 on hardware (f32 MXU emulation); round 3's integer shift-add
+    # rewrite was exact but needs a [LANES, W, 16] reshape Mosaic's
+    # infer-vector-layout rejects ("unsupported shape cast", seen on-chip
+    # 2026-07-30). Splitting the word into 8-bit halves keeps the
+    # Mosaic-supported matmul shape AND exactness: each half's weights are
+    # 2^0..2^7 (exact in bf16) and its per-word sum is <= 255, exactly
+    # representable under any MXU accumulation scheme; lo + 256*hi <= 65535
+    # is exact in f32 on the VPU.
+    w_words = 9 * LANES // _PACK
+    c_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 0)
+    w_iota = jax.lax.broadcasted_iota(jnp.int32, (9 * LANES, w_words), 1)
+    bit = c_iota - w_iota * _PACK  # bit index within the word, or out of range
+    half = _PACK // 2
+    pmat_lo = jnp.where(
+        (bit >= 0) & (bit < half), jnp.exp2(bit.astype(jnp.float32)), 0.0
+    )
+    pmat_hi = jnp.where(
+        (bit >= half) & (bit < _PACK),
+        jnp.exp2((bit - half).astype(jnp.float32)),
+        0.0,
     )
 
-    # Bit-pack 16 candidate bits per i32 word with integer shift-adds on the
-    # VPU — exact by construction. (Round 2 packed via an exp2 MXU matmul;
-    # f32 dot emulation loses the LSB of sums near 2^16, silently flipping
-    # one event bit per full word — and the matmul was ~70x more work than
-    # this elementwise reduce anyway.)
-    w_words = 9 * LANES // _PACK
-    m = mask.astype(jnp.int32).reshape(LANES, w_words, _PACK)
-    weights = (jnp.int32(1) << jnp.arange(_PACK, dtype=jnp.int32))
-    out_ref[0, 0, 0] = jnp.sum(m * weights[None, None, :], axis=-1)
+    def pack(mask):
+        mf = mask.astype(jnp.float32)
+        lo = jnp.dot(mf, pmat_lo, preferred_element_type=jnp.float32)
+        hi = jnp.dot(mf, pmat_hi, preferred_element_type=jnp.float32)
+        return (lo + 256.0 * hi).astype(jnp.int32)  # [LANES, W]
+
+    enter = pack(v_a & ~v_b)
+    if dual:
+        out_ref[0, 0, 0] = jnp.concatenate([enter, pack(v_b & ~v_a)], axis=1)
+    else:
+        out_ref[0, 0, 0] = enter
 
 
 @functools.lru_cache(maxsize=None)
 def _compiled_event_kernel(p: NeighborParams, interpret: bool,
-                           rows: int | None = None):
+                           rows: int | None = None, dual: bool = False):
     """``rows`` limits the kernel to a slab of grid rows (cells input is then
     the slab plus its 2 halo rows): the sharded engine launches one slab per
-    device (parallel/mesh.py)."""
+    device (parallel/mesh.py). ``dual`` emits enter+leave masks in one launch
+    (words [0, W) enter, [W, 2W) leave)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if rows is None:
         rows = p.grid_z
-    w_words = 9 * LANES // _PACK
-    kernel = functools.partial(_event_kernel, p)
+    w_words = (9 * LANES // _PACK) * (2 if dual else 1)
+    kernel = functools.partial(_event_kernel, p, dual)
     return pl.pallas_call(
         kernel,
         grid=(p.space_slots, rows, p.grid_x),
@@ -407,8 +466,8 @@ def _compiled_event_kernel(p: NeighborParams, interpret: bool,
             (p.space_slots, rows, p.grid_x, LANES, w_words), jnp.int32
         ),
         scratch_shapes=[
-            pltpu.VMEM((3, 3, _F, LANES), jnp.float32),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, 3, 3, _F, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )
@@ -460,11 +519,25 @@ def _drain_bits(
     row = jnp.clip(row, 0, n - 1)
     k = j - row_starts[row]  # event rank within its row
 
-    row_pc = pc[row]  # [E, W]
-    word_cum = jnp.cumsum(row_pc, axis=1)  # inclusive
-    w = jnp.sum((word_cum <= k[:, None]).astype(jnp.int32), axis=1)
-    w = jnp.minimum(w, row_pc.shape[1] - 1)
-    word_start = word_cum[jnp.arange(max_events), w] - row_pc[jnp.arange(max_events), w]
+    # Word selection by binary search over the row's inclusive word-count
+    # cumsum: computed ONCE as [N, W] and probed with ceil(log2(W+1)) flat
+    # [E] gathers. (The round-3 predecessor gathered each event's full
+    # 72-word row and re-cumsummed it — [E, W] traffic ~7x this, measured
+    # on-chip 2026-07-30.)
+    nw = pc.shape[1]
+    word_cum = jnp.cumsum(pc, axis=1)  # [N, W] inclusive
+    wc_flat = word_cum.reshape(-1)
+    pc_flat = pc.reshape(-1)
+    base = row * nw
+    lo = jnp.zeros((max_events,), jnp.int32)
+    hi = jnp.full((max_events,), nw, jnp.int32)
+    for _ in range(max(1, nw.bit_length())):
+        mid = jnp.minimum((lo + hi) // 2, nw - 1)
+        gt = wc_flat[base + mid] > k
+        hi = jnp.where(gt, mid, hi)
+        lo = jnp.where(gt, lo, mid + 1)
+    w = jnp.minimum(lo, nw - 1)
+    word_start = wc_flat[base + w] - pc_flat[base + w]
     kk = k - word_start  # set-bit rank within the word
 
     word = packed_e[row, w]
@@ -490,33 +563,82 @@ def _drain_bits(
 def _step_pallas(
     p: NeighborParams, interpret: bool,
     ppos, pact, pspc, prad,  # previous-tick inputs
-    pcx, pcz, psm, ptable, pslot,  # previous tick's CARRIED grid artifacts
+    pcx, pcz, psm, ptable, pslot, porder, pdst,  # prev tick's CARRIED grid
     pos, act, spc, rad,  # current-tick inputs
 ):
-    """Two Pallas passes (enter on the current grid, leave on the previous
-    grid) + XLA postlude. The previous grid's bins/table/slot are carried
-    in engine state (they were this tick's current grid last tick), so only
-    ONE argsort+table build runs per tick. Returns the paging contexts, the
-    packed readback, and the current grid artifacts for the next carry."""
+    """Pallas passes + XLA postlude. The previous grid's bins/table/slot are
+    carried in engine state (they were this tick's current grid last tick),
+    so only ONE argsort+table build runs per tick.
+
+    Launch strategy (measured on-chip 2026-07-30: the second feats+kernel
+    pass was ~88 ms of a 271 ms tick at 102k entities): when NO entity
+    deactivated, changed space, was capacity-dropped, or moved more than
+    (cell_size − r_prev)/2 since the previous tick, every pair valid in
+    EITHER epoch sits inside the 3x3 halo of the CURRENT grid — two points
+    in cells ≥ 2 apart are > cell_size apart, and dist_now(a,b) ≤ r_prev +
+    2·max_disp for any previously-valid pair — so ONE dual-output launch on
+    the current grid yields both masks. Despawn / space-hop / teleport /
+    drop ticks take the exact two-launch path (enter on the current grid,
+    leave on the previous). Returns the paging contexts, the packed
+    readback, and the current grid artifacts for the next carry."""
     kernel = _compiled_event_kernel(p, interpret)
+    kernel_dual = _compiled_event_kernel(p, interpret, dual=True)
 
     cxc, czc, smc = _bins(p, pos, spc)
     cxp, czp, smp = pcx, pcz, psm
     buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
-    table_c, slot_c, dropped_c, _, _ = _build_table(p, buc_c, act, LANES)
+    table_c, slot_c, dropped_c, order_c, dst_c = _build_table(
+        p, buc_c, act, LANES
+    )
     table_p, slot_p = ptable, pslot
-    av_c = (slot_c >= 0).astype(jnp.float32)
-    av_p = (slot_p >= 0).astype(jnp.float32)
 
-    cur_feats = (pos[:, 0], pos[:, 1], spc, rad, av_c)
-    prev_feats = (ppos[:, 0], ppos[:, 1], pspc, prad, av_p)
-    cells_c = _scatter_feats(p, table_c, cur_feats, prev_feats)
-    cells_p = _scatter_feats(p, table_p, prev_feats, cur_feats)
+    # Each epoch's x row is poisoned by its OWN slot validity: an entity
+    # outside epoch E's table (inactive or capacity-dropped that tick) must
+    # be invalid under E even when its row is written through the OTHER
+    # epoch's table — e.g. a fresh spawn's stale previous position must not
+    # suppress its enter event.
+    xs_c = jnp.where(slot_c >= 0, pos[:, 0], jnp.nan)
+    xs_p = jnp.where(slot_p >= 0, ppos[:, 0], jnp.nan)
+    cur_feats = (xs_c, pos[:, 1], spc, rad)
+    prev_feats = (xs_p, ppos[:, 1], pspc, prad)
+    cells_c = _scatter_feats(p, dst_c, order_c, cur_feats, prev_feats)
 
-    packed_cells_e = kernel(cells_c)  # enter mask, rows = current grid
-    packed_cells_l = kernel(cells_p)  # leave mask, rows = previous grid
+    both = pact & act
+    deact = jnp.any(pact & ~act)
+    spchg = jnp.any(both & (pspc != spc))
+    disp = jnp.sqrt(
+        jnp.max(jnp.where(both, jnp.sum((pos - ppos) ** 2, axis=1), 0.0))
+    )
+    prad_max = jnp.max(jnp.where(pact, prad, 0.0))
+    # dropped_c == 0 is required: a capacity-dropped entity is absent from
+    # table_c entirely, so the single-launch path could never see its
+    # epoch-B pairs — its neighbors' leave events must come from the
+    # previous grid, where it is still tabled (code-review r3 finding).
+    fast = (
+        (~deact)
+        & (~spchg)
+        & (dropped_c == 0)
+        & (2.0 * disp + prad_max <= p.cell_size)
+    )
 
     w_words = 9 * LANES // _PACK
+
+    # Each branch returns its masks WITH the grid artifacts the leave mask
+    # was computed on (current grid in fast mode, previous otherwise) — the
+    # cond unifies them without per-array selects.
+    def fast_fn():
+        pk2 = kernel_dual(cells_c)
+        return (pk2[..., :w_words], pk2[..., w_words:],
+                cxc, czc, smc, table_c, slot_c)
+
+    def slow_fn():
+        cells_p = _scatter_feats(p, pdst, porder, prev_feats, cur_feats)
+        return (kernel(cells_c), kernel(cells_p),
+                cxp, czp, smp, table_p, slot_p)
+
+    packed_cells_e, packed_cells_l, lcx, lcz, lsm, ltable, lslot = (
+        jax.lax.cond(fast, fast_fn, slow_fn)
+    )
 
     def per_entity(packed_cells, slot):
         flat = packed_cells.reshape(-1, w_words)
@@ -524,12 +646,12 @@ def _step_pallas(
         return jnp.where((slot >= 0)[:, None], flat[safe], 0)
 
     packed_e = per_entity(packed_cells_e, slot_c)  # i32[N, W]
-    packed_l = per_entity(packed_cells_l, slot_p)
+    packed_l = per_entity(packed_cells_l, lslot)
     n_enters = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
     n_leaves = jnp.sum(jax.lax.population_count(packed_l)).astype(jnp.int32)
 
     ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0))
-    lp, _ = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0))
+    lp, _ = _drain_bits(p, packed_l, lcx, lcz, lsm, ltable, jnp.int32(0))
     # Rank-based paging resumes at max_events, so the cursor row is unused.
     zero = jnp.int32(0)
     header = jnp.stack(
@@ -542,8 +664,8 @@ def _step_pallas(
     out = jnp.concatenate([header, ep, lp], axis=0)
     # Paging context: everything _drain_bits needs for overflow chunks.
     enter_ctx = (packed_e, cxc, czc, smc, table_c)
-    leave_ctx = (packed_l, cxp, czp, smp, table_p)
-    next_grid = (cxc, czc, smc, table_c, slot_c)
+    leave_ctx = (packed_l, lcx, lcz, lsm, ltable)
+    next_grid = (cxc, czc, smc, table_c, slot_c, order_c, dst_c)
     return enter_ctx, leave_ctx, out, next_grid
 
 
@@ -558,10 +680,13 @@ def _jitted_step_packed(params: NeighborParams, backend: str):
         fn = functools.partial(
             _step_pallas, params, backend == "pallas_interpret"
         )
-    # Only the previous-tick INPUT arrays are donated. The pallas path's
-    # carried grid artifacts (args 4-8) must NOT be: the still-pending
-    # previous step's paging context references those exact buffers.
-    return jax.jit(fn, donate_argnums=(0, 1, 2, 3))
+    # Only the previous-tick POSITION array is donated. The carried grid
+    # artifacts (pallas args 4-10) must NOT be: the still-pending previous
+    # step's paging context references those exact buffers. The previous
+    # meta arrays (act/space/radius) must not be either: with
+    # ``meta_dirty=False`` the SAME device buffers are passed as both the
+    # previous and current epoch's meta.
+    return jax.jit(fn, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -623,6 +748,16 @@ class PendingStep:
         self._out = out
         self._collected = False
         start_host_copy(out)
+
+    def is_ready(self) -> bool:
+        """True when collect() will not block on device compute (the packed
+        result is finished; the host copy may still be a memcpy away).
+        Callers on a latency-critical thread — the single-threaded game loop
+        — poll this to frame-skip instead of stalling (batched.py)."""
+        try:
+            return bool(self._out.is_ready())
+        except AttributeError:  # older jax array types
+            return True
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         """Fetch (enter_pairs, leave_pairs, dropped); one blocking read."""
@@ -715,8 +850,9 @@ class NeighborEngine:
         )
         if self.backend != "jnp":
             # Carried grid artifacts of the (all-inactive) previous tick:
-            # sentinel table, -1 slots — exactly what _build_table returns
-            # for active=False everywhere; bins are irrelevant then.
+            # sentinel table, -1 slots, all-dropped dst — exactly what
+            # _build_table returns for active=False everywhere; bins and
+            # order are irrelevant then.
             table_size = self.params.num_buckets * LANES
             self._state = self._state + (
                 jnp.zeros((n,), jnp.int32),  # pcx
@@ -724,6 +860,8 @@ class NeighborEngine:
                 jnp.zeros((n,), jnp.int32),  # psm
                 jnp.full((table_size,), n, jnp.int32),  # ptable
                 jnp.full((n,), -1, jnp.int32),  # pslot
+                jnp.arange(n, dtype=jnp.int32),  # porder
+                jnp.full((n,), table_size, jnp.int32),  # pdst
             )
 
     def _page(self, ctx, remaining: int, start_flat: int) -> np.ndarray:
@@ -745,12 +883,18 @@ class NeighborEngine:
         active: np.ndarray,
         space: np.ndarray,
         radius: np.ndarray,
+        meta_dirty: bool = True,
     ) -> PendingStep:
         """Dispatch one tick without blocking; collect() fetches the events.
 
         State advances immediately, so back-to-back step_async calls
         pipeline: tick t+1 computes while tick t's packed result is in
         flight to the host.
+
+        ``meta_dirty=False`` asserts that active/space/radius are unchanged
+        since the previous step: the device-resident copies are reused and
+        only positions are uploaded (~half the per-tick host→device bytes;
+        spawn/despawn/space/radius changes are rare relative to movement).
         """
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
@@ -760,12 +904,15 @@ class NeighborEngine:
         # state, so they must not alias the caller's numpy buffers — on the
         # CPU backend a zero-copy view would silently mutate history when
         # game code updates positions in place.
-        cur = (
-            jnp.array(pos, jnp.float32),
-            jnp.array(active, jnp.bool_),
-            jnp.array(space, jnp.int32),
-            jnp.array(radius, jnp.float32),
-        )
+        if meta_dirty:
+            meta = (
+                jnp.array(active, jnp.bool_),
+                jnp.array(space, jnp.int32),
+                jnp.array(radius, jnp.float32),
+            )
+        else:
+            meta = self._state[1:4]
+        cur = (jnp.array(pos, jnp.float32),) + meta
         if self.backend == "jnp":
             enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
             next_state = cur

@@ -191,20 +191,26 @@ def _sharded_step_pallas(
     def one_grid(xpos, xact, xspc):
         cx, cz, sm = _bins(p, xpos, xspc)
         buc = (sm * p.grid_z + cz) * p.grid_x + cx
-        table, slot, dropped, _, _ = _build_table(p, buc, xact, LANES)
-        return cx, cz, sm, table, slot, dropped
+        table, slot, dropped, order, dst = _build_table(p, buc, xact, LANES)
+        return cx, cz, sm, table, slot, dropped, order, dst
 
-    cxc, czc, smc, table_c, slot_c, dropped_c = one_grid(pos, act, spc)
-    cxp, czp, smp, table_p, slot_p, _ = one_grid(ppos, pact, pspc)
-    av_c = (slot_c >= 0).astype(jnp.float32)
-    av_p = (slot_p >= 0).astype(jnp.float32)
-    cur_feats = (pos[:, 0], pos[:, 1], spc, rad, av_c)
-    prev_feats = (ppos[:, 0], ppos[:, 1], pspc, prad, av_p)
+    cxc, czc, smc, table_c, slot_c, dropped_c, order_c, dst_c = one_grid(
+        pos, act, spc
+    )
+    cxp, czp, smp, table_p, slot_p, _, order_p, dst_p = one_grid(
+        ppos, pact, pspc
+    )
+    # x rows poisoned by each epoch's own slot validity (ops/neighbor:
+    # _step_pallas) — NaN replaces the av occupancy rows of round 2.
+    xs_c = jnp.where(slot_c >= 0, pos[:, 0], jnp.nan)
+    xs_p = jnp.where(slot_p >= 0, ppos[:, 0], jnp.nan)
+    cur_feats = (xs_c, pos[:, 1], spc, rad)
+    prev_feats = (xs_p, ppos[:, 1], pspc, prad)
 
-    def one_pass(feats_a, feats_b, cx, cz, sm, table, slot):
+    def one_pass(feats_a, feats_b, cx, cz, sm, slot, order, dst):
         """Events for pairs valid under epoch A but not epoch B, binned by
         epoch A's grid (ops/neighbor._step_pallas, slab-sharded)."""
-        cells = _scatter_feats(p, table, feats_a, feats_b)
+        cells = _scatter_feats(p, dst, order, feats_a, feats_b)
         slab = jax.lax.dynamic_slice_in_dim(cells, lo, rows + 2, axis=1)
         packed_cells = kernel(slab)  # [S, rows, gx, LANES, W]
 
@@ -220,10 +226,10 @@ def _sharded_step_pallas(
         return packed_e, count
 
     packed_e, n_enters = one_pass(
-        cur_feats, prev_feats, cxc, czc, smc, table_c, slot_c
+        cur_feats, prev_feats, cxc, czc, smc, slot_c, order_c, dst_c
     )
     packed_l, n_leaves = one_pass(
-        prev_feats, cur_feats, cxp, czp, smp, table_p, slot_p
+        prev_feats, cur_feats, cxp, czp, smp, slot_p, order_p, dst_p
     )
 
     ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0),
@@ -282,7 +288,9 @@ def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int)
         in_specs=(spec,) * 8,
         out_specs=(spec, spec, spec),
     )
-    return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+    # Positions only: meta_dirty=False passes the SAME buffers as previous
+    # and current meta (ShardedNeighborEngine.step_async).
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -304,7 +312,7 @@ def _jitted_sharded_step_pallas(
         # skip the vma check (outputs are explicitly per-shard here anyway).
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(0, 1, 2, 3))
+    return jax.jit(mapped, donate_argnums=(0,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -348,6 +356,13 @@ class ShardedPendingStep:
         self._out = out
         self._collected = False
         start_host_copy(out)
+
+    def is_ready(self) -> bool:
+        """Non-blocking readiness probe (parity with PendingStep)."""
+        try:
+            return bool(self._out.is_ready())
+        except AttributeError:
+            return True
 
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         assert not self._collected, "ShardedPendingStep already collected"
@@ -495,8 +510,10 @@ class ShardedNeighborEngine:
         active: np.ndarray,
         space: np.ndarray,
         radius: np.ndarray,
+        meta_dirty: bool = True,
     ) -> ShardedPendingStep:
-        """Dispatch one tick without blocking (parity with NeighborEngine)."""
+        """Dispatch one tick without blocking (parity with NeighborEngine,
+        including the ``meta_dirty=False`` upload-elision contract)."""
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
         if self.backend != "jnp":
@@ -505,12 +522,15 @@ class ShardedNeighborEngine:
         # np.array (copying, not asarray): state must not alias caller
         # buffers — see NeighborEngine.step_async. Numpy (not jnp) inputs by
         # design: see reset().
-        cur = (
-            put(np.array(pos, np.float32)),
-            put(np.array(active, bool)),
-            put(np.array(space, np.int32)),
-            put(np.array(radius, np.float32)),
-        )
+        if meta_dirty:
+            meta = (
+                put(np.array(active, bool)),
+                put(np.array(space, np.int32)),
+                put(np.array(radius, np.float32)),
+            )
+        else:
+            meta = self._state[1:4]
+        cur = (put(np.array(pos, np.float32)),) + meta
         if self.backend == "jnp":
             enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
             enter_ctx: tuple = (enter_ids,)

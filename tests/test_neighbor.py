@@ -413,6 +413,50 @@ def test_pallas_kernel_oracle_and_drops():
     assert saw_drop
 
 
+def test_pallas_drift_into_overflow_emits_leaves():
+    """Entities DRIFT (small per-tick displacement — the single-launch fast
+    path's territory) until one cell exceeds cell_capacity. The dropped
+    entity's neighbors must still receive their leave events, which only the
+    two-launch path can emit (the dropped entity is absent from the current
+    table entirely) — i.e. ``fast`` must be vetoed by ``dropped_c > 0``
+    (code-review r3 finding: teleport-based drop tests always forced the
+    slow path via the displacement guard, leaving this hole untested)."""
+    p = NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=4, grid_z=4,
+        space_slots=2, cell_capacity=8, max_events=8192,
+    )
+    e1 = NeighborEngine(p, backend="jnp")
+    e2 = NeighborEngine(p, backend="pallas_interpret")
+    e1.reset()
+    e2.reset()
+    rng = np.random.default_rng(11)
+    active = np.ones(64, bool)
+    space = np.zeros(64, np.int32)
+    radius = np.full(64, 60.0, np.float32)
+    # 12 entities ringed just outside one cell, drifting INTO it (cap 8);
+    # everyone else far away and static.
+    pos = np.full((64, 2), 350.0, np.float32)
+    pos[:12] = 50.0 + rng.uniform(-45.0, 45.0, (12, 2)).astype(np.float32)
+    pos[:12, 0] += 60.0  # start in the neighboring cell
+    cur = [set() for _ in range(64)]
+    saw_drop = False
+    for tick in range(16):
+        a1 = e1.step(pos, active, space, radius)
+        a2 = e2.step(pos, active, space, radius)
+        saw_drop |= a1[2] > 0
+        assert sorted(map(tuple, a1[0].tolist())) == sorted(map(tuple, a2[0].tolist())), f"tick {tick} enters"
+        assert sorted(map(tuple, a1[1].tolist())) == sorted(map(tuple, a2[1].tolist())), f"tick {tick} leaves"
+        assert a1[2] == a2[2], f"tick {tick} dropped"
+        apply_events(cur, a1[0], a1[1])
+        vis = _visible_mask(p, pos, active, space)
+        want = brute_force_sets(pos, vis, space, radius)
+        assert cur == want, f"tick {tick} interest sets"
+        # drift: ~8 units/tick toward the target cell — well under the
+        # fast-path displacement bound (cell 100, radius 60 -> D <= 20).
+        pos[:12, 0] -= 8.0
+    assert saw_drop, "scenario never overflowed the cell"
+
+
 def test_pallas_cell_capacity_cap():
     with pytest.raises(ValueError, match="cell_capacity"):
         NeighborEngine(
