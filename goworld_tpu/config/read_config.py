@@ -96,9 +96,19 @@ class AOIConfig:
     """TPU compute-plane knobs (no reference analog; see SURVEY.md §7)."""
 
     backend: str = "auto"  # auto | xzlist | tpu
+    # JAX platform for the batched engine: "auto" keeps jax's default
+    # (the TPU when one is attached). MUST be "cpu" for CPU-only deploys on
+    # TPU-image hosts: the TPU plugin ignores the JAX_PLATFORMS env var, so
+    # a game process would otherwise silently grab the chip (and on
+    # single-client transports, fight other processes for it).
+    platform: str = "auto"  # auto | cpu | tpu
     cell_capacity: int = 64
     max_entities: int = 16384  # padded capacity of the batched engine
     mesh_shards: int = 1  # entity-shard axis over devices
+    # Grid geometry (0 = derive from max_entities; see params_from_config).
+    grid: int = 0  # cells per side (grid_x = grid_z)
+    cell_size: float = 0.0  # cell side length; must be >= max AOI distance
+    space_slots: int = 0  # space-id folding slots
 
 
 @dataclasses.dataclass
@@ -238,10 +248,14 @@ def _load(path: Optional[str]) -> GoWorldConfig:
     if cp.has_section("aoi"):
         s = cp["aoi"]
         cfg.aoi = AOIConfig(
-            backend=s.get("backend", "auto"),
+            backend=s.get("backend", "auto").strip().lower(),
+            platform=s.get("platform", "auto").strip().lower(),
             cell_capacity=int(s.get("cell_capacity", 64)),
             max_entities=int(s.get("max_entities", 16384)),
             mesh_shards=int(s.get("mesh_shards", 1)),
+            grid=int(s.get("grid", 0)),
+            cell_size=float(s.get("cell_size", 0.0)),
+            space_slots=int(s.get("space_slots", 0)),
         )
     if cp.has_section("debug"):
         cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
@@ -252,6 +266,16 @@ def _load(path: Optional[str]) -> GoWorldConfig:
 
 def _validate(cfg: GoWorldConfig) -> None:
     """Sanity checks, mirroring read_config.go:538-661."""
+    if cfg.aoi.backend not in ("auto", "xzlist", "tpu"):
+        raise ValueError(
+            f"[aoi] backend must be auto|xzlist|tpu, got {cfg.aoi.backend!r}"
+        )
+    if cfg.aoi.platform not in ("auto", "cpu", "tpu"):
+        # A typo here would silently put a CPU-deploy game on the chip
+        # (GameService only acts on the exact value "cpu") — fail loudly.
+        raise ValueError(
+            f"[aoi] platform must be auto|cpu|tpu, got {cfg.aoi.platform!r}"
+        )
     if cfg.deployment.desired_dispatchers < 1:
         raise ValueError("deployment.dispatchers must be >= 1")
     if cfg.deployment.desired_games < 1:

@@ -467,6 +467,16 @@ class Entity:
         self.uninterest(other)
 
     def interest(self, other: "Entity") -> None:
+        # Idempotent by design: the batched AOI plane delivers diffs one
+        # tick late (aoi/batched.py), so edge races — an entity destroyed
+        # inside the window suppresses its enter but its leave still arrives
+        # next tick — are reconciled HERE, not in the engine. go-aoi fires
+        # exactly-once synchronously and needs no such guard
+        # (Entity.go:236-246); our pipelined model does: without it a
+        # client receives destroys for entities it never saw (found live by
+        # the strict bot fleet, round 3).
+        if other in self.interested_in:
+            return
         self.interested_in.add(other)
         other.interested_by.add(self)
         if self.client is not None:
@@ -475,6 +485,8 @@ class Entity:
             self.client.send_create_entity(other, is_player=False)
 
     def uninterest(self, other: "Entity") -> None:
+        if other not in self.interested_in:
+            return  # see interest(): leave may arrive without its enter
         self.interested_in.discard(other)
         other.interested_by.discard(self)
         if self.client is not None:

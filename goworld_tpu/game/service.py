@@ -56,6 +56,7 @@ class GameService:
         self._stop_event = asyncio.Event()
         self.exit_code: Optional[int] = None
         self._last_sync_collect = 0.0
+        self._last_aoi_tick = 0.0
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -83,6 +84,19 @@ class GameService:
             from goworld_tpu.entity.aoi.batched import params_from_config
 
             rt.aoi_params = params_from_config(self.cfg.aoi)
+        if rt.aoi_backend != "xzlist":
+            if self.cfg.aoi.platform == "cpu":
+                # Must happen before the first jax use: the TPU plugin
+                # ignores JAX_PLATFORMS, so only jax.config reliably keeps a
+                # CPU-deploy game process off the chip (read_config.py).
+                # ("tpu"/"auto" leave jax's default, which prefers the chip.)
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+            # Compile the engine BEFORE the ready barrier admits clients —
+            # the first dispatch otherwise freezes the loop for the whole
+            # jit compile (seconds) right as the first clients log in.
+            rt.get_aoi_service().warmup()
         if not storage.initialized():
             storage.initialize(self.cfg.storage)
         rt.storage = storage.SyncStorageAdapter()
@@ -177,7 +191,20 @@ class GameService:
                 pass
             rt.timer_service.tick()
             if rt.aoi_service is not None:
-                rt.aoi_service.tick()
+                # AOI rides the position-sync cadence (reference §3.3: AOI
+                # updates feed client create/destroy alongside position
+                # syncs), NOT the 5 ms loop tick — dispatching every loop
+                # iteration ran the device at 100% duty cycle and starved
+                # single-core hosts. wait=False: never stall the loop on
+                # device compute — frame-skip and let RPCs keep flowing.
+                now_aoi = time.monotonic()
+                if now_aoi - self._last_aoi_tick >= self.position_sync_interval:
+                    # Advance the cadence timer only on an actual dispatch:
+                    # a frame-skip (None) keeps probing every 5 ms loop
+                    # iteration so a step finishing just past the boundary
+                    # isn't penalized a whole extra interval.
+                    if rt.aoi_service.tick(wait=False) is not None:
+                        self._last_aoi_tick = now_aoi
             crontab.check()
             post.tick()
             now = time.monotonic()

@@ -167,3 +167,52 @@ def test_bot_army_with_hot_reload(cluster):
         if t != "DoSayInProfChannel"
     }
     assert not fatal_timeouts, text
+
+
+BATCHED_AOI_SECTION = """
+[aoi]
+backend = tpu
+platform = cpu
+max_entities = 2048
+"""
+
+
+@pytest.fixture
+def batched_cluster(tmp_path):
+    """Same deployment with the batched (TPU-plane) AOI backend on the CPU
+    jax backend — the configuration that flushed out the round-3 pipelined
+    delivery desyncs (duplicate create / destroy-of-unknown)."""
+    d = str(tmp_path)
+    ports = {
+        "disp1": free_port(), "disp2": free_port(),
+        "gate1": free_port(), "gate2": free_port(),
+    }
+    with open(os.path.join(d, "goworld.ini"), "w") as f:
+        f.write(INI.format(dir=d, **ports) + BATCHED_AOI_SECTION)
+    r = cli(d, "start", "examples.test_game")
+    assert r.returncode == 0, r.stdout + r.stderr
+    yield d, [("127.0.0.1", ports["gate1"]), ("127.0.0.1", ports["gate2"])]
+    cli(d, "kill", "examples.test_game")
+
+
+def test_bot_army_batched_aoi(batched_cluster):
+    """Strict bots over the batched AOI plane: AOI create/destroy streams to
+    clients must stay exactly consistent under migration and entity churn
+    despite the one-tick diff pipeline (idempotent interest guards +
+    synchronous severing at space-leave, entity.py / aoi/batched.py)."""
+    d, gates = batched_cluster
+    from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+    async def scenario():
+        return await run_fleet(
+            max(10, N_BOTS // 3), gates, max(30.0, DURATION / 2),
+            strict=True, seed=7, thing_timeout=15.0,
+        )
+
+    try:
+        report = asyncio.run(scenario())
+    except Exception:
+        _dump_cluster(d, "batched-aoi strict fleet failed")
+        raise
+    assert report["errors"] == [], report
+    print(format_report(report))
