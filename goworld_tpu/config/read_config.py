@@ -276,6 +276,21 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError(
             f"[aoi] platform must be auto|cpu|tpu, got {cfg.aoi.platform!r}"
         )
+    a = cfg.aoi
+    if a.max_entities < 8:
+        raise ValueError("[aoi] max_entities must be >= 8")
+    if not (1 <= a.cell_capacity <= 128):
+        raise ValueError("[aoi] cell_capacity must be in [1, 128]")
+    if a.mesh_shards < 1:
+        raise ValueError("[aoi] mesh_shards must be >= 1")
+    if a.grid != 0 and not (4 <= a.grid <= 512):
+        raise ValueError("[aoi] grid must be 0 (derive) or in [4, 512]")
+    if a.cell_size < 0.0:
+        # A negative cell size would bin every entity into garbage cells
+        # and silently return wrong neighbor sets.
+        raise ValueError("[aoi] cell_size must be >= 0 (0 = default)")
+    if a.space_slots < 0:
+        raise ValueError("[aoi] space_slots must be >= 0 (0 = default)")
     if cfg.deployment.desired_dispatchers < 1:
         raise ValueError("deployment.dispatchers must be >= 1")
     if cfg.deployment.desired_games < 1:

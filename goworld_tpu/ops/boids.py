@@ -122,16 +122,37 @@ def _build_cells(p: BoidsParams, pos, vel, active):
 
 
 def _boids_kernel(p: BoidsParams, cells_hbm, out_ref, scratch, sem):
-    """One program per grid cell: DMA the 3x3 halo block, steer its agents."""
+    """One program per grid cell: DMA the 3x3 halo block, steer its agents.
+
+    The halo DMA is double-buffered across grid steps (prefetch cell k+1
+    during cell k's math) — the same latency fix measured on the neighbor
+    kernel (ops/neighbor.py::_event_kernel)."""
     i = pl.program_id(0)
     j = pl.program_id(1)
-    dma = pltpu.make_async_copy(
-        cells_hbm.at[pl.ds(i, 3), pl.ds(j, 3)], scratch, sem
-    )
-    dma.start()
-    dma.wait()
+    gx = pl.num_programs(1)
+    lin = i * gx + j
+    total = pl.num_programs(0) * gx
+    slot = jax.lax.rem(lin, 2)
+    nslot = jax.lax.rem(lin + 1, 2)
 
-    c = scratch[:]  # [3, 3, F, LANES]
+    def halo_copy(idx_lin, buf):
+        return pltpu.make_async_copy(
+            cells_hbm.at[pl.ds(idx_lin // gx, 3),
+                         pl.ds(jax.lax.rem(idx_lin, gx), 3)],
+            scratch.at[buf],
+            sem.at[buf],
+        )
+
+    @pl.when(lin == 0)
+    def _():
+        halo_copy(lin, slot).start()
+
+    @pl.when(lin + 1 < total)
+    def _():
+        halo_copy(lin + 1, nslot).start()
+
+    halo_copy(lin, slot).wait()
+    c = scratch[slot]  # [3, 3, F, LANES]
     # Candidates: all 9 cells, feature-major [F, 9*LANES].
     cand = c.transpose(2, 0, 1, 3).reshape(_F, 9 * LANES)
     q = c[1, 1]  # center cell [F, LANES]
@@ -202,8 +223,8 @@ def _compiled_accel(p: BoidsParams, interpret: bool):
         ),
         out_shape=jax.ShapeDtypeStruct((p.grid_z, p.grid_x, 2, LANES), jnp.float32),
         scratch_shapes=[
-            pltpu.VMEM((3, 3, _F, LANES), jnp.float32),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((2, 3, 3, _F, LANES), jnp.float32),
+            pltpu.SemaphoreType.DMA((2,)),
         ],
         interpret=interpret,
     )

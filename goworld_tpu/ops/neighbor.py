@@ -792,10 +792,13 @@ class PendingStep:
 
             gwlog.warnf(
                 "AOI grid overflow: %d active entities exceeded cell_capacity"
-                "=%d and are invisible this tick; raise cell_capacity or "
-                "space_slots/grid size",
+                "=%d and are invisible this tick; raise cell_capacity, or "
+                "raise [aoi] grid/cell_size — the torus covers "
+                "grid*cell_size (%.0f) world units, and a wider map FOLDS "
+                "distant cells onto shared buckets",
                 dropped,
                 p.cell_capacity,
+                p.grid_x * p.cell_size,
             )
         return enters, leaves, dropped
 

@@ -679,3 +679,71 @@ def test_batched_aoi_destroy_in_window_no_client_desync():
     assert b.id not in rec.creates, "client saw a dead entity's create"
     assert b.id not in rec.destroys, "client got destroy for unknown entity"
     assert not a.is_interested_in(b)
+
+
+def test_batched_aoi_grow_reentrant_from_delivery_callback():
+    """An AOI delivery callback that spawns an entity at a tier boundary
+    triggers _grow RE-ENTRANTLY inside _deliver. The grow must not deliver
+    the in-flight step or recycle quarantined slots (the outer delivery's
+    remaining events still reference them); final interest sets must match
+    a fresh-engine ground truth (code-review r3 re-entrancy finding)."""
+    from goworld_tpu.entity.aoi import batched as batched_mod
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    em.runtime.aoi_backend = "batched"
+    em.runtime.aoi_params = NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=8, grid_z=8,
+        space_slots=4, cell_capacity=16, max_events=512,
+    )
+    orig_tier = batched_mod._MIN_TIER
+    batched_mod._MIN_TIER = 8
+    try:
+        sp = _setup_space()
+        spawned = []
+
+        class SpawnerAvatar(Avatar):
+            def on_enter_aoi(self, other):
+                super().on_enter_aoi(other)
+                # Spawn exactly once, from inside the delivery loop.
+                if not spawned:
+                    e = em.create_entity_locally("Avatar")
+                    spawned.append(e)
+                    sp._enter(e, Vector3(30.0, 0, 0))
+
+        em.register_entity(SpawnerAvatar)
+        # Fill the 8-slot tier exactly (spawner included), with a freed
+        # slot held in quarantine so the free list is empty at delivery.
+        victim = em.create_entity_locally("Avatar")
+        sp._enter(victim, Vector3(90.0, 0, 0))
+        others = []
+        for i in range(6):
+            e = em.create_entity_locally("Avatar")
+            sp._enter(e, Vector3(float(i * 5), 0, 0))
+            others.append(e)
+        spawner = em.create_entity_locally("SpawnerAvatar")
+        sp._enter(spawner, Vector3(20.0, 0, 0))
+        em.runtime.tick()  # dispatch #1 (sees 8 actives: tier full)
+        sp._leave(victim)  # quarantined; slot NOT yet recyclable
+        victim.destroy()
+        svc = em.runtime.aoi_service
+        assert svc.params.capacity == 8
+        # Tick #2: dispatches, then DELIVERS #1's enters — the spawner's
+        # callback spawns with the free list empty and the victim's slot
+        # quarantined: _grow runs re-entrantly inside _deliver.
+        em.runtime.tick()
+        assert svc.params.capacity > 8, "re-entrant grow did not trigger"
+        for _ in range(4):
+            em.runtime.tick()
+        assert spawned, "delivery callback never fired"
+        # Ground truth: every live pair within 100 units, same space.
+        live = others + [spawner] + spawned
+        for a in live:
+            expect = {
+                b for b in live
+                if b is not a
+                and (a.position - b.position).length() <= 100.0
+            }
+            assert set(a.interested_in) == expect, f"{a} interest diverged"
+            assert victim not in a.interested_in
+    finally:
+        batched_mod._MIN_TIER = orig_tier
