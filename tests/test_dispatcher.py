@@ -40,9 +40,12 @@ class FakePeer:
                 pass
 
 
-def make_game_cluster(addr, gameid, peer, entity_ids=()):
+def make_game_cluster(addr, gameid, peer, entity_ids=(),
+                      is_reconnect=False, is_restore=False):
     def handshake(proxy):
-        proxy.send_set_game_id(gameid, False, False, False, list(entity_ids))
+        proxy.send_set_game_id(
+            gameid, is_reconnect, is_restore, False, list(entity_ids)
+        )
 
     return ClusterClient([addr], handshake, peer.on_packet)
 
@@ -317,5 +320,89 @@ def test_unplanned_game_death_cleanup():
         await asyncio.sleep(0.1)
         assert not any(mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game2.received)
         await _teardown(disp, c2)
+
+    asyncio.run(run())
+
+
+def test_entity_pending_queue_bound_drops_overflow(monkeypatch):
+    """The per-entity pending queue is BOUNDED during a migrate window
+    (reference consts.go:32 caps it at 1000; DispatcherService.go:34-80):
+    overflow packets drop, and unblocking flushes exactly the buffered
+    prefix in order."""
+    from goworld_tpu import consts
+
+    monkeypatch.setattr(consts, "ENTITY_PENDING_PACKET_QUEUE_MAX_LEN", 5)
+
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        eid = gen_entity_id()
+        c1.select(0).send_notify_create_entity(eid)
+        # Open a migrate window: calls to eid now buffer (cap 5).
+        c1.select(0).send_migrate_request(eid, gen_entity_id(), 2)
+        await game1.expect(MsgType.MIGRATE_REQUEST_ACK)
+        for i in range(9):
+            c2.select(0).send_call_entity_method(eid, f"M{i}", ())
+        await asyncio.sleep(0.1)
+        assert not any(
+            mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game1.received
+        )
+        # Complete the migration to game 2: exactly the first 5 flush, in
+        # order; the overflow (M5..M8) was dropped at the bound.
+        c1.select(0).send_real_migrate(eid, 2, {"type": "T", "attrs": {}})
+        await game2.expect(MsgType.REAL_MIGRATE)
+        names = []
+        for _ in range(5):
+            pkt = await game2.expect(MsgType.CALL_ENTITY_METHOD)
+            assert pkt.read_entity_id() == eid
+            names.append(pkt.read_varstr())
+        assert names == [f"M{i}" for i in range(5)]
+        await asyncio.sleep(0.1)
+        assert not any(
+            mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game2.received
+        ), "overflow packets beyond the bound must be dropped"
+        await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_game_pending_queue_bound_while_frozen(monkeypatch):
+    """Packets for a FROZEN game buffer up to the per-game bound
+    (reference consts.go:30, 1e6) and the overflow drops; reconnecting
+    with -restore flushes the buffered prefix."""
+    from goworld_tpu import consts
+
+    monkeypatch.setattr(consts, "GAME_PENDING_PACKET_QUEUE_MAX_LEN", 4)
+
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        eid = gen_entity_id()
+        c1.select(0).send_notify_create_entity(eid)
+        # Freeze game 1, then sever its connection (reload window).
+        c1.select(0).send_start_freeze_game()
+        await game1.expect(MsgType.START_FREEZE_GAME_ACK)
+        await c1.stop()
+        await asyncio.sleep(0.1)
+        for i in range(7):
+            c2.select(0).send_call_entity_method(eid, f"F{i}", ())
+        await asyncio.sleep(0.1)
+        # Game 1 comes back with -restore and its entity list.
+        game1b = FakePeer()
+        c1b = make_game_cluster(
+            ("127.0.0.1", disp.port), 1, game1b, [eid],
+            is_reconnect=True, is_restore=True,
+        )
+        c1b.start()
+        await c1b.wait_connected()
+        names = []
+        for _ in range(4):
+            pkt = await game1b.expect(MsgType.CALL_ENTITY_METHOD)
+            assert pkt.read_entity_id() == eid
+            names.append(pkt.read_varstr())
+        assert names == [f"F{i}" for i in range(4)]
+        await asyncio.sleep(0.1)
+        assert not any(
+            mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game1b.received
+        ), "overflow past the frozen-game bound must be dropped"
+        await _teardown(disp, c1b, c2, cg)
 
     asyncio.run(run())

@@ -526,3 +526,45 @@ def test_pipelined_step_async_matches_sync(backend):
     e2, l2, _ = pending.collect()
     pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
     assert sync_stream == pipe_stream
+
+
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_meta_dirty_false_reuses_device_meta(backend):
+    """meta_dirty=False (positions-only upload) must produce the identical
+    event stream as full uploads while active/space/radius are unchanged —
+    and the engine state must keep the TRUE meta so a later dirty tick
+    diffs correctly."""
+    p = PALLAS_PARAMS
+    e1 = NeighborEngine(p, backend=backend)
+    e2 = NeighborEngine(p, backend=backend)
+    e1.reset()
+    e2.reset()
+    rng = np.random.default_rng(9)
+    n = p.capacity
+    pos = rng.uniform(0, 400, (n, 2)).astype(np.float32)
+    act = np.ones(n, bool)
+    act[n // 2:] = False
+    spc = (np.arange(n) % 2).astype(np.int32)
+    rad = np.full(n, 90.0, np.float32)
+
+    def canon(pairs):
+        return sorted(map(tuple, np.asarray(pairs).tolist()))
+
+    a1 = e1.step(pos, act, spc, rad)  # first tick uploads meta on both
+    a2 = e2.step(pos, act, spc, rad)
+    assert canon(a1[0]) == canon(a2[0])
+    for tick in range(3):
+        pos = np.clip(
+            pos + rng.normal(0, 15, pos.shape).astype(np.float32), 0, 400
+        ).astype(np.float32)
+        a1 = e1.step(pos, act, spc, rad)
+        a2 = e2.step_async(pos, act, spc, rad, meta_dirty=False).collect()
+        assert canon(a1[0]) == canon(a2[0]), f"tick {tick} enters"
+        assert canon(a1[1]) == canon(a2[1]), f"tick {tick} leaves"
+    # Now actually change meta (spawn the dormant half) — a dirty tick must
+    # pick it up and both engines agree again.
+    act[:] = True
+    a1 = e1.step(pos, act, spc, rad)
+    a2 = e2.step(pos, act, spc, rad)  # meta_dirty defaults True
+    assert canon(a1[0]) == canon(a2[0])
+    assert canon(a1[1]) == canon(a2[1])
