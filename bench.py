@@ -23,7 +23,8 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Env knobs: BENCH_MODE=aoi|boids|multispace|all (default all),
 BENCH_PLATFORM=cpu forces CPU (skips probe), BENCH_N / BENCH_STEPS scale the
-headline config, BENCH_TPU_PROBE_TIMEOUT / BENCH_TPU_PROBE_ATTEMPTS tune the
+headline config, BENCH_MAX_EVENTS sizes the inline event budget (drain work
+scales with it), BENCH_TPU_PROBE_TIMEOUT / BENCH_TPU_PROBE_ATTEMPTS tune the
 probe.
 """
 
@@ -150,7 +151,8 @@ def _resolve_platform(diag: dict) -> str:
 
 def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
               label: str = "aoi", cell_override: float | None = None,
-              grid_override: int | None = None) -> dict:
+              grid_override: int | None = None,
+              max_events_override: int | None = None) -> dict:
     """The production AOI loop (BatchAOIService path): pipelined step_async +
     single packed readback per tick. n_spaces>1 = BASELINE config 3 (batched
     cross-space AOI in one launch)."""
@@ -180,6 +182,13 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
         cell = cell_override
     if grid_override is not None:
         grid = grid_override
+    # Drain work scales with max_events (static shapes): ~126k events/tick
+    # at the headline config means 131072 per side is ~2x oversized; the
+    # knob lets the on-chip sweep find the knee (storms page correctly at
+    # any value).
+    max_events = max_events_override or int(
+        os.environ.get("BENCH_MAX_EVENTS", "131072")
+    )
     params = NeighborParams(
         capacity=n,
         cell_size=cell,
@@ -187,7 +196,7 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
         grid_z=grid,
         space_slots=space_slots,
         cell_capacity=cap,
-        max_events=131072,
+        max_events=max_events,
     )
     eng = NeighborEngine(params)
     eng.reset()
@@ -480,11 +489,26 @@ def main() -> int:
                         sweep[f"cell_{int(cell)}"] = {
                             "error": traceback.format_exc(limit=2).splitlines()[-1]
                         }
+                configs["cell_sweep"] = sweep
+                # Event-budget sweep: drain cost scales with max_events and
+                # the default is ~2x the steady-state volume (see the knob).
+                esweep = {}
+                for me in (65536, 98304):
+                    try:
+                        r = bench_aoi(label=f"me{me}", max_events_override=me)
+                        esweep[f"max_events_{me}"] = {
+                            "updates_per_sec": r["value"],
+                            "diff_latency_p99_ms": r["diff_latency_p99_ms"],
+                        }
+                    except Exception:
+                        esweep[f"max_events_{me}"] = {
+                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        }
                 if saved_steps is None:
                     os.environ.pop("BENCH_STEPS", None)
                 else:
                     os.environ["BENCH_STEPS"] = saved_steps
-                configs["cell_sweep"] = sweep
+                configs["events_sweep"] = esweep
             else:
                 # Pallas interpret mode at 50k agents takes hours on CPU —
                 # an explicit hardware-gated skip, not silent truncation.
