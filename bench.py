@@ -477,7 +477,8 @@ def main() -> int:
                 os.environ["BENCH_STEPS"] = os.environ.get(
                     "BENCH_SWEEP_STEPS", "12"
                 )
-                for cell, grid in ((100.0, 132), (150.0, 88), (300.0, 44)):
+                for cell, grid in ((100.0, 132), (150.0, 88), (300.0, 44),
+                                   (440.0, 30), (600.0, 22)):
                     try:
                         r = bench_aoi(label=f"cell{int(cell)}",
                                       cell_override=cell, grid_override=grid)

@@ -204,14 +204,28 @@ def test_bot_army_batched_aoi(batched_cluster):
     from goworld_tpu.client.bot_runner import format_report, run_fleet
 
     async def scenario():
-        return await run_fleet(
-            max(10, N_BOTS // 3), gates, max(30.0, DURATION / 2),
-            # 20 s budget, matching the reload gate above: a single-core
-            # host running the full deployment + fleet in-process sees
-            # multi-second tail latencies under external load (a prior CI
-            # stage's cleanup) with perfectly healthy server logs.
-            strict=True, seed=7, thing_timeout=20.0,
+        dur = max(40.0, DURATION / 2)
+        fleet = asyncio.create_task(
+            run_fleet(
+                max(10, N_BOTS // 3), gates, dur,
+                # 30 s budget: the reload gate's 20 s freeze-window budget
+                # plus the restored processes' engine recompile (the jit
+                # cache dies with the process; the persistent XLA cache is
+                # not used — its AOT artifacts warn about machine-feature
+                # mismatches on this host). Single-core tail latencies under
+                # external load also ride this (healthy server logs).
+                strict=True, seed=7, thing_timeout=30.0,
+            )
         )
+        # Hot reload mid-run: the freeze path must flush the in-flight AOI
+        # step (delivery barrier) before packing entities, and the restored
+        # game re-enters every entity into a FRESH engine (one enter storm,
+        # no duplicate interest) — under live strict bots.
+        await asyncio.sleep(dur / 2)
+        r = await asyncio.to_thread(cli, d, "reload", "examples.test_game")
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "reload complete" in r.stdout
+        return await fleet
 
     try:
         report = asyncio.run(scenario())
