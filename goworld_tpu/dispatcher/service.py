@@ -460,25 +460,30 @@ class DispatcherService:
     def _handle_query_space_gameid_for_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
         spaceid = packet.read_entity_id()
         eid = packet.read_entity_id()
+        nonce = packet.read_uint32()
         space_info = self.entities.get(spaceid)
         gameid = space_info.gameid if space_info is not None else 0
-        # Ack goes back to the entity's current game (the requester).
+        # Ack goes back to the entity's current game (the requester); the
+        # request nonce is echoed verbatim (proto/conn.py).
         p = Packet()
         p.append_entity_id(spaceid)
         p.append_entity_id(eid)
         p.append_uint16(gameid)
+        p.append_uint32(nonce)
         self._ack_requester(proxy, MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK, p)
 
     def _handle_migrate_request(self, proxy: GoWorldConnection, packet: Packet) -> None:
         eid = packet.read_entity_id()
         spaceid = packet.read_entity_id()
         space_gameid = packet.read_uint16()
+        nonce = packet.read_uint32()
         info = self._entity(eid)
         info.block(self._now(), consts.DISPATCHER_MIGRATE_TIMEOUT)
         p = Packet()
         p.append_entity_id(eid)
         p.append_entity_id(spaceid)
         p.append_uint16(space_gameid)
+        p.append_uint32(nonce)
         self._ack_requester(proxy, MsgType.MIGRATE_REQUEST_ACK, p)
 
     def _handle_real_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:

@@ -94,7 +94,8 @@ class Entity:
         self._sync_info_flag = 0
         self._syncing_from_client = False
         self._save_timer = None
-        self._enter_space_request: tuple | None = None  # (spaceid, pos, time)
+        self._enter_space_request: tuple | None = None  # (spaceid, pos, time, nonce)
+        self._enter_space_nonce = 0  # per-entity request sequence
 
     # --- identity ----------------------------------------------------------
 
@@ -547,8 +548,8 @@ class Entity:
             # not wedge the entity's space-hopping forever.
             from goworld_tpu import consts
 
-            _, _, t0 = self._enter_space_request
-            if entity_manager.runtime.now() - t0 <= consts.DISPATCHER_MIGRATE_TIMEOUT:
+            _, _, t0, _ = self._enter_space_request
+            if entity_manager.runtime.now() - t0 <= consts.ENTER_SPACE_REQUEST_TIMEOUT:
                 gwlog.errorf("%s: enter_space while another enter is pending", self)
                 return
             gwlog.warnf("%s: dropping expired enter-space request", self)
@@ -560,9 +561,13 @@ class Entity:
         # Cross-game: ask the dispatcher which game owns the space. Routed by
         # the SPACE id — its dispatch record lives on hash(spaceid)'s
         # dispatcher (reference SelectByEntityID(spaceID), Entity.go:1006-1012).
-        self._enter_space_request = (spaceid, pos, entity_manager.runtime.now())
+        self._enter_space_nonce += 1
+        nonce = self._enter_space_nonce
+        self._enter_space_request = (
+            spaceid, pos, entity_manager.runtime.now(), nonce
+        )
         dispatchercluster.select_by_entity_id(spaceid).send_query_space_gameid_for_migrate(
-            spaceid, self.id
+            spaceid, self.id, nonce
         )
 
     def _enter_local_space(self, space, pos: Vector3) -> None:
@@ -580,7 +585,7 @@ class Entity:
         self._enter_space_request = None
         dispatchercluster.select_by_entity_id(self.id).send_cancel_migrate(self.id)
 
-    def _enter_space_request_valid(self, spaceid: str) -> bool:
+    def _enter_space_request_valid(self, spaceid: str, nonce: int) -> bool:
         """Validity checks on migration acks (Entity.go:1026-1058): entity
         destroyed, request superseded, or request timed out → cancel."""
         from goworld_tpu import consts
@@ -589,10 +594,15 @@ class Entity:
         req = self._enter_space_request
         if req is None:
             return False
-        rspaceid, _, t0 = req
-        if rspaceid != spaceid:
+        rspaceid, _, t0, rnonce = req
+        if rspaceid != spaceid or rnonce != nonce:
             # Stale ack for a superseded request — ignore it; the current
-            # request stays live (reference returns on SpaceID mismatch).
+            # request stays live. The NONCE check matters even for the same
+            # space id: a buffered ack for an expired-and-canceled request
+            # must not drive a newer request into REAL_MIGRATE, because the
+            # cancel already released the dispatcher's block (the reference
+            # compares space ids only, but it also never replaces a pending
+            # request before the full migrate window elapses).
             return False
         if self._destroyed:
             self.cancel_enter_space()
@@ -603,12 +613,13 @@ class Entity:
             return False
         return True
 
-    def on_query_space_gameid_ack(self, spaceid: str, gameid: int) -> None:
+    def on_query_space_gameid_ack(self, spaceid: str, gameid: int,
+                                  nonce: int = 0) -> None:
         """Step 2 of cross-game EnterSpace (Entity.go:1026-1058): the
         dispatcher told us which game owns the target space."""
         from goworld_tpu.entity import entity_manager
 
-        if not self._enter_space_request_valid(spaceid):
+        if not self._enter_space_request_valid(spaceid, nonce):
             return
         if gameid == 0:
             gwlog.warnf("%s: space %s not found anywhere", self, spaceid)
@@ -621,22 +632,23 @@ class Entity:
                 gwlog.warnf("%s: space %s reported local but not found", self, spaceid)
                 self.cancel_enter_space()
                 return
-            _, pos, _ = self._enter_space_request
+            _, pos, _, _ = self._enter_space_request
             self._enter_space_request = None
             entity_manager.runtime.post(lambda: self._enter_local_space(space, pos))
             return
         dispatchercluster.select_by_entity_id(self.id).send_migrate_request(
-            self.id, spaceid, gameid
+            self.id, spaceid, gameid, nonce
         )
 
-    def on_migrate_request_ack(self, spaceid: str, space_gameid: int) -> None:
+    def on_migrate_request_ack(self, spaceid: str, space_gameid: int,
+                               nonce: int = 0) -> None:
         """Step 3: dispatcher blocked our RPC stream; pack and really migrate
         (Entity.go:1092-1101)."""
         from goworld_tpu.entity import entity_manager
 
-        if not self._enter_space_request_valid(spaceid):
+        if not self._enter_space_request_valid(spaceid, nonce):
             return
-        _, pos, _ = self._enter_space_request
+        _, pos, _, _ = self._enter_space_request
         self._enter_space_request = None
         data = self.get_migrate_data()
         # Rebuild into the *target* space at the requested position.

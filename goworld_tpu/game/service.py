@@ -277,16 +277,18 @@ class GameService:
             spaceid = packet.read_entity_id()
             eid = packet.read_entity_id()
             gameid = packet.read_uint16()
+            nonce = packet.read_uint32()
             e = entity_manager.get_entity(eid)
             if e is not None:
-                e.on_query_space_gameid_ack(spaceid, gameid)
+                e.on_query_space_gameid_ack(spaceid, gameid, nonce)
         elif msgtype == MsgType.MIGRATE_REQUEST_ACK:
             eid = packet.read_entity_id()
             spaceid = packet.read_entity_id()
             space_gameid = packet.read_uint16()
+            nonce = packet.read_uint32()
             e = entity_manager.get_entity(eid)
             if e is not None:
-                e.on_migrate_request_ack(spaceid, space_gameid)
+                e.on_migrate_request_ack(spaceid, space_gameid, nonce)
         elif msgtype == MsgType.REAL_MIGRATE:
             eid = packet.read_entity_id()
             packet.read_uint16()

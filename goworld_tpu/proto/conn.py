@@ -175,33 +175,49 @@ class GoWorldConnection:
 
     # --- migration (Entity.go:956-1115, DispatcherService.go:850-907) ------
 
-    def send_query_space_gameid_for_migrate(self, spaceid: str, eid: str) -> None:
+    # The migration query/request acks carry a per-request NONCE, echoed
+    # verbatim by the dispatcher: ack validity must bind to the exact
+    # request instance, not just the space id — a stale buffered ack for a
+    # canceled request must never satisfy a newer same-space request (its
+    # dispatcher block was released by the cancel).
+
+    def send_query_space_gameid_for_migrate(
+        self, spaceid: str, eid: str, nonce: int = 0
+    ) -> None:
         p = Packet()
         p.append_entity_id(spaceid)
         p.append_entity_id(eid)
+        p.append_uint32(nonce)
         self.send(MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE, p)
 
     def send_query_space_gameid_for_migrate_ack(
-        self, spaceid: str, eid: str, gameid: int
+        self, spaceid: str, eid: str, gameid: int, nonce: int = 0
     ) -> None:
         p = Packet()
         p.append_entity_id(spaceid)
         p.append_entity_id(eid)
         p.append_uint16(gameid)
+        p.append_uint32(nonce)
         self.send(MsgType.QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK, p)
 
-    def send_migrate_request(self, eid: str, spaceid: str, space_gameid: int) -> None:
+    def send_migrate_request(
+        self, eid: str, spaceid: str, space_gameid: int, nonce: int = 0
+    ) -> None:
         p = Packet()
         p.append_entity_id(eid)
         p.append_entity_id(spaceid)
         p.append_uint16(space_gameid)
+        p.append_uint32(nonce)
         self.send(MsgType.MIGRATE_REQUEST, p)
 
-    def send_migrate_request_ack(self, eid: str, spaceid: str, space_gameid: int) -> None:
+    def send_migrate_request_ack(
+        self, eid: str, spaceid: str, space_gameid: int, nonce: int = 0
+    ) -> None:
         p = Packet()
         p.append_entity_id(eid)
         p.append_entity_id(spaceid)
         p.append_uint16(space_gameid)
+        p.append_uint32(nonce)
         self.send(MsgType.MIGRATE_REQUEST_ACK, p)
 
     def send_real_migrate(self, eid: str, target_game: int, migrate_data: dict) -> None:

@@ -747,3 +747,68 @@ def test_batched_aoi_grow_reentrant_from_delivery_callback():
             assert victim not in a.interested_in
     finally:
         batched_mod._MIN_TIER = orig_tier
+
+
+def test_stale_migrate_ack_nonce_rejected(monkeypatch):
+    """A buffered MIGRATE_REQUEST_ACK for an expired-and-replaced request
+    must NOT drive the newer same-space request into REAL_MIGRATE: the
+    cancel already released the dispatcher's block, so migrating on the
+    stale ack would run unblocked (packets lost). Acks bind to the request
+    NONCE (code-review r3 finding on the 10 s expiry)."""
+    import goworld_tpu.dispatchercluster as dc
+    from goworld_tpu import consts
+
+    class Recorder:
+        def __init__(self):
+            self.calls = []
+
+        def __getattr__(self, name):
+            if name.startswith("send_"):
+                def rec(*a, **k):
+                    self.calls.append((name, a))
+                return rec
+            raise AttributeError(name)
+
+    class Cluster:
+        def __init__(self):
+            self.sender = Recorder()
+
+        def select(self, idx):
+            return self.sender
+
+        def select_by_entity_id(self, eid):
+            return self.sender
+
+        def count(self):
+            return 1
+
+    cluster = Cluster()
+    monkeypatch.setattr(dc, "select_by_entity_id", cluster.select_by_entity_id)
+    a = em.create_entity_locally("Avatar")
+    fake_now = [100.0]
+    monkeypatch.setattr(em.runtime.__class__, "now", lambda self: fake_now[0])
+
+    remote_space = "S" * 16
+    a.enter_space(remote_space, Vector3(1, 0, 0))
+    assert a._enter_space_request is not None
+    nonce1 = a._enter_space_request[3]
+
+    # The request's ack gets stuck in a freeze window; past the expiry the
+    # entity may issue a NEW enter for the same space.
+    fake_now[0] += consts.ENTER_SPACE_REQUEST_TIMEOUT + 1.0
+    a.enter_space(remote_space, Vector3(2, 0, 0))
+    nonce2 = a._enter_space_request[3]
+    assert nonce2 != nonce1
+
+    # The stale buffered ack arrives late: must be IGNORED outright.
+    a.on_migrate_request_ack(remote_space, 2, nonce1)
+    assert not a.is_destroyed(), "stale-nonce ack drove an unblocked migration"
+    assert a._enter_space_request is not None
+
+    # The CURRENT request's ack migrates normally.
+    a.on_query_space_gameid_ack(remote_space, 2, nonce2)
+    a.on_migrate_request_ack(remote_space, 2, nonce2)
+    assert a.is_destroyed()  # packed and gone (REAL_MIGRATE sent)
+    sends = [n for n, _ in cluster.sender.calls]
+    assert "send_real_migrate" in sends
+    assert sends.count("send_real_migrate") == 1
