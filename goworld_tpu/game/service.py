@@ -198,7 +198,15 @@ class GameService:
                 # single-core hosts. wait=False: never stall the loop on
                 # device compute — frame-skip and let RPCs keep flowing.
                 now_aoi = time.monotonic()
-                if now_aoi - self._last_aoi_tick >= self.position_sync_interval:
+                # Cadence stretches to 2x the measured step turnaround when
+                # compute exceeds the configured interval — caps engine
+                # duty at ~50% under overload instead of dispatching
+                # back-to-back (graceful degradation; batched.py).
+                cadence = max(
+                    self.position_sync_interval,
+                    2.0 * rt.aoi_service.last_step_duration,
+                )
+                if now_aoi - self._last_aoi_tick >= cadence:
                     # Advance the cadence timer only on an actual dispatch:
                     # a frame-skip (None) keeps probing every 5 ms loop
                     # iteration so a step finishing just past the boundary
