@@ -120,7 +120,11 @@ def _expect_marker(kind: str, name: str, server_module: str | None) -> str:
 # --- spawn + tag wait --------------------------------------------------------
 
 
-def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
+def _spawn_nowait(run_dir: str, name: str, argv: list[str]):
+    """Launch the process and return (proc, log offset) without waiting for
+    its supervisor tag — callers spawn a batch, then wait for every tag
+    (parallel restart halves a reload's client-visible freeze window: each
+    game is a fresh interpreter with seconds of import/warmup cost)."""
     log_path = _logfile(run_dir, name)
     logf = open(log_path, "ab")
     logf.write(f"\n--- spawn {time.strftime('%F %T')}: {' '.join(argv)}\n".encode())
@@ -134,6 +138,11 @@ def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
     start = _proc_starttime(proc.pid)
     with open(_pidfile(run_dir, name), "w") as f:
         f.write(str(proc.pid) if start is None else f"{proc.pid} {start}")
+    return proc, offset
+
+
+def _spawn(run_dir: str, name: str, argv: list[str], tag: str) -> None:
+    proc, offset = _spawn_nowait(run_dir, name, argv)
     _wait_tag(run_dir, name, tag, proc, offset)
 
 
@@ -294,13 +303,22 @@ def cmd_reload(args) -> int:
         print(f"  {name}: freezed")
     configfile = os.path.abspath(args.configfile) if args.configfile else ""
     cfg_argv = ["-configfile", configfile] if configfile else []
+    # Spawn ALL restores first, then wait for every tag: the restart cost
+    # (interpreter + imports + engine warmup, seconds per game) overlaps
+    # instead of serializing, shrinking the window clients must ride out.
+    # No truncation on reload: the pre-freeze log half is the forensic
+    # record of what led into the swap (_wait_tag scans from the new
+    # spawn marker, so stale tags can't satisfy the wait).
+    started = []
     for name, _, i in frozen:
-        # No truncation on reload: the pre-freeze log half is the forensic
-        # record of what led into the swap (_wait_tag scans from the new
-        # spawn marker, so stale tags can't satisfy the wait).
-        _spawn(run_dir, name,
-               [sys.executable, "-m", args.server_module, "-gid", str(i), "-restore"] + cfg_argv,
-               consts.GAME_STARTED_TAG)
+        proc, offset = _spawn_nowait(
+            run_dir, name,
+            [sys.executable, "-m", args.server_module, "-gid", str(i),
+             "-restore"] + cfg_argv,
+        )
+        started.append((name, proc, offset))
+    for name, proc, offset in started:
+        _wait_tag(run_dir, name, consts.GAME_STARTED_TAG, proc, offset)
     print("reload complete")
     return 0
 

@@ -255,7 +255,12 @@ class ClientBot:
             eid = packet.read_entity_id()
             e = self.entities.pop(eid, None)
             if e is None:
-                self.error(f"destroy of unknown entity {typename} {eid}")
+                # No-op by protocol contract: the reference client ignores
+                # destroys of unknown entities (ClientBot.go:474-480) — the
+                # server legitimately re-derives interest after a restore
+                # and timing windows can double-report.
+                gwlog.debugf("%s: destroy of unknown entity %s %s",
+                             self.name, typename, eid)
                 return
             e.destroyed = True
             if e.is_player and self.player is e:
@@ -308,11 +313,17 @@ class ClientBot:
         y = packet.read_float32()
         z = packet.read_float32()
         yaw = packet.read_float32()
-        if eid in self.entities:
-            # Player create may replace a mirror (GiveClientTo re-create).
+        if eid in self.entities and not is_player:
             old = self.entities[eid]
-            if not is_player and not old.is_player:
-                self.error(f"duplicate create of entity {eid}")
+            if not old.is_player:
+                # Idempotent by protocol contract: the reference server
+                # re-sends creates when AOI re-derives interest after a
+                # freeze/restore, and its client KEEPS the existing mirror
+                # untouched (ClientBot.go:459-471) — replacing it would
+                # orphan references scenario code still holds.
+                gwlog.debugf("%s: create for existing entity %s (kept)",
+                             self.name, eid)
+                return
         e = self.entity_class(self, eid, typename, is_player, attrs, x, y, z, yaw)
         self.entities[eid] = e
         if is_player:

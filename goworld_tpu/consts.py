@@ -27,14 +27,6 @@ DISPATCHER_MESSAGE_QUEUE_LEN = 10_000
 
 # --- timeouts ---------------------------------------------------------------
 DISPATCHER_MIGRATE_TIMEOUT = 60.0  # consts.go (1 min migrate window)
-# How long an entity's own enter-space request may pend before a NEW enter
-# may replace it. Deliberately much shorter than the dispatcher's 60 s
-# migrate window: the pre-REAL_MIGRATE phases (query/migrate-request acks)
-# are cancel-safe by protocol (CANCEL_MIGRATE unblocks, reference
-# Entity.go:1014-1023), and an ack lost to a freeze window must not wedge
-# the entity's space-hopping for a minute (seen live: reload-under-load
-# strict bots timing out on nil-space hops).
-ENTER_SPACE_REQUEST_TIMEOUT = 10.0
 DISPATCHER_LOAD_TIMEOUT = 60.0
 # Freeze buffering window (reference: 10 s, consts.go FREEZE_GAME_TIMEOUT).
 # A restarting game here is a fresh Python interpreter (~2-4 s import cost

@@ -542,17 +542,16 @@ class Entity:
         from goworld_tpu.entity import entity_manager
 
         if self._enter_space_request is not None:
-            # Pending requests expire by TIME, like the reference's
-            # isEnteringSpace (Entity.go:1000-1004): if an ack was lost (the
-            # requester's dispatcher link blipped), a dangling request must
-            # not wedge the entity's space-hopping forever.
-            from goworld_tpu import consts
-
-            _, _, t0, _ = self._enter_space_request
-            if entity_manager.runtime.now() - t0 <= consts.ENTER_SPACE_REQUEST_TIMEOUT:
-                gwlog.errorf("%s: enter_space while another enter is pending", self)
-                return
-            gwlog.warnf("%s: dropping expired enter-space request", self)
+            # The LATEST enter wins: cancel the pending request and proceed.
+            # The reference instead rejects while isEnteringSpace
+            # (Entity.go:1000-1004) — safe for it because its bots never
+            # race a reload — but an ack lost to a freeze window would then
+            # wedge the entity's space-hopping for the whole migrate
+            # window. Superseding is protocol-safe here: CANCEL_MIGRATE
+            # releases any dispatcher block, and the per-request NONCE
+            # guarantees the old request's late acks can't drive the new
+            # one into an unblocked migration.
+            gwlog.debugf("%s: enter_space supersedes a pending enter", self)
             self.cancel_enter_space()
         space = entity_manager.get_space(spaceid)
         if space is not None:

@@ -198,6 +198,11 @@ class GameService:
                 # single-core hosts. wait=False: never stall the loop on
                 # device compute — frame-skip and let RPCs keep flowing.
                 now_aoi = time.monotonic()
+                # Ungated readiness probe FIRST (every loop iteration): the
+                # turnaround sample must be independent of the cadence gate
+                # or the gate re-measures itself and doubles unbounded
+                # (poll_ready docstring).
+                rt.aoi_service.poll_ready()
                 # Cadence stretches to 2x the measured step turnaround when
                 # compute exceeds the configured interval — caps engine
                 # duty at ~50% under overload instead of dispatching

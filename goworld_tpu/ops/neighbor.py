@@ -140,6 +140,25 @@ def _bins(p: NeighborParams, pos: jax.Array, space: jax.Array):
     return cx, cz, sm
 
 
+def bins_reference(p: NeighborParams, pos: np.ndarray, space: np.ndarray):
+    """Numpy mirror of :func:`_bins` (same hash constants, same int32
+    wraparound) for host-side oracles — tests and the dryrun's engineered
+    drop-count formula use THIS so a change to the binning scheme has a
+    single source of truth."""
+    s32 = space.astype(np.int32)
+    with np.errstate(over="ignore"):
+        ox = (s32 * np.int32(-1640531527)) % np.int32(p.grid_x)
+        oz = (s32 * np.int32(40503)) % np.int32(p.grid_z)
+    cx = (
+        np.floor(pos[:, 0] / p.cell_size).astype(np.int32) % p.grid_x + ox
+    ) % p.grid_x
+    cz = (
+        np.floor(pos[:, 1] / p.cell_size).astype(np.int32) % p.grid_z + oz
+    ) % p.grid_z
+    sm = s32 % p.space_slots
+    return cx, cz, sm
+
+
 def _build_table(
     p: NeighborParams, bucket: jax.Array, active: jax.Array, stride: int
 ):

@@ -793,9 +793,10 @@ def test_stale_migrate_ack_nonce_rejected(monkeypatch):
     assert a._enter_space_request is not None
     nonce1 = a._enter_space_request[3]
 
-    # The request's ack gets stuck in a freeze window; past the expiry the
-    # entity may issue a NEW enter for the same space.
-    fake_now[0] += consts.ENTER_SPACE_REQUEST_TIMEOUT + 1.0
+    # The request's ack is stuck in a freeze window; a NEW enter for the
+    # same space SUPERSEDES it immediately (latest intent wins — safe
+    # because acks bind to the nonce).
+    fake_now[0] += 2.0
     a.enter_space(remote_space, Vector3(2, 0, 0))
     nonce2 = a._enter_space_request[3]
     assert nonce2 != nonce1

@@ -387,3 +387,48 @@ def test_nil_game_registers_and_boots(runtime):
     assert nil_space.is_nil()
     account = em.create_entity_locally("Account")
     assert account.typename == "Account"
+
+
+@pytest.fixture
+def unity_batched(runtime):
+    """unity_demo on the batched AOI plane: the chase/combat AI reads
+    interest sets that arrive one delivery tick late."""
+    from examples import unity_demo as ud
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    em.runtime.aoi_backend = "batched"
+    em.runtime.aoi_params = NeighborParams(
+        capacity=256, cell_size=600.0, grid_x=8, grid_z=8,
+        space_slots=4, cell_capacity=64, max_events=16384,
+    )
+    ud.register()
+    em.create_nil_space(1)
+    start_services(1)
+    pump(lambda: services_ready(["SpaceService"]))
+    yield ud
+
+
+def test_unity_monster_chase_batched(unity_batched):
+    """The monster AI (InterestedIn-driven chase → attack) works unchanged
+    over the pipelined interest stream — it just sees the player a tick or
+    two later than the synchronous xzlist manager would deliver."""
+    player = em.create_entity_locally("Player")
+    attach_client(player)
+    pump(lambda: player.space is not None and not player.space.is_nil())
+    monster = next(
+        e for e in player.space.entities if e.typename == "Monster"
+    )
+    player.set_position(monster.position + Vector3(20.0, 0.0, 0.0))
+    # Interest lands after the engine's dispatch+deliver pipeline.
+    pump(lambda: monster.is_interested_in(player))
+    monster.call_local("AI", ())
+    assert monster.moving_to is player
+    d0 = monster.distance_to(player)
+    monster.call_local("Tick", ())
+    assert monster.distance_to(player) < d0
+    player.set_position(monster.position + Vector3(1.0, 0.0, 0.0))
+    monster.call_local("AI", ())
+    assert monster.attacking is player
+    hp0 = player.attrs.get_int("hp")
+    monster.call_local("Tick", ())
+    assert player.attrs.get_int("hp") == hp0 - monster.DAMAGE

@@ -293,13 +293,10 @@ def test_drop_window_event_consistency():
 
 def _visible_mask(p, pos, active, space):
     """Replicates the engine's deterministic first-come-per-cell visibility
-    (including the per-space hash spreading, neighbor._bins)."""
-    s32 = space.astype(np.int32)
-    ox = (s32 * np.int32(-1640531527)) % np.int32(p.grid_x)
-    oz = (s32 * np.int32(40503)) % np.int32(p.grid_z)
-    cx = (np.floor(pos[:, 0] / p.cell_size).astype(np.int32) % p.grid_x + ox) % p.grid_x
-    cz = (np.floor(pos[:, 1] / p.cell_size).astype(np.int32) % p.grid_z + oz) % p.grid_z
-    sm = space % p.space_slots
+    (binning via the shared numpy mirror, neighbor.bins_reference)."""
+    from goworld_tpu.ops.neighbor import bins_reference
+
+    cx, cz, sm = bins_reference(p, pos, space)
     bucket = (sm * p.grid_z + cz) * p.grid_x + cx
     vis = np.zeros(len(pos), bool)
     counts: dict[int, int] = {}

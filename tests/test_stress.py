@@ -204,24 +204,27 @@ def test_bot_army_batched_aoi(batched_cluster):
     from goworld_tpu.client.bot_runner import format_report, run_fleet
 
     async def scenario():
-        dur = max(40.0, DURATION / 2)
+        dur = max(60.0, DURATION)
         fleet = asyncio.create_task(
             run_fleet(
                 max(10, N_BOTS // 3), gates, dur,
-                # 30 s budget: the reload gate's 20 s freeze-window budget
-                # plus the restored processes' engine recompile (the jit
-                # cache dies with the process; the persistent XLA cache is
-                # not used — its AOT artifacts warn about machine-feature
-                # mismatches on this host). Single-core tail latencies under
-                # external load also ride this (healthy server logs).
-                strict=True, seed=7, thing_timeout=30.0,
+                # 40 s budget: the measured client-visible reload window on
+                # this single-core host is ~15-19 s for BATCHED games (each
+                # restore is a fresh interpreter + jax import + engine
+                # warmup; parallel spawning can't overlap CPU on one core,
+                # and the persistent XLA cache is rejected — its AOT
+                # artifacts warn of machine-feature mismatches). A scenario
+                # straddling the window needs the window plus service
+                # re-claims plus a retry cycle.
+                strict=True, seed=7, thing_timeout=40.0,
             )
         )
         # Hot reload mid-run: the freeze path must flush the in-flight AOI
         # step (delivery barrier) before packing entities, and the restored
         # game re-enters every entity into a FRESH engine (one enter storm,
-        # no duplicate interest) — under live strict bots.
-        await asyncio.sleep(dur / 2)
+        # no duplicate interest) — under live strict bots. Placed at 25 s so
+        # ~15+ s of post-window runway still exercises the restored plane.
+        await asyncio.sleep(25.0)
         r = await asyncio.to_thread(cli, d, "reload", "examples.test_game")
         assert r.returncode == 0, r.stdout + r.stderr
         assert "reload complete" in r.stdout
