@@ -269,14 +269,41 @@ def _step_jnp(
     vp_on_c = _epoch_mask(p, cand_c, q_ids, ppos, av_p, pspc, prad, ppos, av_p, pspc)
     enter_mask = vc & ~vp_on_c
 
-    # Leave pass: candidates from the previous grid.
-    cand_p = _gather_cands(p, table_p, cxp, czp, smp)
-    vp = _epoch_mask(p, cand_p, q_ids, ppos, av_p, pspc, prad, ppos, av_p, pspc)
-    vc_on_p = _epoch_mask(p, cand_p, q_ids, pos, av_c, spc, rad, pos, av_c, spc)
-    leave_mask = vp & ~vc_on_p
+    # Single-pass fast path (same geometry argument as _step_pallas): when
+    # no entity deactivated, changed space, was capacity-dropped, or moved
+    # more than (cell_size − r_prev)/2, every previously-valid pair sits in
+    # the CURRENT grid's 3x3 halo — so the leave mask is just
+    # vp_on_c & ~vc over cand_c, both already computed. Other ticks pay the
+    # second gather + epoch-mask pair on the previous grid.
+    both = pact & act
+    deact = jnp.any(pact & ~act)
+    spchg = jnp.any(both & (pspc != spc))
+    disp = jnp.sqrt(
+        jnp.max(jnp.where(both, jnp.sum((pos - ppos) ** 2, axis=1), 0.0))
+    )
+    prad_max = jnp.max(jnp.where(pact, prad, 0.0))
+    fast = (
+        (~deact)
+        & (~spchg)
+        & (dropped_c == 0)
+        & (2.0 * disp + prad_max <= p.cell_size)
+    )
+
+    def fast_fn():
+        return vp_on_c & ~vc, cand_c
+
+    def slow_fn():
+        cand_p = _gather_cands(p, table_p, cxp, czp, smp)
+        vp = _epoch_mask(p, cand_p, q_ids, ppos, av_p, pspc, prad,
+                         ppos, av_p, pspc)
+        vc_on_p = _epoch_mask(p, cand_p, q_ids, pos, av_c, spc, rad,
+                              pos, av_c, spc)
+        return vp & ~vc_on_p, cand_p
+
+    leave_mask, cand_l = jax.lax.cond(fast, fast_fn, slow_fn)
 
     enter_ids = jnp.where(enter_mask, cand_c, n)
-    leave_ids = jnp.where(leave_mask, cand_p, n)
+    leave_ids = jnp.where(leave_mask, cand_l, n)
     n_enters = jnp.sum(enter_mask).astype(jnp.int32)
     n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
     return enter_ids, leave_ids, n_enters, n_leaves, dropped_c
