@@ -190,6 +190,29 @@ def _build_table(
     return table, slot, dropped, order, dst
 
 
+def _fast_guard(p: NeighborParams, ppos, pact, pspc, prad, pos, act, spc,
+                dropped_c):
+    """Single-pass eligibility: True when every pair valid in EITHER epoch
+    provably sits inside the CURRENT grid's 3x3 halo — no entity
+    deactivated, changed space, was capacity-dropped this tick, or moved
+    more than (cell_size − r_prev)/2 (two points in cells ≥ 2 apart are
+    > cell_size apart, and dist_now(a,b) ≤ r_prev + 2·max_disp for any
+    previously-valid pair). Shared by the jnp, pallas and sharded steps."""
+    both = pact & act
+    deact = jnp.any(pact & ~act)
+    spchg = jnp.any(both & (pspc != spc))
+    disp = jnp.sqrt(
+        jnp.max(jnp.where(both, jnp.sum((pos - ppos) ** 2, axis=1), 0.0))
+    )
+    prad_max = jnp.max(jnp.where(pact, prad, 0.0))
+    return (
+        (~deact)
+        & (~spchg)
+        & (dropped_c == 0)
+        & (2.0 * disp + prad_max <= p.cell_size)
+    )
+
+
 def _pair_valid(
     q_av, q_space, q_r2, q_x, q_z, c_av, c_space, c_x, c_z, not_self
 ):
@@ -269,25 +292,10 @@ def _step_jnp(
     vp_on_c = _epoch_mask(p, cand_c, q_ids, ppos, av_p, pspc, prad, ppos, av_p, pspc)
     enter_mask = vc & ~vp_on_c
 
-    # Single-pass fast path (same geometry argument as _step_pallas): when
-    # no entity deactivated, changed space, was capacity-dropped, or moved
-    # more than (cell_size − r_prev)/2, every previously-valid pair sits in
-    # the CURRENT grid's 3x3 halo — so the leave mask is just
+    # Single-pass fast path (_fast_guard): the leave mask is just
     # vp_on_c & ~vc over cand_c, both already computed. Other ticks pay the
     # second gather + epoch-mask pair on the previous grid.
-    both = pact & act
-    deact = jnp.any(pact & ~act)
-    spchg = jnp.any(both & (pspc != spc))
-    disp = jnp.sqrt(
-        jnp.max(jnp.where(both, jnp.sum((pos - ppos) ** 2, axis=1), 0.0))
-    )
-    prad_max = jnp.max(jnp.where(pact, prad, 0.0))
-    fast = (
-        (~deact)
-        & (~spchg)
-        & (dropped_c == 0)
-        & (2.0 * disp + prad_max <= p.cell_size)
-    )
+    fast = _fast_guard(p, ppos, pact, pspc, prad, pos, act, spc, dropped_c)
 
     def fast_fn():
         return vp_on_c & ~vc, cand_c
@@ -665,23 +673,11 @@ def _step_pallas(
     prev_feats = (xs_p, ppos[:, 1], pspc, prad)
     cells_c = _scatter_feats(p, dst_c, order_c, cur_feats, prev_feats)
 
-    both = pact & act
-    deact = jnp.any(pact & ~act)
-    spchg = jnp.any(both & (pspc != spc))
-    disp = jnp.sqrt(
-        jnp.max(jnp.where(both, jnp.sum((pos - ppos) ** 2, axis=1), 0.0))
-    )
-    prad_max = jnp.max(jnp.where(pact, prad, 0.0))
     # dropped_c == 0 is required: a capacity-dropped entity is absent from
     # table_c entirely, so the single-launch path could never see its
     # epoch-B pairs — its neighbors' leave events must come from the
     # previous grid, where it is still tabled (code-review r3 finding).
-    fast = (
-        (~deact)
-        & (~spchg)
-        & (dropped_c == 0)
-        & (2.0 * disp + prad_max <= p.cell_size)
-    )
+    fast = _fast_guard(p, ppos, pact, pspc, prad, pos, act, spc, dropped_c)
 
     w_words = 9 * LANES // _PACK
 

@@ -41,6 +41,7 @@ from goworld_tpu.ops.neighbor import (
     NeighborParams,
     _bins,
     _build_table,
+    _fast_guard,
     _compiled_event_kernel,
     _drain_bits,
     _drain_ids,
@@ -123,16 +124,26 @@ def _sharded_step(
                           sl(prad), ppos, av_p, pspc)
     enter_mask = vc & ~vp_on_c
 
-    # Leave pass: candidates from the previous grid.
-    cand_p = _gather_cands(p, table_p, sl(cxp), sl(czp), sl(smp))
-    vp = _epoch_mask(p, cand_p, q_ids, sl2(ppos), sl(av_p), sl(pspc), sl(prad),
-                     ppos, av_p, pspc)
-    vc_on_p = _epoch_mask(p, cand_p, q_ids, sl2(pos), sl(av_c), sl(spc),
-                          sl(rad), pos, av_c, spc)
-    leave_mask = vp & ~vc_on_p
+    # Leave pass: single-pass fast path when the displacement guard holds
+    # (ops/neighbor._step_jnp — the guard's inputs are replicated after the
+    # all-gather, so the cond resolves identically on every shard).
+    fast = _fast_guard(p, ppos, pact, pspc, prad, pos, act, spc, dropped_c)
+
+    def fast_fn():
+        return vp_on_c & ~vc, cand_c
+
+    def slow_fn():
+        cand_p = _gather_cands(p, table_p, sl(cxp), sl(czp), sl(smp))
+        vp = _epoch_mask(p, cand_p, q_ids, sl2(ppos), sl(av_p), sl(pspc),
+                         sl(prad), ppos, av_p, pspc)
+        vc_on_p = _epoch_mask(p, cand_p, q_ids, sl2(pos), sl(av_c), sl(spc),
+                              sl(rad), pos, av_c, spc)
+        return vp & ~vc_on_p, cand_p
+
+    leave_mask, cand_l = jax.lax.cond(fast, fast_fn, slow_fn)
 
     enter_ids = jnp.where(enter_mask, cand_c, n)
-    leave_ids = jnp.where(leave_mask, cand_p, n)
+    leave_ids = jnp.where(leave_mask, cand_l, n)
     n_enters = jnp.sum(enter_mask).astype(jnp.int32)
     n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
 
@@ -178,6 +189,7 @@ def _sharded_step_pallas(
     lo = shard * rows
     w_words = 9 * LANES // _PACK
     kernel = _compiled_event_kernel(p, interpret, rows)
+    kernel_dual = _compiled_event_kernel(p, interpret, rows, dual=True)
 
     gather = lambda x: jax.lax.all_gather(x, SHARD_AXIS, tiled=True)  # noqa: E731
     pos, act, spc, rad = gather(pos_l), gather(act_l), gather(spc_l), gather(rad_l)
@@ -207,34 +219,49 @@ def _sharded_step_pallas(
     cur_feats = (xs_c, pos[:, 1], spc, rad)
     prev_feats = (xs_p, ppos[:, 1], pspc, prad)
 
-    def one_pass(feats_a, feats_b, cx, cz, sm, slot, order, dst):
-        """Events for pairs valid under epoch A but not epoch B, binned by
-        epoch A's grid (ops/neighbor._step_pallas, slab-sharded)."""
-        cells = _scatter_feats(p, dst, order, feats_a, feats_b)
-        slab = jax.lax.dynamic_slice_in_dim(cells, lo, rows + 2, axis=1)
-        packed_cells = kernel(slab)  # [S, rows, gx, LANES, W]
+    cells_c = _scatter_feats(p, dst_c, order_c, cur_feats, prev_feats)
+    slab_c = jax.lax.dynamic_slice_in_dim(cells_c, lo, rows + 2, axis=1)
 
-        # Per-entity packed words for entities binned in THIS slab.
+    # Single-launch fast path (ops/neighbor._step_pallas): the guard's
+    # inputs are replicated after the all-gather, so the cond resolves
+    # identically on every shard. Fast ticks run ONE dual-output kernel on
+    # the current grid's slab; other ticks pay the second feats+kernel pass
+    # on the previous grid.
+    fast = _fast_guard(p, ppos, pact, pspc, prad, pos, act, spc, dropped_c)
+
+    def fast_fn():
+        pk2 = kernel_dual(slab_c)  # [S, rows, gx, LANES, 2W]
+        return (pk2[..., :w_words], pk2[..., w_words:],
+                cxc, czc, smc, table_c, slot_c)
+
+    def slow_fn():
+        pk_e = kernel(slab_c)
+        cells_p = _scatter_feats(p, dst_p, order_p, prev_feats, cur_feats)
+        slab_p = jax.lax.dynamic_slice_in_dim(cells_p, lo, rows + 2, axis=1)
+        pk_l = kernel(slab_p)
+        return (pk_e, pk_l, cxp, czp, smp, table_p, slot_p)
+
+    pk_e, pk_l, lcx, lcz, lsm, ltable, lslot = jax.lax.cond(
+        fast, fast_fn, slow_fn
+    )
+
+    def extract(packed_cells, cx, cz, sm, slot):
+        """Per-entity packed words for entities binned in THIS slab."""
         lane = slot % LANES
         local_bucket = (sm * rows + (cz - lo)) * p.grid_x + cx
         local_flat = local_bucket * LANES + lane
         mine = (slot >= 0) & (cz >= lo) & (cz < lo + rows)
         flat = packed_cells.reshape(-1, w_words)
         safe = jnp.clip(local_flat, 0, flat.shape[0] - 1)
-        packed_e = jnp.where(mine[:, None], flat[safe], 0)  # i32[N, W]
-        count = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
-        return packed_e, count
+        pe = jnp.where(mine[:, None], flat[safe], 0)  # i32[N, W]
+        return pe, jnp.sum(jax.lax.population_count(pe)).astype(jnp.int32)
 
-    packed_e, n_enters = one_pass(
-        cur_feats, prev_feats, cxc, czc, smc, slot_c, order_c, dst_c
-    )
-    packed_l, n_leaves = one_pass(
-        prev_feats, cur_feats, cxp, czp, smp, slot_p, order_p, dst_p
-    )
+    packed_e, n_enters = extract(pk_e, cxc, czc, smc, slot_c)
+    packed_l, n_leaves = extract(pk_l, lcx, lcz, lsm, lslot)
 
     ep, _ = _drain_bits(p, packed_e, cxc, czc, smc, table_c, jnp.int32(0),
                         max_events=events_inline)
-    lp, _ = _drain_bits(p, packed_l, cxp, czp, smp, table_p, jnp.int32(0),
+    lp, _ = _drain_bits(p, packed_l, lcx, lcz, lsm, ltable, jnp.int32(0),
                         max_events=events_inline)
     zero = jnp.int32(0)
     header = jnp.stack(
@@ -246,7 +273,7 @@ def _sharded_step_pallas(
     ).astype(jnp.int32)
     out = jnp.concatenate([header, ep, lp], axis=0)
     enter_ctx = (packed_e, cxc, czc, smc, table_c)
-    leave_ctx = (packed_l, cxp, czp, smp, table_p)
+    leave_ctx = (packed_l, lcx, lcz, lsm, ltable)
     return enter_ctx + leave_ctx + (out,)
 
 
