@@ -813,3 +813,40 @@ def test_stale_migrate_ack_nonce_rejected(monkeypatch):
     sends = [n for n, _ in cluster.sender.calls]
     assert "send_real_migrate" in sends
     assert sends.count("send_real_migrate") == 1
+
+
+def test_attr_tree_fuzz_roundtrip_and_migration():
+    """Randomized attr trees (the reference has no fuzzing, SURVEY §4.2):
+    random nested assign/set/list ops, then to_dict → assign round-trip
+    must reproduce the tree exactly — the same path migrate/freeze data
+    takes (get_migrate_data packs attrs.to_dict)."""
+    import random
+
+    rng = random.Random(99)
+
+    def rand_value(depth):
+        r = rng.random()
+        if depth < 2 and r < 0.25:
+            return {
+                f"k{rng.randint(0, 5)}": rand_value(depth + 1)
+                for _ in range(rng.randint(0, 4))
+            }
+        if depth < 2 and r < 0.45:
+            return [rand_value(depth + 1) for _ in range(rng.randint(0, 4))]
+        return rng.choice([
+            True, False, rng.randint(-2**50, 2**50),
+            rng.uniform(-1e12, 1e12), "", "héllo中", None,
+        ])
+
+    for trial in range(60):
+        root = MapAttr()
+        for _ in range(rng.randint(1, 10)):
+            root.set(f"key{rng.randint(0, 7)}", rand_value(0))
+        snapshot = root.to_dict()
+        rebuilt = MapAttr()
+        rebuilt.assign(snapshot)
+        assert rebuilt.to_dict() == snapshot, f"trial {trial} diverged"
+        # And a second generation (migrate → migrate) stays stable.
+        again = MapAttr()
+        again.assign(rebuilt.to_dict())
+        assert again.to_dict() == snapshot
