@@ -468,7 +468,21 @@ def main() -> int:
                 configs["multispace_32"] = {
                     "error": traceback.format_exc(limit=2).splitlines()[-1]
                 }
+            configs["unity_200"] = {
+                "covered_by": "tests/test_examples.py unity_demo suite "
+                              "(functional parity, CPU xzlist + batched)"
+            }
             if platform == "tpu":
+                try:
+                    # BASELINE config 2: 10k random-walk entities, one chip
+                    # (oracle correctness lives in tests/test_tpu_smoke.py).
+                    configs["synthetic_10k"] = bench_aoi(
+                        n=10240, label="aoi_10k"
+                    )
+                except Exception:
+                    configs["synthetic_10k"] = {
+                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                    }
                 try:
                     configs["boids_50k"] = bench_boids()
                 except Exception:
