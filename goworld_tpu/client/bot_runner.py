@@ -22,7 +22,6 @@ import time
 from typing import Optional
 
 from goworld_tpu.client.client import ClientBot, StrictError
-from goworld_tpu.utils import gwlog
 
 THING_TIMEOUT = 5.0
 

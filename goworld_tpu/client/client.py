@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import asyncio
 import ssl
-import time
 from typing import Callable, Optional
 
 from goworld_tpu.netutil.packet import Packet

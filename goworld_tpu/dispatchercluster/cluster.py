@@ -15,7 +15,7 @@ reconnect handshake).
 from __future__ import annotations
 
 import asyncio
-from typing import Awaitable, Callable, Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 from goworld_tpu import consts
 from goworld_tpu.dispatchercluster import DispatcherClusterBase, _NULL_SENDER

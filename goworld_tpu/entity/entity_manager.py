@@ -15,7 +15,7 @@ how reference entity tests run without a dispatcher (SURVEY.md §4.1).
 from __future__ import annotations
 
 import time
-from typing import Any, Optional, Type
+from typing import Optional, Type
 
 from goworld_tpu import consts, dispatchercluster
 from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
