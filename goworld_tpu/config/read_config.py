@@ -80,6 +80,9 @@ class StorageConfig:
     directory: str = "_entity_storage"  # filesystem backend
     url: str = ""  # network backends
     db: str = "goworld"
+    # redis_cluster seed nodes, from ``start_nodes_N = host:port`` keys
+    # (reference read_config.go:492-493).
+    start_nodes: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -89,6 +92,7 @@ class KVDBConfig:
     url: str = ""
     db: str = "goworld"
     collection: str = "kvdb"
+    start_nodes: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -160,6 +164,19 @@ def reload() -> GoWorldConfig:
     with _lock:
         _config = _load(_config_file)
         return _config
+
+
+def _read_start_nodes(section) -> list:
+    """``start_nodes_1 = host:port`` etc, sorted by numeric suffix for
+    determinism (reference read_config.go:492-493 collects them into a
+    StringSet; non-numeric suffixes sort after, lexicographically)."""
+    nodes = []
+    for name in section:
+        if name.startswith("start_nodes_") and section[name].strip():
+            suffix = name[len("start_nodes_"):]
+            key = (0, int(suffix), "") if suffix.isdigit() else (1, 0, suffix)
+            nodes.append((key, section[name].strip()))
+    return [v for _, v in sorted(nodes)]
 
 
 def _load(path: Optional[str]) -> GoWorldConfig:
@@ -235,6 +252,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             directory=s.get("directory", "_entity_storage"),
             url=s.get("url", ""),
             db=s.get("db", "goworld"),
+            start_nodes=_read_start_nodes(s),
         )
     if cp.has_section("kvdb"):
         s = cp["kvdb"]
@@ -244,6 +262,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             url=s.get("url", ""),
             db=s.get("db", "goworld"),
             collection=s.get("collection", "kvdb"),
+            start_nodes=_read_start_nodes(s),
         )
     if cp.has_section("aoi"):
         s = cp["aoi"]
@@ -291,6 +310,12 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[aoi] cell_size must be >= 0 (0 = default)")
     if a.space_slots < 0:
         raise ValueError("[aoi] space_slots must be >= 0 (0 = default)")
+    for section, c in (("storage", cfg.storage), ("kvdb", cfg.kvdb)):
+        if c.type == "redis_cluster" and not c.start_nodes:
+            # read_config.go:555-556,617-619: fatal without seed nodes.
+            raise ValueError(
+                f"must have at least 1 start_nodes for [{section}].redis_cluster"
+            )
     if cfg.deployment.desired_dispatchers < 1:
         raise ValueError("deployment.dispatchers must be >= 1")
     if cfg.deployment.desired_games < 1:

@@ -31,7 +31,7 @@ from goworld_tpu.dispatcher.lbc import LBCHeap
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
-from goworld_tpu.proto.msgtypes import MsgType, is_gate_redirect
+from goworld_tpu.proto.msgtypes import PROTO_VERSION, MsgType, is_gate_redirect
 from goworld_tpu.utils import gwlog
 
 
@@ -282,12 +282,33 @@ class DispatcherService:
 
     # --- handshakes ----------------------------------------------------------
 
+    def _check_proto_version(
+        self, proxy: GoWorldConnection, packet: Packet, peer: str
+    ) -> bool:
+        """Reject a handshake whose PROTO_VERSION trailer is absent or
+        different — a mixed-version pair would otherwise mis-frame packets
+        whose layouts changed (e.g. the migrate-nonce fields) and fail far
+        from the cause (ADVICE r3). Pre-version peers send no trailer."""
+        ver = packet.read_uint32() if packet.unread_len() >= 4 else 0
+        if ver == PROTO_VERSION:
+            return True
+        gwlog.errorf(
+            "dispatcher %d: %s speaks protocol version %d, this dispatcher "
+            "speaks %d — deploy dispatchers and games/gates in lockstep "
+            "(restart the cluster with one build); closing the connection",
+            self.dispid, peer, ver, PROTO_VERSION,
+        )
+        proxy.close()
+        return False
+
     def _handle_set_game_id(self, proxy: GoWorldConnection, packet: Packet) -> None:
         gameid = packet.read_uint16()
         is_reconnect = packet.read_bool()
         is_restore = packet.read_bool()
         is_ban_boot = packet.read_bool()
         entity_ids = packet.read_data()
+        if not self._check_proto_version(proxy, packet, f"game {gameid}"):
+            return
         gi = self._game(gameid)
         gi.proxy = proxy
         gi.is_banned_boot = is_ban_boot
@@ -331,6 +352,8 @@ class DispatcherService:
 
     def _handle_set_gate_id(self, proxy: GoWorldConnection, packet: Packet) -> None:
         gateid = packet.read_uint16()
+        if not self._check_proto_version(proxy, packet, f"gate {gateid}"):
+            return
         self.gates[gateid] = proxy
         self._proxy_gates[proxy] = gateid
         self._check_deployment_ready()

@@ -57,6 +57,7 @@ class GameService:
         self.exit_code: Optional[int] = None
         self._last_sync_collect = 0.0
         self._last_aoi_tick = 0.0
+        self._aoi_wedge_warned = False
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -218,6 +219,21 @@ class GameService:
                     # isn't penalized a whole extra interval.
                     if rt.aoi_service.tick(wait=False) is not None:
                         self._last_aoi_tick = now_aoi
+                        self._aoi_wedge_warned = False
+                # Watchdog: a step that never becomes ready (wedged device)
+                # would frame-skip forever with AOI silently dead while RPCs
+                # keep flowing (ADVICE r3). Warn once per incident at 10x
+                # the cadence (generous: covers jit recompiles on growth).
+                age = rt.aoi_service.in_flight_age()
+                if age > max(10.0 * cadence, 30.0):
+                    if not self._aoi_wedge_warned:
+                        self._aoi_wedge_warned = True
+                        gwlog.errorf(
+                            "game %d: in-flight AOI step not ready after "
+                            "%.1f s (cadence %.3f s) — device wedged? AOI "
+                            "delivery is stalled; RPCs keep running",
+                            self.gameid, age, cadence,
+                        )
             crontab.check()
             post.tick()
             now = time.monotonic()

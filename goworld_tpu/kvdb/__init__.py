@@ -37,6 +37,10 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.kvdb.redis import RedisKVDB
 
         return RedisKVDB(cfg.url)
+    if kind == "redis_cluster":
+        from goworld_tpu.kvdb.redis_cluster import RedisClusterKVDB
+
+        return RedisClusterKVDB(list(cfg.start_nodes))
     if kind == "mongodb":
         from goworld_tpu.kvdb.mongodb import MongoKVDB
 
@@ -50,7 +54,7 @@ def make_backend(kind: str, cfg):
         return MySQLKVDB(cfg.url)
     raise ValueError(
         f"unknown kvdb type {kind!r} "
-        f"(available: filesystem, sqlite, redis, mongodb, mysql)"
+        f"(available: filesystem, sqlite, redis, redis_cluster, mongodb, mysql)"
     )
 
 

@@ -12,7 +12,7 @@ import struct
 
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import PacketConnection
-from goworld_tpu.proto.msgtypes import FilterOp, MsgType
+from goworld_tpu.proto.msgtypes import PROTO_VERSION, FilterOp, MsgType
 
 SYNC_RECORD_SIZE = 16 + 4 * 4  # EntityID + x,y,z,yaw (proto.go:135-139)
 _SYNC = struct.Struct("<16s4f")
@@ -76,6 +76,7 @@ class GoWorldConnection:
         p.append_bool(is_restore)
         p.append_bool(is_ban_boot_entity)
         p.append_data(entity_ids)
+        p.append_uint32(PROTO_VERSION)
         self.send(MsgType.SET_GAME_ID, p)
 
     def send_set_game_id_ack(
@@ -99,6 +100,7 @@ class GoWorldConnection:
     def send_set_gate_id(self, gateid: int) -> None:
         p = Packet()
         p.append_uint16(gateid)
+        p.append_uint32(PROTO_VERSION)
         self.send(MsgType.SET_GATE_ID, p)
 
     # --- entity lifecycle notifications ------------------------------------

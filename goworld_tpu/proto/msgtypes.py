@@ -15,6 +15,13 @@ from __future__ import annotations
 
 import enum
 
+# Cluster wire-protocol version, carried in the SET_GAME_ID / SET_GATE_ID
+# handshakes and verified by the dispatcher. Bump on ANY payload layout
+# change (e.g. the round-3 migrate-nonce addition) so a mixed-version
+# dispatcher/game pair — mid rolling upgrade, or a dispatcher not restarted
+# during `reload` — fails loudly at connect instead of mis-framing packets.
+PROTO_VERSION = 2
+
 
 class MsgType(enum.IntEnum):
     # --- dispatcher-handled (proto.go:19-76) -------------------------------

@@ -44,6 +44,10 @@ def make_backend(kind: str, cfg):
         from goworld_tpu.storage.redis import RedisEntityStorage
 
         return RedisEntityStorage(cfg.url)
+    if kind == "redis_cluster":
+        from goworld_tpu.storage.redis_cluster import RedisClusterEntityStorage
+
+        return RedisClusterEntityStorage(list(cfg.start_nodes))
     if kind == "mongodb":
         from goworld_tpu.storage.mongodb import MongoEntityStorage
 
@@ -54,7 +58,7 @@ def make_backend(kind: str, cfg):
         return MySQLEntityStorage(cfg.url)
     raise ValueError(
         f"unknown storage type {kind!r} "
-        f"(available: filesystem, sqlite, redis, mongodb, mysql)"
+        f"(available: filesystem, sqlite, redis, redis_cluster, mongodb, mysql)"
     )
 
 

@@ -1,0 +1,321 @@
+"""Minimal in-process Redis Cluster for hermetic backend tests.
+
+N single-threaded RESP2 nodes, each owning a contiguous slot range, with
+real cluster behaviors the production client must handle:
+
+- ``CLUSTER SLOTS`` topology from any node;
+- ``-MOVED <slot> host:port`` for keys owned elsewhere (and after a
+  ``reshard()``, exercising the client's full map refresh);
+- ``-ASK <slot> host:port`` during a ``start_migration()`` window for keys
+  absent from the source, with the target requiring ``ASKING`` (else it
+  answers MOVED back) — the one-shot-redirect protocol;
+- ``-CROSSSLOT`` for multi-key commands whose keys hash to different slots
+  (even on the same node), keeping the client's per-slot MGET split honest.
+
+Slot hashing deliberately does NOT import the production client's crc16 —
+it re-implements CRC16/XMODEM independently so a broken production hash
+desyncs routing in tests instead of agreeing with itself; known-answer
+vectors are asserted in the contract suite.
+
+Test infrastructure only.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import socket
+import threading
+
+SLOTS = 16384
+
+
+def _crc16_xmodem(data: bytes) -> int:
+    crc = 0
+    for b in data:
+        crc ^= b << 8
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else crc << 1
+            crc &= 0xFFFF
+    return crc
+
+
+def slot_of(key: bytes) -> int:
+    start = key.find(b"{")
+    if start >= 0:
+        end = key.find(b"}", start + 1)
+        if end > start + 1:
+            key = key[start + 1 : end]
+    return _crc16_xmodem(key) % SLOTS
+
+
+def _bulk(v: bytes | None) -> bytes:
+    return b"$-1\r\n" if v is None else b"$%d\r\n%s\r\n" % (len(v), v)
+
+
+class _Node:
+    def __init__(self, cluster: "MiniRedisCluster", index: int) -> None:
+        self.cluster = cluster
+        self.index = index
+        self.store: dict[bytes, bytes] = {}
+        self._srv = socket.socket()
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._stopping = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        self._stopping = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        buf = b""
+        asking = False  # one-shot, reset after the next command
+
+        def read_line():
+            nonlocal buf
+            while b"\r\n" not in buf:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            line, rest = buf.split(b"\r\n", 1)
+            buf = rest
+            return line
+
+        def read_exact(n):
+            nonlocal buf
+            while len(buf) < n:
+                chunk = conn.recv(65536)
+                if not chunk:
+                    raise ConnectionError
+                buf += chunk
+            data, buf = buf[:n], buf[n:]
+            return data
+
+        try:
+            while True:
+                line = read_line()
+                if not line.startswith(b"*"):
+                    conn.sendall(b"-ERR protocol\r\n")
+                    return
+                args = []
+                for _ in range(int(line[1:])):
+                    hdr = read_line()
+                    assert hdr.startswith(b"$")
+                    args.append(read_exact(int(hdr[1:])))
+                    read_exact(2)
+                if args and args[0].upper() == b"ASKING":
+                    asking = True
+                    conn.sendall(b"+OK\r\n")
+                    continue
+                reply = self._dispatch(args, asking)
+                asking = False
+                conn.sendall(reply)
+        except (ConnectionError, OSError, AssertionError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # --- slot routing -------------------------------------------------------
+
+    def _route(self, keys: list[bytes], asking: bool) -> bytes | None:
+        """None = serve locally; else the error/redirect reply."""
+        slots = {slot_of(k) for k in keys}
+        if len(slots) > 1:
+            return b"-CROSSSLOT Keys in request don't hash to the same slot\r\n"
+        slot = slots.pop()
+        cl = self.cluster
+        with cl.lock:
+            owner = cl.slot_owner[slot]
+            migrating = cl.migrations.get(slot)  # (src, dst) or None
+        if owner == self.index:
+            if migrating is not None and migrating[0] == self.index:
+                # Source of an in-progress migration: keys no longer here
+                # have ALREADY moved — point at the target, one-shot.
+                if not all(k in self.store for k in keys):
+                    dst = cl.nodes[migrating[1]]
+                    return b"-ASK %d %s\r\n" % (slot, dst.addr.encode())
+            return None
+        if (
+            migrating is not None
+            and migrating[1] == self.index
+            and asking
+        ):
+            return None  # importing target honors ASKING
+        return b"-MOVED %d %s\r\n" % (
+            slot,
+            cl.nodes[owner].addr.encode(),
+        )
+
+    # --- commands -----------------------------------------------------------
+
+    def _dispatch(self, args: list[bytes], asking: bool) -> bytes:
+        cmd = args[0].upper()
+        if cmd == b"PING":
+            return b"+PONG\r\n"
+        if cmd == b"AUTH":
+            return b"+OK\r\n"
+        if cmd == b"SELECT":
+            # Cluster supports db 0 only (real redis answers -ERR for >0).
+            return (
+                b"+OK\r\n"
+                if args[1] == b"0"
+                else b"-ERR SELECT is not allowed in cluster mode\r\n"
+            )
+        if cmd == b"CLUSTER":
+            if args[1].upper() == b"SLOTS":
+                return self.cluster.slots_reply()
+            return b"-ERR unknown CLUSTER subcommand\r\n"
+        if cmd == b"SCAN":
+            # Node-local keyspace scan (never redirected).
+            pattern = b"*"
+            count = 4  # tiny page: force the client's full cursor loop
+            for i, a in enumerate(args):
+                if a.upper() == b"MATCH":
+                    pattern = args[i + 1]
+                elif a.upper() == b"COUNT":
+                    count = min(int(args[i + 1]), 4)
+            keys = sorted(
+                k for k in self.store
+                if fnmatch.fnmatchcase(
+                    k.decode("utf-8", "replace"),
+                    pattern.decode("utf-8", "replace"),
+                )
+            )
+            start = int(args[1])
+            page = keys[start : start + count]
+            nxt = start + count if start + count < len(keys) else 0
+            nb = str(nxt).encode()
+            parts = [
+                b"*2\r\n$%d\r\n%s\r\n" % (len(nb), nb),
+                b"*%d\r\n" % len(page),
+            ]
+            parts += [_bulk(k) for k in page]
+            return b"".join(parts)
+
+        if cmd in (b"GET", b"SET", b"SETNX", b"DEL", b"EXISTS", b"MGET"):
+            keys = args[1:2] if cmd in (b"GET", b"SET", b"SETNX") else args[1:]
+            redirect = self._route(keys, asking)
+            if redirect is not None:
+                return redirect
+            store = self.store
+            if cmd == b"SET":
+                store[args[1]] = args[2]
+                return b"+OK\r\n"
+            if cmd == b"GET":
+                return _bulk(store.get(args[1]))
+            if cmd == b"SETNX":
+                if args[1] in store:
+                    return b":0\r\n"
+                store[args[1]] = args[2]
+                return b":1\r\n"
+            if cmd == b"DEL":
+                n = sum(
+                    1 for k in args[1:] if store.pop(k, None) is not None
+                )
+                return b":%d\r\n" % n
+            if cmd == b"EXISTS":
+                return b":%d\r\n" % sum(1 for k in args[1:] if k in store)
+            if cmd == b"MGET":
+                parts = [b"*%d\r\n" % (len(args) - 1)]
+                parts += [_bulk(store.get(k)) for k in args[1:]]
+                return b"".join(parts)
+        return b"-ERR unknown command '%s'\r\n" % cmd
+
+
+class MiniRedisCluster:
+    def __init__(self, n_nodes: int = 3) -> None:
+        self.lock = threading.Lock()
+        self.nodes = [_Node(self, i) for i in range(n_nodes)]
+        # Contiguous even split, like a fresh real cluster.
+        self.slot_owner = [
+            min(s * n_nodes // SLOTS, n_nodes - 1) for s in range(SLOTS)
+        ]
+        self.migrations: dict[int, tuple[int, int]] = {}  # slot → (src, dst)
+
+    @property
+    def start_nodes(self) -> list[str]:
+        return [n.addr for n in self.nodes]
+
+    def stop(self) -> None:
+        for n in self.nodes:
+            n.stop()
+
+    def node_of_key(self, key: str) -> int:
+        return self.slot_owner[slot_of(key.encode())]
+
+    # --- topology mutations (test hooks) ------------------------------------
+
+    def slots_reply(self) -> bytes:
+        """CLUSTER SLOTS: contiguous ranges with [start, end, [ip, port]]."""
+        with self.lock:
+            ranges = []
+            start = 0
+            for s in range(1, SLOTS + 1):
+                if s == SLOTS or self.slot_owner[s] != self.slot_owner[start]:
+                    ranges.append((start, s - 1, self.slot_owner[start]))
+                    start = s
+        parts = [b"*%d\r\n" % len(ranges)]
+        for lo, hi, owner in ranges:
+            parts.append(
+                b"*3\r\n:%d\r\n:%d\r\n*2\r\n$9\r\n127.0.0.1\r\n:%d\r\n"
+                % (lo, hi, self.nodes[owner].port)
+            )
+        return b"".join(parts)
+
+    def reshard(self, slot: int, dst: int) -> None:
+        """Instantly move a slot's ownership AND its keys (the post-state of
+        a completed migration): old owner answers MOVED from now on."""
+        with self.lock:
+            src = self.slot_owner[slot]
+            if src == dst:
+                return
+            moved = [
+                k for k in self.nodes[src].store if slot_of(k) == slot
+            ]
+            for k in moved:
+                self.nodes[dst].store[k] = self.nodes[src].store.pop(k)
+            self.slot_owner[slot] = dst
+
+    def start_migration(self, slot: int, dst: int, move_keys: bool = True) -> None:
+        """Open an ASK window: source still owns the slot but redirects
+        misses to dst with -ASK; dst serves the slot only under ASKING."""
+        with self.lock:
+            src = self.slot_owner[slot]
+            self.migrations[slot] = (src, dst)
+            if move_keys:
+                moved = [
+                    k for k in self.nodes[src].store if slot_of(k) == slot
+                ]
+                for k in moved:
+                    self.nodes[dst].store[k] = self.nodes[src].store.pop(k)
+
+    def finish_migration(self, slot: int) -> None:
+        with self.lock:
+            src, dst = self.migrations.pop(slot)
+            self.slot_owner[slot] = dst
+            moved = [k for k in self.nodes[src].store if slot_of(k) == slot]
+            for k in moved:
+                self.nodes[dst].store[k] = self.nodes[src].store.pop(k)

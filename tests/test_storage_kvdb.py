@@ -57,18 +57,33 @@ def _net_server(kind: str):
             yield f"mysql://root@127.0.0.1:{srv.port}"
         finally:
             srv.stop()
+    elif kind == "redis_cluster":
+        nodes = os.environ.get("GOWORLD_REDIS_CLUSTER_NODES")
+        if nodes:
+            yield nodes.split(",")
+            return
+        from miniredis_cluster import MiniRedisCluster
+
+        srv = MiniRedisCluster(n_nodes=3)
+        try:
+            yield srv.start_nodes
+        finally:
+            srv.stop()
     else:
         yield ""
 
 
-_BACKENDS = ["filesystem", "sqlite", "redis", "mongodb", "mysql"]
+_BACKENDS = ["filesystem", "sqlite", "redis", "redis_cluster", "mongodb", "mysql"]
 
 
 @pytest.fixture(params=_BACKENDS)
 def entity_backend(request, tmp_path):
     with _net_server(request.param) as url:
+        cluster = request.param == "redis_cluster"
         cfg = StorageConfig(
-            type=request.param, directory=str(tmp_path / "es"), url=url
+            type=request.param, directory=str(tmp_path / "es"),
+            url="" if cluster else url,
+            start_nodes=url if cluster else [],
         )
         backend = storage.make_backend(request.param, cfg)
         yield backend
@@ -78,8 +93,11 @@ def entity_backend(request, tmp_path):
 @pytest.fixture(params=_BACKENDS)
 def kv_backend(request, tmp_path):
     with _net_server(request.param) as url:
+        cluster = request.param == "redis_cluster"
         cfg = KVDBConfig(
-            type=request.param, directory=str(tmp_path / "kv"), url=url
+            type=request.param, directory=str(tmp_path / "kv"),
+            url="" if cluster else url,
+            start_nodes=url if cluster else [],
         )
         backend = kvdb.make_backend(request.param, cfg)
         yield backend
@@ -150,6 +168,105 @@ def test_async_kvdb_api(tmp_path):
     post.tick()
     assert results == ["put", "avatar9", "avatar9"]
     kvdb.set_backend(None)
+
+
+def test_cluster_key_slot_known_answers():
+    """CRC16/XMODEM + hash-tag known-answer vectors: the mini cluster's hash
+    is implemented independently of the production client's, so agreement on
+    these pins both to the real Redis Cluster mapping."""
+    from miniredis_cluster import slot_of
+
+    from goworld_tpu.netutil.resp_cluster import crc16, key_slot
+
+    assert crc16(b"123456789") == 0x31C3  # standard XMODEM check value
+    assert key_slot("foo") == 12182  # well-known Redis slot assignments
+    assert key_slot("bar") == 5061
+    assert key_slot("") == crc16(b"") % 16384
+    # Hash tags: only the brace section is hashed; empty tags are ignored.
+    assert key_slot("{user1000}.following") == key_slot("{user1000}.followers")
+    # Empty first tag means NO tag: the WHOLE key is hashed (cluster spec).
+    assert key_slot("foo{}{bar}") == crc16(b"foo{}{bar}") % 16384
+    assert key_slot("foo{}{bar}") != key_slot("bar")
+    for k in ("foo", "bar", "{user1000}.following", "a{b}c", "x"):
+        assert slot_of(k.encode()) == key_slot(k)
+
+
+def test_cluster_moved_redirect_and_refresh():
+    """A reshard makes the old owner answer -MOVED; the client must refresh
+    its map and converge on the new owner (reference redirect semantics via
+    chasex/redis-go-cluster)."""
+    from miniredis_cluster import MiniRedisCluster
+
+    from goworld_tpu.netutil.resp_cluster import RespClusterClient, key_slot
+
+    srv = MiniRedisCluster(n_nodes=3)
+    try:
+        c = RespClusterClient(srv.start_nodes)
+        c.set("movekey", "v1")
+        home = srv.node_of_key("movekey")
+        dst = (home + 1) % 3
+        srv.reshard(key_slot("movekey"), dst)
+        # Client's map is now stale: first attempt hits the old owner,
+        # gets MOVED, refreshes, retries — transparently.
+        assert c.get("movekey") == "v1"
+        c.set("movekey", "v2")
+        assert srv.nodes[dst].store[b"movekey"] == b"v2"
+        assert b"movekey" not in srv.nodes[home].store
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_cluster_ask_redirect_window():
+    """During a live slot migration the source answers -ASK for moved keys;
+    the client must follow one-shot with ASKING and must NOT rewrite its
+    slot map (the source still owns the slot until migration finishes)."""
+    from miniredis_cluster import MiniRedisCluster
+
+    from goworld_tpu.netutil.resp_cluster import RespClusterClient, key_slot
+
+    srv = MiniRedisCluster(n_nodes=3)
+    try:
+        c = RespClusterClient(srv.start_nodes)
+        c.set("askkey", "v1")
+        slot = key_slot("askkey")
+        home = srv.node_of_key("askkey")
+        dst = (home + 1) % 3
+        srv.start_migration(slot, dst)  # keys already moved to dst
+        assert c.get("askkey") == "v1"  # via ASK + ASKING
+        # Map not rewritten: source still owns the slot (keys that are
+        # still on the source keep being served there).
+        assert c._slot_owner[slot] == ("127.0.0.1", srv.nodes[home].port)
+        srv.finish_migration(slot)
+        assert c.get("askkey") == "v1"  # now via MOVED + refresh
+        assert c._slot_owner[slot] == ("127.0.0.1", srv.nodes[dst].port)
+        c.close()
+    finally:
+        srv.stop()
+
+
+def test_cluster_mget_splits_per_slot_and_scan_merges():
+    """mget across arbitrary keys must split per slot (cluster MGET is
+    CROSSSLOT otherwise); scan_keys must merge every master's keyspace
+    through real cursor pagination (4-key server pages)."""
+    from miniredis_cluster import MiniRedisCluster
+
+    from goworld_tpu.netutil.resp_cluster import RespClusterClient
+
+    srv = MiniRedisCluster(n_nodes=3)
+    try:
+        c = RespClusterClient(srv.start_nodes)
+        keys = [f"k{i:03d}" for i in range(30)]
+        for k in keys:
+            c.set(k, k.upper())
+        assert {srv.node_of_key(k) for k in keys} == {0, 1, 2}  # really spread
+        got = c.mget(keys + ["absent"])
+        assert got == [k.upper() for k in keys] + [None]
+        assert c.scan_keys("k0*") == sorted(k for k in keys if k.startswith("k0"))
+        assert c.scan_keys("*") == keys
+        c.close()
+    finally:
+        srv.stop()
 
 
 def test_network_backend_pagination():
