@@ -44,7 +44,15 @@ P99_TARGET_MS = 5.0
 
 # Sweep points (single source for both the sweep loops and self-tuning).
 CELL_SWEEP = ((100.0, 132), (150.0, 88), (300.0, 44), (440.0, 30), (600.0, 22))
-EVENTS_SWEEP = (65536, 98304, 131072)  # includes the default so it can win
+# max_events is PER SIDE (the packed buffer holds max_events enters AND
+# max_events leaves; collect() pages on n_e > e / n_l > e independently), so
+# the headline's ~135k TOTAL events/tick is ~67k per side and the 131072
+# default already clears it ~2x (VERDICT r3 #8 read the total against the
+# per-side budget; the `paged_ticks` metric now settles that empirically).
+# The sweep still spans 64k..192k: smaller budgets shrink drain+readback if
+# occasional paging is cheaper, larger ones buy storm headroom.
+EVENTS_SWEEP = (65536, 98304, 131072, 163840, 196608)
+DRAIN_SWEEP = ("bsearch", "grouped")  # word-select strategies (neighbor.py)
 
 
 # --- backend resolution ------------------------------------------------------
@@ -156,7 +164,8 @@ def _resolve_platform(diag: dict) -> str:
 def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
               label: str = "aoi", cell_override: float | None = None,
               grid_override: int | None = None,
-              max_events_override: int | None = None) -> dict:
+              max_events_override: int | None = None,
+              drain_mode: str | None = None) -> dict:
     """The production AOI loop (BatchAOIService path): pipelined step_async +
     single packed readback per tick. n_spaces>1 = BASELINE config 3 (batched
     cross-space AOI in one launch)."""
@@ -201,6 +210,7 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
         space_slots=space_slots,
         cell_capacity=cap,
         max_events=max_events,
+        drain_mode=drain_mode or os.environ.get("BENCH_DRAIN_MODE", "bsearch"),
     )
     eng = NeighborEngine(params)
     eng.reset()
@@ -225,6 +235,7 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
 
     steps = max(2, int(os.environ.get("BENCH_STEPS", "45")))
     events = 0
+    paged_ticks = 0  # ticks whose event count overflowed the inline budget
     collect_lat: list[float] = []
     diff_lat: list[float] = []  # dispatch of tick t → tick t events on host
     pending = None
@@ -244,6 +255,8 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
             collect_lat.append(t1 - t0)
             diff_lat.append(t1 - pending_dispatch_t)
             events += len(enters) + len(leaves)
+            if len(enters) > max_events or len(leaves) > max_events:
+                paged_ticks += 1
         pending, pending_dispatch_t = nxt, t_dispatch
     t0 = time.perf_counter()
     enters, leaves, _ = pending.collect()
@@ -251,6 +264,8 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
     collect_lat.append(t1 - t0)
     diff_lat.append(t1 - pending_dispatch_t)
     events += len(enters) + len(leaves)
+    if len(enters) > max_events or len(leaves) > max_events:
+        paged_ticks += 1
     t_all = time.perf_counter() - t_all0
 
     c_ms = np.array(collect_lat) * 1000.0
@@ -266,9 +281,14 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
         "cell_size": cell,
         "grid": grid,
         "max_events": max_events,
+        "drain_mode": params.drain_mode,
         "spaces": n_spaces,
         "ticks_per_sec": round(ticks_per_sec, 2),
         "events_per_tick": round(events / steps, 1),
+        # VERDICT r3 #8: steady state must clear the inline budget so no
+        # tick pays a second drain round trip.
+        "paged_ticks": paged_ticks,
+        "inline_budget_clears_steady_state": paged_ticks == 0,
         "collect_p50_ms": round(float(np.percentile(c_ms, 50)), 3),
         "collect_p99_ms": round(float(np.percentile(c_ms, 99)), 3),
         # End-to-end enter/leave-diff delivery latency (dispatch → host),
@@ -529,11 +549,26 @@ def main() -> int:
                         esweep[f"max_events_{me}"] = {
                             "error": traceback.format_exc(limit=2).splitlines()[-1]
                         }
+                configs["events_sweep"] = esweep
+                # Drain word-select strategy sweep (identical event streams,
+                # different gather shapes — neighbor.py drain_mode).
+                dsweep = {}
+                for dm in DRAIN_SWEEP:
+                    try:
+                        r = bench_aoi(label=f"drain_{dm}", drain_mode=dm)
+                        dsweep[f"drain_{dm}"] = {
+                            "updates_per_sec": r["value"],
+                            "diff_latency_p99_ms": r["diff_latency_p99_ms"],
+                        }
+                    except Exception:
+                        dsweep[f"drain_{dm}"] = {
+                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        }
                 if saved_steps is None:
                     os.environ.pop("BENCH_STEPS", None)
                 else:
                     os.environ["BENCH_STEPS"] = saved_steps
-                configs["events_sweep"] = esweep
+                configs["drain_sweep"] = dsweep
                 # Self-tuning: if the (short) sweeps found a better config,
                 # re-run the headline at FULL length there and promote the
                 # result — the driver runs this file exactly once per round,
@@ -548,7 +583,7 @@ def main() -> int:
                     cells = {cg: f"cell_{int(cg[0])}" for cg in CELL_SWEEP}
                     head_cfg = (
                         result.get("cell_size"), result.get("grid"),
-                        result.get("max_events"),
+                        result.get("max_events"), result.get("drain_mode"),
                     )
                     best_cell = max(
                         (cg for cg in cells
@@ -564,16 +599,24 @@ def main() -> int:
                             "updates_per_sec"],
                         default=head_cfg[2],
                     )
-                    if (best_cell[0], best_cell[1], best_me) != head_cfg:
+                    best_dm = max(
+                        (dm for dm in DRAIN_SWEEP
+                         if "updates_per_sec" in dsweep.get(f"drain_{dm}", {})),
+                        key=lambda dm: dsweep[f"drain_{dm}"]["updates_per_sec"],
+                        default=head_cfg[3],
+                    )
+                    if (best_cell[0], best_cell[1], best_me, best_dm) != head_cfg:
                         tuned = bench_aoi(
                             label="aoi_tuned",
                             cell_override=best_cell[0],
                             grid_override=best_cell[1],
                             max_events_override=best_me,
+                            drain_mode=best_dm,
                         )
                         tuned["tuned_cell"] = best_cell[0]
                         tuned["tuned_grid"] = best_cell[1]
                         tuned["tuned_max_events"] = best_me
+                        tuned["tuned_drain_mode"] = best_dm
                         if tuned["value"] > result["value"]:
                             configs["default_config_headline"] = {
                                 k: result[k] for k in

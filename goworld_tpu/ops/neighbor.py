@@ -92,8 +92,18 @@ class NeighborParams:
     space_slots: int = 8  # space-id folding slots for the shared grid
     cell_capacity: int = 64  # M: max entities visible per grid cell
     max_events: int = 65536  # enter/leave pairs fetched per host round trip
+    # Pallas-drain word-select strategy (identical results, different
+    # gather shapes — the on-chip microbench promotes the winner):
+    #   bsearch: ceil(log2(W+1)) random scalar gathers per event
+    #   grouped: two contiguous-row gathers ([E, G] group cumsums, then
+    #            [E, W/G] words) + prefix compares
+    drain_mode: str = "bsearch"
 
     def __post_init__(self) -> None:
+        if self.drain_mode not in ("bsearch", "grouped"):
+            raise ValueError(
+                f"drain_mode must be bsearch|grouped, got {self.drain_mode!r}"
+            )
         if self.grid_x < 4 or self.grid_z < 4:
             # 3x3 neighborhoods must touch 9 distinct buckets after wrap.
             raise ValueError("grid_x and grid_z must be >= 4")
@@ -175,10 +185,26 @@ def _build_table(
     n = p.capacity
     cap = min(p.cell_capacity, stride)
     key = jnp.where(active, bucket, p.num_buckets)
-    order = jnp.argsort(key)  # stable
-    sorted_key = key[order]
-    first = jnp.searchsorted(sorted_key, sorted_key, side="left")
-    rank = jnp.arange(n, dtype=jnp.int32) - first.astype(jnp.int32)
+    iota = jnp.arange(n, dtype=jnp.int32)
+    if (p.num_buckets + 1) * n < 2**31:
+        # Fused single-array sort: key*n + iota is unique, sorts by
+        # (key, iota) — same order as a stable argsort — and decomposes
+        # back without the pair-sort's payload lanes or the key[order]
+        # regather (the table build was 17.8 ms of the 112 ms on-chip
+        # tick, 2026-07-30; sort is its dominant term).
+        fused = jnp.sort(key * jnp.int32(n) + iota)
+        order = jax.lax.rem(fused, jnp.int32(n))
+        sorted_key = fused // jnp.int32(n)
+    else:
+        order = jnp.argsort(key).astype(jnp.int32)  # stable
+        sorted_key = key[order]
+    # First-occurrence index per key run via segment boundaries + cummax —
+    # O(N) scan instead of searchsorted's log(N) gather passes.
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+    )
+    first = jax.lax.cummax(jnp.where(boundary, iota, 0))
+    rank = iota - first
     ok = (sorted_key < p.num_buckets) & (rank < cap)
     dropped = jnp.sum((sorted_key < p.num_buckets) & ~ok).astype(jnp.int32)
     table_size = p.num_buckets * stride
@@ -596,19 +622,51 @@ def _drain_bits(
     # on-chip 2026-07-30.)
     nw = pc.shape[1]
     word_cum = jnp.cumsum(pc, axis=1)  # [N, W] inclusive
-    wc_flat = word_cum.reshape(-1)
-    pc_flat = pc.reshape(-1)
-    base = row * nw
-    lo = jnp.zeros((max_events,), jnp.int32)
-    hi = jnp.full((max_events,), nw, jnp.int32)
-    for _ in range(max(1, nw.bit_length())):
-        mid = jnp.minimum((lo + hi) // 2, nw - 1)
-        gt = wc_flat[base + mid] > k
-        hi = jnp.where(gt, mid, hi)
-        lo = jnp.where(gt, lo, mid + 1)
-    w = jnp.minimum(lo, nw - 1)
-    word_start = wc_flat[base + w] - pc_flat[base + w]
-    kk = k - word_start  # set-bit rank within the word
+    if p.drain_mode == "grouped":
+        # Two-level select via CONTIGUOUS row gathers: the bsearch mode's
+        # ~log2(W) random scalar gathers per event are latency-bound on
+        # TPU; here each event pulls its row's [G] group cumsums and the
+        # [gsz] words of the chosen group in two row gathers, then finds
+        # group/word with wide prefix compares (VPU-friendly).
+        # Invariant: word w holds rank k iff word_cum[w] > k and
+        # word_cum[w-1] <= k, so index = count of inclusive cumsums <= k.
+        gsz = 8
+        ng = (nw + gsz - 1) // gsz
+        pad = ng * gsz - nw
+        # edge-pad: padded words repeat the last cumsum (popcount 0).
+        wc_pad = jnp.pad(word_cum, ((0, 0), (0, pad)), mode="edge")
+        group_cum = wc_pad[:, gsz - 1 :: gsz]  # [N, G] inclusive per group
+        g_rows = group_cum[row]  # [E, G]
+        g = jnp.sum((g_rows <= k[:, None]).astype(jnp.int32), axis=1)
+        g = jnp.minimum(g, ng - 1)
+        # The chosen group's word cumsums per event: [E, gsz].
+        idx = (row * (ng * gsz) + g * gsz)[:, None] + jnp.arange(
+            gsz, dtype=jnp.int32
+        )[None, :]
+        wg = wc_pad.reshape(-1)[idx]
+        wi = jnp.sum((wg <= k[:, None]).astype(jnp.int32), axis=1)
+        w = jnp.minimum(g * gsz + wi, nw - 1)
+        ev = jnp.arange(max_events)
+        # Exclusive cumsum at w: last word of the previous group when the
+        # event is the group's first word, else the group-local neighbor.
+        prev_in_group = wg[ev, jnp.maximum(wi - 1, 0)]
+        prev_group_end = jnp.where(g > 0, g_rows[ev, jnp.maximum(g - 1, 0)], 0)
+        word_start = jnp.where(wi > 0, prev_in_group, prev_group_end)
+        kk = k - word_start  # set-bit rank within the word
+    else:
+        wc_flat = word_cum.reshape(-1)
+        pc_flat = pc.reshape(-1)
+        base = row * nw
+        lo = jnp.zeros((max_events,), jnp.int32)
+        hi = jnp.full((max_events,), nw, jnp.int32)
+        for _ in range(max(1, nw.bit_length())):
+            mid = jnp.minimum((lo + hi) // 2, nw - 1)
+            gt = wc_flat[base + mid] > k
+            hi = jnp.where(gt, mid, hi)
+            lo = jnp.where(gt, lo, mid + 1)
+        w = jnp.minimum(lo, nw - 1)
+        word_start = wc_flat[base + w] - pc_flat[base + w]
+        kk = k - word_start  # set-bit rank within the word
 
     word = packed_e[row, w]
     bits = (word[:, None] >> jnp.arange(_PACK, dtype=jnp.int32)) & 1

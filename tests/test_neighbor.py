@@ -229,6 +229,59 @@ def test_chunked_drain_small_buffer(backend):
     assert total > 3 * p.max_events
 
 
+def test_grouped_drain_matches_bsearch():
+    """drain_mode=grouped must produce the identical event stream as the
+    default bsearch select, including under storm paging (tiny max_events
+    forces many chunks through the grouped path's group/word compares)."""
+    base = dict(
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64,
+    )
+    rng = np.random.default_rng(11)
+    for max_events in (64, 65536):
+        engines = {}
+        for mode in ("bsearch", "grouped"):
+            p = NeighborParams(max_events=max_events, drain_mode=mode, **base)
+            engines[mode] = NeighborEngine(p, backend="pallas_interpret")
+            engines[mode].reset()
+        pos, active, space, radius = make_world(256, 200, seed=7)
+        for tick in range(4):
+            results = {
+                m: e.step(pos, active, space, radius)
+                for m, e in engines.items()
+            }
+            for which in (0, 1):
+                a = np.asarray(results["bsearch"][which])
+                b = np.asarray(results["grouped"][which])
+                assert np.array_equal(a, b), (tick, which, max_events)
+            pos = pos + rng.uniform(-30, 30, pos.shape).astype(np.float32)
+
+
+def test_table_sort_fallback_branch_matches_oracle():
+    """_build_table's argsort fallback — taken when (num_buckets+1)*capacity
+    overflows the fused single-array sort's int32 space — must produce the
+    same event streams as the fused branch. Production's largest grids
+    (cell_100 sweep at 102k entities) run THIS branch, so it needs coverage
+    beyond the small-grid configs every other test uses (code-review r4)."""
+    p = NeighborParams(
+        capacity=1024, cell_size=100.0, grid_x=512, grid_z=512,
+        space_slots=8, cell_capacity=4, max_events=65536,
+    )
+    assert (p.num_buckets + 1) * p.capacity >= 2**31  # really the fallback
+    eng = NeighborEngine(p, backend="jnp")
+    eng.reset()
+    rng = np.random.default_rng(21)
+    pos = rng.uniform(0, 51200.0, (1024, 2)).astype(np.float32)
+    active = rng.random(1024) < 0.9
+    space = rng.integers(0, 5, 1024).astype(np.int32)
+    radius = np.full(1024, 100.0, np.float32)
+    enters, _, dropped = eng.step(pos, active, space, radius)
+    assert dropped == 0
+    got = pairs_to_setlist(enters, 1024)
+    want = brute_force_sets(pos, active, space, radius)
+    assert got == want
+
+
 def test_radius_exceeding_cell_size_rejected():
     eng = engine()
     pos, active, space, radius = make_world(256, 10, seed=5)
