@@ -52,6 +52,10 @@ class GameConfig:
     log_file: str = ""
     log_level: str = "info"
     position_sync_interval: float = 0.1  # server→client cadence (read_config.go:328)
+    # Per-game override of [aoi] platform ("" = inherit): on single-client
+    # TPU transports exactly ONE game process may hold the chip — set
+    # aoi_platform=tpu on that game and cpu on the rest.
+    aoi_platform: str = ""
 
 
 @dataclasses.dataclass
@@ -226,6 +230,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             log_file=s.get("log_file", ""),
             log_level=s.get("log_level", "info"),
             position_sync_interval=float(s.get("position_sync_interval", 0.1)),
+            aoi_platform=s.get("aoi_platform", "").strip().lower(),
         )
 
     for i in range(1, cfg.deployment.desired_gates + 1):
@@ -310,6 +315,12 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[aoi] cell_size must be >= 0 (0 = default)")
     if a.space_slots < 0:
         raise ValueError("[aoi] space_slots must be >= 0 (0 = default)")
+    for gid, g in cfg.games.items():
+        if g.aoi_platform not in ("", "auto", "cpu", "tpu"):
+            raise ValueError(
+                f"game{gid}: aoi_platform must be auto|cpu|tpu, "
+                f"got {g.aoi_platform!r}"
+            )
     for section, c in (("storage", cfg.storage), ("kvdb", cfg.kvdb)):
         if c.type == "redis_cluster" and not c.start_nodes:
             # read_config.go:555-556,617-619: fatal without seed nodes.

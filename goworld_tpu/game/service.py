@@ -86,7 +86,14 @@ class GameService:
 
             rt.aoi_params = params_from_config(self.cfg.aoi)
         if rt.aoi_backend != "xzlist":
-            if self.cfg.aoi.platform == "cpu":
+            # Per-game aoi_platform overrides the global [aoi] platform: on
+            # single-client TPU transports exactly one game may hold the
+            # chip (read_config.py GameConfig.aoi_platform).
+            platform = (
+                (game_cfg.aoi_platform if game_cfg else "")
+                or self.cfg.aoi.platform
+            )
+            if platform == "cpu":
                 # Must happen before the first jax use: the TPU plugin
                 # ignores JAX_PLATFORMS, so only jax.config reliably keeps a
                 # CPU-deploy game process off the chip (read_config.py).

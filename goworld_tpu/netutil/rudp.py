@@ -20,12 +20,25 @@ Wire format (one datagram per segment, 13-byte header):
   out-of-order-received segments immediately).
 - FIN(3): graceful close.
 
-Loss recovery: a 10 ms tick (consts.go:122-131 turbo interval) retransmits
-unacked segments older than their RTO (50 ms, doubling per retry, 1 s cap).
-In-flight is windowed; senders buffer beyond the window and evict the
-connection if the backlog exceeds MAX_BACKLOG (the WS transport's stalled-
-client policy). ``loss_simulation`` drops outgoing datagrams randomly —
-the e2e tests' induced-loss knob.
+Loss recovery (KCP turbo parity, ``engine/consts/consts.go:122-131``):
+
+- **Adaptive RTO** (Jacobson/Karels with Karn's rule): RTT is sampled from
+  acks of segments transmitted exactly once; ``rto = srtt +
+  max(tick, 4*rttvar)``, clamped to [30 ms, 1 s] (the 30 ms floor is KCP's
+  nodelay minimum). Timeout backoff is the nodelay ×1.5, not the vanilla
+  ×2 (KCP_NO_DELAY=1).
+- **Fast resend** (KCP_ENABLE_FAST_RESEND=2): every ack counts, for each
+  older in-flight segment, how many times it was "skipped"; at 2 skips the
+  segment retransmits immediately without waiting its RTO.
+- **Congestion control is OFF by default** (KCP_DISABLE_CONGESTION_CONTROL
+  = 1, turbo nc mode): the window is the fixed SEND_WINDOW. Passing
+  ``congestion=True`` enables slow-start/AIMD for adverse networks.
+
+A 10 ms tick (turbo interval) drives timeouts. In-flight is windowed;
+senders buffer beyond the window and evict the connection if the backlog
+exceeds MAX_BACKLOG (the WS transport's stalled-client policy).
+``loss_simulation`` drops outgoing datagrams randomly — the e2e tests'
+induced-loss knob.
 """
 
 from __future__ import annotations
@@ -46,9 +59,12 @@ CMD_FIN = 3
 
 MSS = 1200  # payload bytes per segment (under common 1500 MTU)
 TICK_INTERVAL = 0.01  # 10 ms retransmit cadence (KCP turbo interval)
-RTO_INIT = 0.05
+RTO_INIT = 0.05  # before the first RTT sample lands
+RTO_MIN = 0.03  # KCP nodelay floor
 RTO_MAX = 1.0
-SEND_WINDOW = 256  # in-flight segments
+RTO_BACKOFF = 1.5  # nodelay-mode timeout growth (vanilla KCP doubles)
+FAST_RESEND = 2  # KCP_ENABLE_FAST_RESEND: skipped-by-2-acks → retransmit
+SEND_WINDOW = 256  # in-flight segments (flow-control cap)
 MAX_BACKLOG = 65536  # queued segments beyond the window → evict
 NO_SACK = 0xFFFFFFFF
 
@@ -61,6 +77,7 @@ class RUDPEndpoint:
         conv: int,
         transmit: Callable[[bytes], None],
         on_close: Optional[Callable[["RUDPEndpoint"], None]] = None,
+        congestion: bool = False,  # default = KCP turbo nc=1 (off)
     ) -> None:
         self.conv = conv
         self._transmit = transmit
@@ -68,10 +85,20 @@ class RUDPEndpoint:
         self.closed = False
         self.loss_simulation = 0.0  # outgoing drop probability (tests)
         self._rng = random.Random(conv)
-        # send side
+        # send side: seq → [bytes, deadline, rto, sent_time, xmits, fastack]
         self._snd_nxt = 0
-        self._unacked: dict[int, list] = {}  # seq → [bytes, deadline, rto]
+        self._unacked: dict[int, list] = {}
         self._backlog: list[tuple[int, bytes]] = []  # beyond the window
+        # RTT estimator (Jacobson/Karels; Karn's rule via xmits == 1)
+        self.srtt = 0.0
+        self.rttvar = 0.0
+        self.rto = RTO_INIT
+        # congestion (disabled by default, KCP_DISABLE_CONGESTION_CONTROL=1)
+        self._congestion = congestion
+        self._cwnd = 2.0
+        self._ssthresh = float(SEND_WINDOW)
+        self.fast_resends = 0  # diagnostics
+        self.timeout_resends = 0
         # recv side
         self._rcv_nxt = 0
         self._ooo: dict[int, bytes] = {}  # out-of-order segments
@@ -100,6 +127,13 @@ class RUDPEndpoint:
 
     # --- public send --------------------------------------------------------
 
+    def _window(self) -> int:
+        """Effective in-flight cap: flow window, AND the congestion window
+        when congestion control is on (off by default, turbo nc mode)."""
+        if not self._congestion:
+            return SEND_WINDOW
+        return max(1, min(SEND_WINDOW, int(self._cwnd)))
+
     def send_bytes(self, data: bytes) -> None:
         """Queue bytes onto the reliable stream (split into MSS segments)."""
         if self.closed:
@@ -110,8 +144,8 @@ class RUDPEndpoint:
             seg = bytes(data[off:off + MSS])
             seq = self._snd_nxt
             self._snd_nxt += 1
-            if len(self._unacked) < SEND_WINDOW:
-                self._unacked[seq] = [seg, now + RTO_INIT, RTO_INIT]
+            if len(self._unacked) < self._window():
+                self._unacked[seq] = [seg, now + self.rto, self.rto, now, 1, 0]
                 self._send_segment(seq, seg)
             else:
                 self._backlog.append((seq, seg))
@@ -136,23 +170,74 @@ class RUDPEndpoint:
             self._send_ack(seq)
         elif cmd == CMD_ACK:
             if seq != NO_SACK:
-                self._unacked.pop(seq, None)
+                self._ack_one(seq)
+                self._fast_ack(seq)
                 self._refill_window()
         elif cmd == CMD_FIN:
             self.close(send_fin=False)
+
+    def _ack_one(self, seq: int) -> None:
+        """Retire one acked segment, sampling RTT per Karn's rule (only
+        segments transmitted exactly once give unambiguous samples)."""
+        ent = self._unacked.pop(seq, None)
+        if ent is None:
+            return
+        if ent[4] == 1:
+            rtt = asyncio.get_running_loop().time() - ent[3]
+            if self.srtt == 0.0:
+                self.srtt = rtt
+                self.rttvar = rtt / 2.0
+            else:
+                self.rttvar = 0.75 * self.rttvar + 0.25 * abs(self.srtt - rtt)
+                self.srtt = 0.875 * self.srtt + 0.125 * rtt
+            self.rto = min(
+                max(self.srtt + max(TICK_INTERVAL, 4.0 * self.rttvar),
+                    RTO_MIN),
+                RTO_MAX,
+            )
+        if self._congestion:  # slow start, then AIMD growth
+            self._cwnd += 1.0 if self._cwnd < self._ssthresh else 1.0 / self._cwnd
 
     def _apply_ack(self, ack: int) -> None:
         if not self._unacked:
             return
         for seq in [s for s in self._unacked if s < ack]:
-            del self._unacked[seq]
+            self._ack_one(seq)
+        # No _fast_ack here: a cumulative ack retires EVERY older segment,
+        # so nothing in flight can have been skipped by it; skips are only
+        # observable via the SACK seq on CMD_ACK.
         self._refill_window()
+
+    def _fast_ack(self, acked: int) -> None:
+        """KCP fast resend: segments older than an acked seq were 'skipped'
+        by that ack; at FAST_RESEND skips, retransmit immediately instead of
+        waiting for the RTO."""
+        ripe = []
+        for seq, ent in self._unacked.items():
+            if seq < acked:
+                ent[5] += 1
+                if ent[5] >= FAST_RESEND:
+                    ripe.append(seq)
+        if not ripe:
+            return
+        now = asyncio.get_running_loop().time()
+        for seq in sorted(ripe):
+            ent = self._unacked[seq]
+            ent[5] = 0
+            ent[4] += 1
+            ent[1] = now + ent[2]  # deadline pushed; rto unchanged
+            self.fast_resends += 1
+            self._send_segment(seq, ent[0])
+        if self._congestion:
+            inflight = len(self._unacked)
+            self._ssthresh = max(inflight / 2.0, 2.0)
+            self._cwnd = self._ssthresh + FAST_RESEND
 
     def _refill_window(self) -> None:
         now = asyncio.get_running_loop().time()
-        while self._backlog and len(self._unacked) < SEND_WINDOW:
+        while self._backlog and len(self._unacked) < self._window():
             seq, seg = self._backlog.pop(0)
-            self._unacked[seq] = [seg, now + RTO_INIT, RTO_INIT]
+            self._unacked[seq] = [seg, now + self.rto, self.rto, now, 1, 0]
             self._send_segment(seq, seg)
 
     def _parse_stream(self) -> None:
@@ -191,11 +276,19 @@ class RUDPEndpoint:
             while not self.closed:
                 await asyncio.sleep(TICK_INTERVAL)
                 now = asyncio.get_running_loop().time()
+                timed_out = False
                 for seq, ent in self._unacked.items():
                     if now >= ent[1]:
-                        ent[2] = min(ent[2] * 2.0, RTO_MAX)
+                        ent[2] = min(ent[2] * RTO_BACKOFF, RTO_MAX)
                         ent[1] = now + ent[2]
+                        ent[4] += 1
+                        ent[5] = 0
+                        timed_out = True
+                        self.timeout_resends += 1
                         self._send_segment(seq, ent[0])
+                if timed_out and self._congestion:
+                    self._ssthresh = max(len(self._unacked) / 2.0, 2.0)
+                    self._cwnd = 1.0
         except asyncio.CancelledError:
             pass
 
@@ -281,8 +374,13 @@ class RUDPListener(asyncio.DatagramProtocol):
     by conv id (GateService.go:134-165 serves KCP beside TCP the same way).
     ``on_accept(pconn)`` fires for each new conversation."""
 
-    def __init__(self, on_accept: Callable[[RUDPPacketConnection], None]) -> None:
+    def __init__(
+        self,
+        on_accept: Callable[[RUDPPacketConnection], None],
+        congestion: bool = False,
+    ) -> None:
         self._on_accept = on_accept
+        self._congestion = congestion
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._convs: dict[int, RUDPEndpoint] = {}
         self._addrs: dict[int, tuple] = {}
@@ -303,6 +401,7 @@ class RUDPListener(asyncio.DatagramProtocol):
                 conv,
                 lambda d, c=conv: self._send_to(c, d),
                 on_close=lambda e: self._forget(e.conv),
+                congestion=self._congestion,
             )
             ep.loss_simulation = self.loss_simulation
             self._convs[conv] = ep
@@ -342,10 +441,13 @@ class _RUDPClientProtocol(asyncio.DatagramProtocol):
 
 
 async def connect_rudp(
-    host: str, port: int, loss_simulation: float = 0.0
+    host: str, port: int, loss_simulation: float = 0.0,
+    congestion: bool = False,
 ) -> RUDPPacketConnection:
     """Client side: open a UDP flow and return a PacketConnection-shaped
-    transport (conversation id chosen randomly, kcp style)."""
+    transport (conversation id chosen randomly, kcp style). ``congestion``
+    enables slow-start/AIMD for adverse networks (default matches the
+    reference's turbo nc=1: off)."""
     loop = asyncio.get_running_loop()
     ref: list = [None]
     transport, _ = await loop.create_datagram_endpoint(
@@ -356,6 +458,7 @@ async def connect_rudp(
         conv,
         transport.sendto,
         on_close=lambda e: transport.close(),
+        congestion=congestion,
     )
     ep.loss_simulation = loss_simulation
     ref[0] = ep

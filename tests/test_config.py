@@ -29,8 +29,10 @@ SAMPLE = textwrap.dedent(
     save_interval = 600
 
     [game1]
+    aoi_platform = tpu
     [game2]
     log_level = debug
+    aoi_platform = cpu
 
     [gate_common]
     host = 127.0.0.1
@@ -92,6 +94,22 @@ def test_storage_kvdb_aoi(cfg):
     assert cfg.kvdb.type == "filesystem"
     assert cfg.aoi.backend == "xzlist"
     assert cfg.aoi.max_entities == 4096
+
+
+def test_per_game_aoi_platform(cfg, tmp_path):
+    """One game may ride the chip while the rest force CPU (single-client
+    TPU transports); invalid values fail loudly like [aoi] platform."""
+    assert cfg.games[1].aoi_platform == "tpu"
+    assert cfg.games[2].aoi_platform == "cpu"
+    bad = SAMPLE.replace("aoi_platform = tpu", "aoi_platform = gpu")
+    p = tmp_path / "badplat.ini"
+    p.write_text(bad)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match="aoi_platform"):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
 
 
 def test_duplicate_addr_rejected(tmp_path):

@@ -143,6 +143,83 @@ def test_sharded_fast_path_parity(backend):
 
 
 @pytest.mark.slow
+def test_pod_1m_sharded_shape_validation():
+    """BASELINE config 5 at FULL slot count: the 1,048,576-slot sharded
+    engine compiles and steps on the 8-device CPU mesh (VERDICT r3 #6 —
+    nothing had ever stepped the 1M configuration). Assertions:
+
+    - sharded == single-device event streams, both ticks (full equality,
+      not a sample) — the storm tick pages each shard's chunked drain;
+    - an independent numpy brute-force oracle over 256 sampled entities
+      (the 'subsampled oracle') agrees with both;
+    - zero grid drops at production-shaped density (per-cell lambda=1;
+      same-slot spaces whose dense regions hash-collide onto a shared
+      bucket stack to lambda=2, still far inside cell_capacity=24 — at
+      lambda=4 the 1M-bucket Poisson tail really does overflow: measured
+      2 drops in the first run of this test);
+    - the 1M config runs the table build's argsort fallback branch
+      ((num_buckets+1)*capacity >= 2^31) at its real production scale.
+
+    Scaling note: per-shard memory is the [N/D, 9*cell_capacity] candidate
+    block (~113 MB i32 here); a v5e-16 pod shards the same program over 16
+    chips with the all-gather riding ICI — the shapes validated here are
+    the pod shapes with D=8 instead of 16.
+    """
+    n = 1_048_576
+    n_spaces = 64
+    p = NeighborParams(
+        capacity=n, cell_size=100.0, grid_x=512, grid_z=512,
+        space_slots=4, cell_capacity=24, max_events=524288,
+    )
+    assert (p.num_buckets + 1) * p.capacity >= 2**31  # argsort fallback
+    mesh = make_mesh(8)
+    single = NeighborEngine(p, backend="jnp")
+    sharded = ShardedNeighborEngine(p, mesh, backend="jnp")
+    single.reset()
+    sharded.reset()
+    rng = np.random.default_rng(9)
+    # Each space's population clusters in its own 12800-unit region (game
+    # worlds are dense, not uniform over the torus): ~0.8 AOI neighbors
+    # per entity -> a ~800k-pair first-tick storm through per-shard paging.
+    space = (np.arange(n) % n_spaces).astype(np.int32)
+    origin = rng.uniform(0, 51200.0 - 12800.0, (n_spaces, 2)).astype(np.float32)
+    pos = (
+        origin[space] + rng.uniform(0, 12800.0, (n, 2))
+    ).astype(np.float32)
+    active = np.ones(n, bool)
+    radius = np.full(n, 50.0, np.float32)
+
+    def subsample_oracle(pos, sample):
+        """Exact interest sets for the sampled entities, chunked numpy."""
+        sets = {}
+        for i in sample:
+            same = space == space[i]
+            d2 = np.sum((pos - pos[i]) ** 2, axis=1)
+            members = np.flatnonzero(same & (d2 <= 50.0 * 50.0) & active)
+            sets[int(i)] = set(int(j) for j in members if j != i)
+        return sets
+
+    sample = rng.choice(n, 256, replace=False)
+    for tick in range(2):
+        e1, l1, d1 = single.step(pos, active, space, radius)
+        e2, l2, d2 = sharded.step(pos, active, space, radius)
+        assert d1 == d2 == 0
+        assert to_sets(e1, n) == to_sets(e2, n), f"enters differ @ {tick}"
+        assert to_sets(l1, n) == to_sets(l2, n), f"leaves differ @ {tick}"
+        if tick == 0:
+            # The storm must overflow the per-shard inline budget (65,536)
+            # so the 1M-scale chunked paging really runs.
+            assert len(e1) > p.max_events, (len(e1), p.max_events)
+            storm = to_sets(e1, n)
+            want = subsample_oracle(pos, sample)
+            for i, members in want.items():
+                assert storm[i] == members, f"oracle mismatch @ entity {i}"
+        pos = np.clip(
+            pos + rng.normal(0, 3, pos.shape), 0, 51200.0
+        ).astype(np.float32)
+
+
+@pytest.mark.slow
 def test_sharded_structural_at_scale():
     """BASELINE config 5 is 1M entities over a v5e-16 pod; real multi-chip
     hardware isn't reachable here, so validate the STRUCTURE at the largest

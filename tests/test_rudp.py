@@ -31,7 +31,7 @@ from test_gate import (  # the in-process 1x1x1 e2e stack
 )
 
 
-def _pipe_pair(loss_a=0.0, loss_b=0.0):
+def _pipe_pair(loss_a=0.0, loss_b=0.0, congestion=False):
     """Two endpoints joined by an in-memory datagram pipe with optional
     per-direction loss (loss is applied by the endpoints themselves)."""
     ref = {}
@@ -48,7 +48,7 @@ def _pipe_pair(loss_a=0.0, loss_b=0.0):
             ref["a"].on_datagram, cmd, seq, ack, data[_HDR.size:]
         )
 
-    a = RUDPEndpoint(7, to_b)
+    a = RUDPEndpoint(7, to_b, congestion=congestion)
     b = RUDPEndpoint(7, to_a)
     a.loss_simulation = loss_a
     b.loss_simulation = loss_b
@@ -86,6 +86,125 @@ def test_rudp_large_message_fragmentation():
         mt, p = await asyncio.wait_for(b.recv_packet(), 30)
         assert mt == 9 and p.payload == big
         assert len(big) > MSS * 10
+        a.close(); b.close()
+
+    asyncio.run(run())
+
+
+def test_rudp_adaptive_rto_tracks_rtt():
+    """The RTO must converge toward the path RTT (Jacobson/Karels over
+    Karn-filtered samples) instead of staying at the static default: on a
+    lossless ~instant pipe, enough acked segments should pull rto to the
+    30 ms KCP floor."""
+    async def run():
+        a, b = _pipe_pair()
+        for i in range(40):
+            a.send_bytes(_frame(1, b"x" * 100))
+        async def drain():
+            for _ in range(40):
+                await b.recv_packet()
+        await asyncio.wait_for(drain(), 10)
+        await asyncio.sleep(0.05)  # let the last acks land
+        assert a.srtt > 0.0, "no RTT samples collected"
+        assert a.rto == pytest.approx(0.03, abs=0.005), a.rto
+        a.close(); b.close()
+
+    asyncio.run(run())
+
+
+def test_rudp_fast_resend_beats_rto():
+    """KCP fast resend: when newer segments are acked past a lost one, the
+    lost segment must retransmit on the skip count (2 acks), not wait for
+    its full RTO — detected by completion before any timeout could fire."""
+    async def run():
+        a, b = _pipe_pair()
+        # Drop EXACTLY the first DATA transmission of seq 0, nothing else.
+        orig = a._transmit
+        dropped = []
+        def lossy(data):
+            conv, cmd, seq, ack = _HDR.unpack_from(data, 0)
+            if cmd == 1 and seq == 0 and not dropped:
+                dropped.append(seq)
+                return
+            orig(data)
+        a._transmit = lossy
+        # Pin a long RTO so only fast resend can recover quickly.
+        a.rto = 0.8
+        a.srtt = 0.8  # freeze the estimator high
+        msgs = [_frame(i, b"p" * 50) for i in range(1, 8)]
+        for m in msgs:
+            a.send_bytes(m)
+        t0 = asyncio.get_running_loop().time()
+        async def drain():
+            for _ in range(len(msgs)):
+                await b.recv_packet()
+        await asyncio.wait_for(drain(), 5)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert dropped, "the loss hook never fired"
+        assert a.fast_resends >= 1, "recovery did not use fast resend"
+        # Well under the 0.8 s RTO: recovery rode the skip-count path.
+        assert elapsed < 0.4, elapsed
+        a.close(); b.close()
+
+    asyncio.run(run())
+
+
+def test_rudp_loss_latency_matrix():
+    """VERDICT r3 #9 done-criterion: bounded completion under 10% and 20%
+    loss. 120 framed messages (~3 windows of segments) must deliver in
+    order within a wall-clock budget that only holds if recovery is
+    RTT-adaptive + fast-resend (static 50 ms-doubling RTO with 20% loss
+    routinely blew multi-second stalls)."""
+    async def run(loss):
+        a, b = _pipe_pair(loss_a=loss, loss_b=loss)
+        msgs = [(i, bytes([i % 251]) * (31 * i % 1500)) for i in range(1, 121)]
+        for mt, payload in msgs:
+            a.send_bytes(_frame(mt, payload))
+        got = []
+        t0 = asyncio.get_running_loop().time()
+        async def collect():
+            while len(got) < len(msgs):
+                got.append(await b.recv_packet())
+        await asyncio.wait_for(collect(), 20)
+        elapsed = asyncio.get_running_loop().time() - t0
+        assert [(mt, p.payload) for mt, p in got] == msgs
+        a.close(); b.close()
+        return elapsed, a.fast_resends, a.timeout_resends
+
+    async def matrix():
+        out = {}
+        for loss in (0.10, 0.20):
+            out[loss] = await run(loss)
+        return out
+
+    results = asyncio.run(matrix())
+    for loss, (elapsed, fast, timeouts) in results.items():
+        # Bounded completion: comfortably inside the asyncio.wait_for cap
+        # and sane in absolute terms for ~200 segments on a loopback pipe.
+        assert elapsed < 10.0, (loss, elapsed)
+    # At these loss rates the skip-count path must be doing real work.
+    assert sum(f for _, f, _ in results.values()) >= 1
+
+
+def test_rudp_congestion_mode_delivers_under_loss():
+    """congestion=True (slow-start/AIMD, off by default per the turbo nc=1
+    parity) must still deliver the full ordered stream under 15% loss; the
+    window provably throttled below the flow cap at some point."""
+    async def run():
+        a, b = _pipe_pair(loss_a=0.15, loss_b=0.15, congestion=True)
+        assert a._window() < 256  # starts in slow start, not the flow cap
+        msgs = [(i, bytes([i % 251]) * (29 * i % 1200)) for i in range(1, 81)]
+        for mt, payload in msgs:
+            a.send_bytes(_frame(mt, payload))
+        got = []
+        async def collect():
+            while len(got) < len(msgs):
+                got.append(await b.recv_packet())
+        await asyncio.wait_for(collect(), 20)
+        assert [(mt, p.payload) for mt, p in got] == msgs
+        # Loss recovery really ran under the congestion-managed window
+        # (cwnd itself may legitimately END at 1.0 after a late timeout).
+        assert a.fast_resends + a.timeout_resends > 0
         a.close(); b.close()
 
     asyncio.run(run())
