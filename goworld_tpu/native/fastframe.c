@@ -1,0 +1,273 @@
+/* _fastframe — C hot path for the wire framing every process runs.
+ *
+ * The reference's runtime is a compiled binary (Go); the Python port's
+ * per-packet costs (two asyncio awaits + struct packs + slicing per
+ * packet) dominate gate/dispatcher CPU at fleet scale (BENCH_NOTES:
+ * control-plane profile at 100 bots — framing + zlib + socket sends).
+ * This module batch-parses an entire received chunk in one call and
+ * builds framed send buffers without intermediate Python objects.
+ *
+ * Wire format (netutil/packet_conn.py, PacketConnection.go:50-186):
+ *   [u32 LE length | bit31 = zlib flag][u16 LE msgtype][payload]
+ * Length counts msgtype + payload (the post-inflate size must also stay
+ * within max_packet — decompression-bomb guard, matching the Python
+ * recv_packet's bounded inflate).
+ *
+ * API (mirrored exactly by native/pyframe.py — the parity fuzz suite in
+ * tests/test_native.py drives both):
+ *   split(data: bytes, max_packet: int) -> (frames, consumed, error)
+ *       frames = list[(msgtype: int, payload: bytes)], consumed = int
+ *       (caller keeps data[consumed:] as the remainder), error = None or
+ *       a str describing the malformed frame parsing STOPPED at (bad
+ *       length, bad zlib stream, inflate overflow). Frames before the
+ *       malformed one are still returned so no valid packet is lost to a
+ *       chunk boundary; the caller treats error as connection-fatal.
+ *   pack(msgtype: int, payload: bytes, compress: bool, threshold: int,
+ *        max_packet: int) -> bytes
+ *       One framed buffer; compresses at level 1 when enabled, the body
+ *       reaches threshold, and deflate actually shrinks it. ValueError
+ *       on msgtype outside u16 or oversize body.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+#include <zlib.h>
+
+#define COMPRESSED_BIT 0x80000000u
+#define LEN_MASK 0x7fffffffu
+
+static uint32_t rd_u32le(const unsigned char *p) {
+    return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+}
+
+/* Bounded inflate of src[0..n) into a fresh bytes object of at most cap
+ * bytes. The output buffer starts small (most compressed packets are
+ * small) and grows geometrically up to cap — never a cap-sized (25 MB)
+ * allocation per tiny frame. Returns NULL with ValueError set on any
+ * zlib error or cap overflow. */
+static PyObject *inflate_bounded(const unsigned char *src, Py_ssize_t n,
+                                 Py_ssize_t cap) {
+    Py_ssize_t size = n * 4 + 64;
+    if (size > cap) size = cap;
+    for (;;) {
+        PyObject *out = PyBytes_FromStringAndSize(NULL, size);
+        if (out == NULL) return NULL;
+        z_stream zs;
+        memset(&zs, 0, sizeof(zs));
+        if (inflateInit(&zs) != Z_OK) {
+            Py_DECREF(out);
+            PyErr_SetString(PyExc_ValueError, "inflateInit failed");
+            return NULL;
+        }
+        zs.next_in = (Bytef *)src;
+        zs.avail_in = (uInt)n;
+        zs.next_out = (Bytef *)PyBytes_AS_STRING(out);
+        zs.avail_out = (uInt)size;
+        int rc = inflate(&zs, Z_FINISH);
+        Py_ssize_t produced = size - (Py_ssize_t)zs.avail_out;
+        inflateEnd(&zs);
+        if (rc == Z_STREAM_END) {
+            if (_PyBytes_Resize(&out, produced) != 0) return NULL;
+            return out;
+        }
+        Py_DECREF(out);
+        int ran_out = (rc == Z_BUF_ERROR || rc == Z_OK) && zs.avail_out == 0;
+        if (ran_out && size < cap) {
+            size = size * 4 <= cap ? size * 4 : cap; /* grow, retry */
+            continue;
+        }
+        PyErr_SetString(PyExc_ValueError,
+                        ran_out ? "compressed packet exceeds size cap"
+                                : "bad compressed packet");
+        return NULL;
+    }
+}
+
+static PyObject *fastframe_split(PyObject *self, PyObject *args) {
+    Py_buffer view;
+    Py_ssize_t max_packet;
+    if (!PyArg_ParseTuple(args, "y*n", &view, &max_packet)) return NULL;
+    const unsigned char *buf = (const unsigned char *)view.buf;
+    Py_ssize_t len = view.len;
+    Py_ssize_t off = 0;
+    const char *err = NULL; /* static message: stop-and-report, not raise */
+    PyObject *err_obj = NULL; /* owned message from a raising helper */
+
+    PyObject *frames = PyList_New(0);
+    if (frames == NULL) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    while (len - off >= 4) {
+        uint32_t raw = rd_u32le(buf + off);
+        int compressed = (raw & COMPRESSED_BIT) != 0;
+        Py_ssize_t body_len = (Py_ssize_t)(raw & LEN_MASK);
+        if (body_len < 2 || body_len > max_packet) {
+            err_obj = PyUnicode_FromFormat("bad packet length %zd", body_len);
+            if (err_obj == NULL) goto fail;
+            break;
+        }
+        if (len - off - 4 < body_len) break; /* incomplete frame */
+        const unsigned char *body = buf + off + 4;
+        PyObject *payload;
+        unsigned int msgtype;
+        if (compressed) {
+            PyObject *inflated =
+                inflate_bounded(body, body_len, max_packet);
+            if (inflated == NULL) {
+                /* Convert the helper's ValueError into the stop-and-
+                 * report contract (frames so far still delivered). */
+                PyObject *tp, *val, *tb;
+                PyErr_Fetch(&tp, &val, &tb);
+                err_obj = val ? PyObject_Str(val) : NULL;
+                Py_XDECREF(tp);
+                Py_XDECREF(val);
+                Py_XDECREF(tb);
+                if (err_obj == NULL) err = "bad compressed packet";
+                break;
+            }
+            Py_ssize_t ilen = PyBytes_GET_SIZE(inflated);
+            if (ilen < 2) {
+                Py_DECREF(inflated);
+                err = "bad decompressed length";
+                break;
+            }
+            const unsigned char *ib =
+                (const unsigned char *)PyBytes_AS_STRING(inflated);
+            msgtype = (unsigned int)ib[0] | ((unsigned int)ib[1] << 8);
+            payload = PyBytes_FromStringAndSize((const char *)ib + 2,
+                                                ilen - 2);
+            Py_DECREF(inflated);
+        } else {
+            msgtype = (unsigned int)body[0] | ((unsigned int)body[1] << 8);
+            payload = PyBytes_FromStringAndSize((const char *)body + 2,
+                                                body_len - 2);
+        }
+        if (payload == NULL) goto fail;
+        PyObject *tup = Py_BuildValue("(IN)", msgtype, payload);
+        if (tup == NULL) goto fail;
+        int rc = PyList_Append(frames, tup);
+        Py_DECREF(tup);
+        if (rc != 0) goto fail;
+        off += 4 + body_len;
+    }
+    PyBuffer_Release(&view);
+    if (err_obj != NULL) return Py_BuildValue("(NnN)", frames, off, err_obj);
+    if (err != NULL) return Py_BuildValue("(Nns)", frames, off, err);
+    return Py_BuildValue("(NnO)", frames, off, Py_None);
+fail:
+    Py_XDECREF(err_obj);
+    Py_DECREF(frames);
+    PyBuffer_Release(&view);
+    return NULL;
+}
+
+static PyObject *fastframe_pack(PyObject *self, PyObject *args) {
+    unsigned int msgtype;
+    Py_buffer view;
+    int compress;
+    Py_ssize_t threshold, max_packet;
+    if (!PyArg_ParseTuple(args, "Iy*pnn", &msgtype, &view, &compress,
+                          &threshold, &max_packet))
+        return NULL;
+    if (msgtype > 0xFFFF) {
+        PyBuffer_Release(&view);
+        PyErr_Format(PyExc_ValueError, "msgtype %u out of u16 range",
+                     msgtype);
+        return NULL;
+    }
+    Py_ssize_t plen = view.len;
+    Py_ssize_t body_len = 2 + plen;
+    if (body_len > max_packet) {
+        PyBuffer_Release(&view);
+        PyErr_Format(PyExc_ValueError, "packet too large: %zd", body_len);
+        return NULL;
+    }
+    uint32_t flag = 0;
+
+    if (compress && body_len >= threshold) {
+        /* Deflate [msgtype][payload] at level 1 (KCP/zlib parity with the
+         * Python path); keep only if it actually shrinks. */
+        uLong bound = compressBound((uLong)body_len);
+        PyObject *tmp = PyBytes_FromStringAndSize(NULL, (Py_ssize_t)bound);
+        if (tmp == NULL) {
+            PyBuffer_Release(&view);
+            return NULL;
+        }
+        unsigned char hdr[2] = {(unsigned char)(msgtype & 0xff),
+                                (unsigned char)((msgtype >> 8) & 0xff)};
+        z_stream zs;
+        memset(&zs, 0, sizeof(zs));
+        int ok = deflateInit(&zs, 1) == Z_OK;
+        Py_ssize_t clen = 0;
+        if (ok) {
+            zs.next_out = (Bytef *)PyBytes_AS_STRING(tmp);
+            zs.avail_out = (uInt)bound;
+            zs.next_in = hdr;
+            zs.avail_in = 2;
+            ok = deflate(&zs, Z_NO_FLUSH) == Z_OK;
+            if (ok) {
+                zs.next_in = (Bytef *)view.buf;
+                zs.avail_in = (uInt)plen;
+                ok = deflate(&zs, Z_FINISH) == Z_STREAM_END;
+            }
+            clen = (Py_ssize_t)zs.total_out;
+            deflateEnd(&zs);
+        }
+        if (ok && clen < body_len) {
+            PyObject *out = PyBytes_FromStringAndSize(NULL, 4 + clen);
+            if (out == NULL) {
+                Py_DECREF(tmp);
+                PyBuffer_Release(&view);
+                return NULL;
+            }
+            unsigned char *w = (unsigned char *)PyBytes_AS_STRING(out);
+            uint32_t raw = (uint32_t)clen | COMPRESSED_BIT;
+            w[0] = raw & 0xff;
+            w[1] = (raw >> 8) & 0xff;
+            w[2] = (raw >> 16) & 0xff;
+            w[3] = (raw >> 24) & 0xff;
+            memcpy(w + 4, PyBytes_AS_STRING(tmp), clen);
+            Py_DECREF(tmp);
+            PyBuffer_Release(&view);
+            return out;
+        }
+        Py_DECREF(tmp);
+        (void)flag;
+    }
+
+    PyObject *out = PyBytes_FromStringAndSize(NULL, 4 + body_len);
+    if (out == NULL) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    unsigned char *w = (unsigned char *)PyBytes_AS_STRING(out);
+    uint32_t raw = (uint32_t)body_len;
+    w[0] = raw & 0xff;
+    w[1] = (raw >> 8) & 0xff;
+    w[2] = (raw >> 16) & 0xff;
+    w[3] = (raw >> 24) & 0xff;
+    w[4] = msgtype & 0xff;
+    w[5] = (msgtype >> 8) & 0xff;
+    memcpy(w + 6, view.buf, plen);
+    PyBuffer_Release(&view);
+    return out;
+}
+
+static PyMethodDef methods[] = {
+    {"split", fastframe_split, METH_VARARGS,
+     "split(data, max_packet) -> (frames, consumed, error)"},
+    {"pack", fastframe_pack, METH_VARARGS,
+     "pack(msgtype, payload, compress, threshold, max_packet) -> bytes"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastframe",
+    "C hot path for goworld wire framing", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__fastframe(void) { return PyModule_Create(&moduledef); }

@@ -1,0 +1,72 @@
+"""Pure-Python reference implementation of the _fastframe surface.
+
+Semantics must match fastframe.c exactly — the parity fuzz suite in
+tests/test_native.py drives both over the same corpus. This is also the
+fallback when the C module can't build (GWT_NO_NATIVE=1, no compiler).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+
+_LEN = struct.Struct("<I")
+_COMPRESSED_BIT = 0x80000000
+_LEN_MASK = 0x7FFFFFFF
+
+
+def split(data, max_packet: int):
+    """Parse complete frames out of ``data``.
+
+    Returns (frames, consumed, error) where frames =
+    [(msgtype, payload_bytes)] and error is None or a str describing the
+    malformed frame parsing STOPPED at (bad length, bad zlib stream,
+    bounded-inflate overflow). Frames parsed before the malformed one are
+    still returned — callers deliver them, then treat error as a
+    connection-fatal condition.
+    """
+    buf = bytes(data)
+    frames = []
+    off = 0
+    n = len(buf)
+    while n - off >= 4:
+        (raw,) = _LEN.unpack_from(buf, off)
+        compressed = bool(raw & _COMPRESSED_BIT)
+        body_len = raw & _LEN_MASK
+        if body_len < 2 or body_len > max_packet:
+            return frames, off, f"bad packet length {body_len}"
+        if n - off - 4 < body_len:
+            break  # incomplete frame
+        body = buf[off + 4 : off + 4 + body_len]
+        if compressed:
+            try:
+                d = zlib.decompressobj()
+                body = d.decompress(body, max_packet)
+                if d.unconsumed_tail or not d.eof:
+                    return frames, off, "compressed packet exceeds size cap"
+            except zlib.error as exc:
+                return frames, off, f"bad compressed packet: {exc}"
+            if len(body) < 2:
+                return frames, off, "bad decompressed length"
+        msgtype = body[0] | (body[1] << 8)
+        frames.append((msgtype, body[2:]))
+        off += 4 + body_len
+    return frames, off, None
+
+
+def pack(msgtype: int, payload, compress: bool, threshold: int,
+         max_packet: int) -> bytes:
+    """Build one framed buffer (optionally zlib level 1 when it shrinks)."""
+    if not 0 <= msgtype <= 0xFFFF:
+        raise ValueError(f"msgtype {msgtype} out of u16 range")
+    payload = bytes(payload)
+    body = struct.pack("<H", msgtype) + payload
+    if len(body) > max_packet:
+        raise ValueError(f"packet too large: {len(body)}")
+    flag = 0
+    if compress and len(body) >= threshold:
+        deflated = zlib.compress(body, 1)
+        if len(deflated) < len(body):
+            body = deflated
+            flag = _COMPRESSED_BIT
+    return _LEN.pack(len(body) | flag) + body

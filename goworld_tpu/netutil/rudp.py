@@ -48,7 +48,7 @@ import random
 import struct
 from typing import Callable, Optional
 
-from goworld_tpu import consts
+from goworld_tpu import consts, native
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed
 
@@ -242,32 +242,17 @@ class RUDPEndpoint:
 
     def _parse_stream(self) -> None:
         """Parse [u32 len][u16 msgtype][payload] frames (TCP framing) out of
-        the ordered stream."""
-        buf = self._instream
-        while True:
-            if len(buf) < 4:
-                break
-            (raw_len,) = struct.unpack_from("<I", buf, 0)
-            length = raw_len & 0x7FFFFFFF
-            if length > consts.MAX_PACKET_SIZE:
-                self.close()
-                return
-            if len(buf) < 4 + length:
-                break
-            body = bytes(buf[4:4 + length])
-            del buf[:4 + length]
-            if raw_len >> 31:
-                import zlib
-
-                try:
-                    body = zlib.decompress(body)
-                except zlib.error:
-                    self.close()
-                    return
-            if len(body) < 2:
-                continue
-            (msgtype,) = struct.unpack_from("<H", body, 0)
-            self._packets.put_nowait((msgtype, Packet(body[2:])))
+        the ordered stream — batch-deframed via native.split (C when
+        available), with the same bounded-inflate guard as the TCP path."""
+        frames, consumed, err = native.split(
+            self._instream, consts.MAX_PACKET_SIZE
+        )
+        if consumed:
+            del self._instream[:consumed]
+        for msgtype, payload in frames:
+            self._packets.put_nowait((msgtype, Packet(payload)))
+        if err is not None:
+            self.close()  # malformed stream (frames before it delivered)
 
     # --- retransmit ---------------------------------------------------------
 
@@ -333,19 +318,12 @@ class RUDPPacketConnection:
         self._compress = True
 
     def send_packet(self, msgtype: int, packet: Packet) -> None:
-        payload = packet.payload
-        body = struct.pack("<H", msgtype) + payload
-        if 2 + len(payload) > consts.MAX_PACKET_SIZE:
-            raise ValueError(f"packet too large: {2 + len(payload)}")
-        flag = 0
-        if self._compress and len(body) >= 64:
-            import zlib
-
-            deflated = zlib.compress(body, 1)
-            if len(deflated) < len(body):
-                body = deflated
-                flag = 1 << 31
-        self._ep.send_bytes(struct.pack("<I", len(body) | flag) + body)
+        self._ep.send_bytes(
+            native.pack(
+                msgtype, packet.payload, self._compress, 64,
+                consts.MAX_PACKET_SIZE,
+            )
+        )
 
     def flush(self) -> None:
         pass  # segments transmit immediately; ARQ handles the rest

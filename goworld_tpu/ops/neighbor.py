@@ -92,17 +92,22 @@ class NeighborParams:
     space_slots: int = 8  # space-id folding slots for the shared grid
     cell_capacity: int = 64  # M: max entities visible per grid cell
     max_events: int = 65536  # enter/leave pairs fetched per host round trip
-    # Pallas-drain word-select strategy (identical results, different
-    # gather shapes — the on-chip microbench promotes the winner):
-    #   bsearch: ceil(log2(W+1)) random scalar gathers per event
-    #   grouped: two contiguous-row gathers ([E, G] group cumsums, then
-    #            [E, W/G] words) + prefix compares
+    # Pallas-drain select strategy (identical results, different gather/
+    # scatter shapes — the on-chip bench sweep promotes the winner):
+    #   bsearch: searchsorted row-find (log2(N) gathers/event) + binary-
+    #            search word-find (log2(W) random scalar gathers/event)
+    #   grouped: searchsorted row-find + two contiguous-row gathers
+    #            ([E, G] group cumsums, then [E, W/G] words) per event
+    #   scatter: one [N]→[E] scatter + cummax fill for the row-find
+    #            (row-of-rank is a monotonic step function over the
+    #            contiguous requested range) + the grouped word-find
     drain_mode: str = "bsearch"
 
     def __post_init__(self) -> None:
-        if self.drain_mode not in ("bsearch", "grouped"):
+        if self.drain_mode not in ("bsearch", "grouped", "scatter"):
             raise ValueError(
-                f"drain_mode must be bsearch|grouped, got {self.drain_mode!r}"
+                f"drain_mode must be bsearch|grouped|scatter, "
+                f"got {self.drain_mode!r}"
             )
         if self.grid_x < 4 or self.grid_z < 4:
             # 3x3 neighborhoods must touch 9 distinct buckets after wrap.
@@ -611,8 +616,33 @@ def _drain_bits(
 
     j = start_rank + jnp.arange(max_events, dtype=jnp.int32)
     valid = j < total
-    row = jnp.searchsorted(row_starts, j, side="right").astype(jnp.int32) - 1
-    row = jnp.clip(row, 0, n - 1)
+    if p.drain_mode == "scatter":
+        # Row-of-rank over the CONTIGUOUS range [start, start+E) is a
+        # monotonic step function: each row with events intersecting the
+        # range claims its first output position (one [N]→[E] scatter-max;
+        # at most one row straddles `start`, and distinct rows have
+        # distinct starts, so positions are unique), then cummax fills
+        # forward — replacing searchsorted's log2(N) gather passes. The
+        # scatter target is max_events-sized, nothing like the 118M-slot
+        # round-2 pathology.
+        first_pos = row_starts - start_rank
+        intersects = (row_counts > 0) & (row_cum > start_rank) & (
+            first_pos < max_events
+        )
+        target = jnp.where(
+            intersects, jnp.maximum(first_pos, 0), max_events
+        )
+        seed = jnp.full((max_events,), -1, jnp.int32)
+        seed = seed.at[target].max(
+            jnp.arange(n, dtype=jnp.int32), mode="drop"
+        )
+        row = jnp.clip(jax.lax.cummax(seed), 0, n - 1)
+    else:
+        row = (
+            jnp.searchsorted(row_starts, j, side="right").astype(jnp.int32)
+            - 1
+        )
+        row = jnp.clip(row, 0, n - 1)
     k = j - row_starts[row]  # event rank within its row
 
     # Word selection by binary search over the row's inclusive word-count
@@ -622,7 +652,7 @@ def _drain_bits(
     # on-chip 2026-07-30.)
     nw = pc.shape[1]
     word_cum = jnp.cumsum(pc, axis=1)  # [N, W] inclusive
-    if p.drain_mode == "grouped":
+    if p.drain_mode in ("grouped", "scatter"):
         # Two-level select via CONTIGUOUS row gathers: the bsearch mode's
         # ~log2(W) random scalar gathers per event are latency-bound on
         # TPU; here each event pulls its row's [G] group cumsums and the

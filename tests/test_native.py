@@ -1,0 +1,149 @@
+"""Parity suite for the native (C) wire-framing hot path.
+
+fastframe.c and its pure-Python reference (native/pyframe.py) must be
+byte-for-byte interchangeable: every frame one packs, both split
+identically; every malformed input one stops at, both stop at, with the
+frames before it still delivered. The C build is expected to succeed in
+this image (cc + zlib are baked in) — the suite fails loudly if the
+import silently degraded, because then the cluster would be running the
+slow path without anyone noticing.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+
+import pytest
+
+from goworld_tpu import native
+from goworld_tpu.native import pyframe
+
+MAXP = 1 << 20
+
+
+def impls():
+    out = [("python", pyframe.split, pyframe.pack)]
+    if native.IMPL == "c":
+        out.append(("c", native.split, native.pack))
+    return out
+
+
+def test_c_module_built():
+    """The image ships cc + zlib: the C path must actually be live (a
+    silent fallback would quietly lose the hot-path win)."""
+    if os.environ.get("GWT_NO_NATIVE") == "1":
+        pytest.skip("native explicitly disabled")
+    assert native.IMPL == "c"
+
+
+def test_pack_split_round_trip_cross_impl():
+    """Frames packed by either impl split identically under BOTH impls,
+    compressed and not, across a fuzzed corpus."""
+    import random
+
+    rng = random.Random(3)
+    msgs = []
+    for i in range(200):
+        mt = rng.randrange(0, 65536)
+        n = rng.choice([0, 1, 2, 63, 64, 256, 1000, 5000])
+        payload = bytes(rng.getrandbits(8) for _ in range(min(n, 200))) * (
+            max(1, n // 200)
+        )
+        payload = payload[:n]
+        compress = rng.random() < 0.5
+        msgs.append((mt, payload, compress))
+
+    for pname, _, ppack in impls():
+        stream = b"".join(ppack(mt, pl, c, 64, MAXP) for mt, pl, c in msgs)
+        for sname, ssplit, _ in impls():
+            frames, consumed, err = ssplit(stream, MAXP)
+            assert err is None, (pname, sname)
+            assert consumed == len(stream), (pname, sname)
+            assert [(mt, bytes(pl)) for mt, pl, _ in msgs] == [
+                (mt, bytes(p)) for mt, p in frames
+            ], (pname, sname)
+
+
+def test_split_partial_frames():
+    """Chunked feeding: split consumes only complete frames; the caller's
+    remainder plus the next chunk parses the rest — byte-identical across
+    impls at every split point."""
+    packed = [
+        pyframe.pack(7, b"a" * 300, True, 64, MAXP),
+        pyframe.pack(9, b"b" * 10, False, 64, MAXP),
+        pyframe.pack(11, b"", False, 64, MAXP),
+    ]
+    stream = b"".join(packed)
+    for cut in range(0, len(stream) + 1, 7):
+        for name, split, _ in impls():
+            f1, c1, e1 = split(stream[:cut], MAXP)
+            rest = stream[c1:cut] + stream[cut:]
+            f2, c2, e2 = split(rest, MAXP)
+            assert e1 is None and e2 is None, (name, cut)
+            got = [(mt, bytes(p)) for mt, p in f1 + f2]
+            assert got == [(7, b"a" * 300), (9, b"b" * 10), (11, b"")], (
+                name, cut
+            )
+
+
+def test_split_stops_at_malformed_keeping_prior_frames():
+    """Valid frames preceding a malformed one are DELIVERED, with the
+    error reported and consumed pointing at the bad frame — no valid
+    packet may be lost to a chunk boundary (code-review r4)."""
+    good = pyframe.pack(5, b"ok", False, 64, MAXP)
+    cases = {
+        "too_big": struct.pack("<I", MAXP + 1) + b"x" * 10,
+        "bad_zlib": struct.pack("<I", 10 | 0x80000000) + b"notzlibbb!",
+        "tiny": struct.pack("<I", 1) + b"x",
+        "under": (lambda s: struct.pack("<I", len(s) | 0x80000000) + s)(
+            zlib.compress(b"z", 1)
+        ),
+    }
+    for case, bad in cases.items():
+        for name, split, _ in impls():
+            frames, consumed, err = split(good + good + bad, MAXP)
+            assert err is not None, (name, case)
+            assert consumed == 2 * len(good), (name, case)
+            assert [(mt, bytes(p)) for mt, p in frames] == [
+                (5, b"ok"), (5, b"ok")
+            ], (name, case)
+
+
+def test_split_bounded_inflate_bomb_guard():
+    """A deflate bomb whose inflated size exceeds max_packet must be
+    rejected, not ballooned (both impls)."""
+    bomb_body = struct.pack("<H", 5) + b"\x00" * (4 << 20)  # inflates to 4MB+2
+    deflated = zlib.compress(bomb_body, 9)
+    frame = struct.pack("<I", len(deflated) | 0x80000000) + deflated
+    cap = 1 << 20  # 1MB cap < 4MB inflated
+    for name, split, _ in impls():
+        frames, consumed, err = split(frame, cap)
+        assert frames == [] and consumed == 0, name
+        assert err is not None and "cap" in err, (name, err)
+    # Same frame passes under a big-enough cap — the guard is the cap, not
+    # the compression ratio (and the C side's growing buffer reaches it).
+    for name, split, _ in impls():
+        frames, consumed, err = split(frame, 8 << 20)
+        assert err is None, name
+        assert frames == [(5, b"\x00" * (4 << 20))], name
+
+
+def test_pack_rejects_oversize_and_bad_msgtype():
+    for name, _, pack in impls():
+        with pytest.raises(ValueError):
+            pack(1, b"x" * MAXP, False, 64, MAXP)
+        with pytest.raises(ValueError):
+            pack(70000, b"x", False, 64, MAXP)
+
+
+def test_pack_skips_unhelpful_compression():
+    """Incompressible payloads ship uncompressed even with compress on
+    (flag bit clear), in both impls."""
+    payload = os.urandom(1000)
+    for name, _, pack in impls():
+        buf = pack(3, payload, True, 64, MAXP)
+        (raw,) = struct.unpack_from("<I", buf, 0)
+        assert not (raw & 0x80000000), name
+        assert buf[6:] == payload

@@ -229,21 +229,22 @@ def test_chunked_drain_small_buffer(backend):
     assert total > 3 * p.max_events
 
 
-def test_grouped_drain_matches_bsearch():
-    """drain_mode=grouped must produce the identical event stream as the
-    default bsearch select, including under storm paging (tiny max_events
-    forces many chunks through the grouped path's group/word compares)."""
+def test_drain_modes_match_bsearch():
+    """drain_mode=grouped and drain_mode=scatter must produce the identical
+    event stream as the default bsearch select, including under storm
+    paging (tiny max_events forces many chunks through each mode's
+    row-find and group/word compares)."""
     base = dict(
         capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64,
     )
     rng = np.random.default_rng(11)
-    # 64 forces storm paging through the grouped path; 8192 covers the
-    # non-paging shape (> any event count this world produces) without the
-    # compile cost of a production-sized budget.
+    # 64 forces storm paging; 8192 covers the non-paging shape (> any
+    # event count this world produces) without the compile cost of a
+    # production-sized budget.
     for max_events in (64, 8192):
         engines = {}
-        for mode in ("bsearch", "grouped"):
+        for mode in ("bsearch", "grouped", "scatter"):
             p = NeighborParams(max_events=max_events, drain_mode=mode, **base)
             engines[mode] = NeighborEngine(p, backend="pallas_interpret")
             engines[mode].reset()
@@ -255,8 +256,11 @@ def test_grouped_drain_matches_bsearch():
             }
             for which in (0, 1):
                 a = np.asarray(results["bsearch"][which])
-                b = np.asarray(results["grouped"][which])
-                assert np.array_equal(a, b), (tick, which, max_events)
+                for mode in ("grouped", "scatter"):
+                    b = np.asarray(results[mode][which])
+                    assert np.array_equal(a, b), (
+                        tick, which, max_events, mode
+                    )
             pos = pos + rng.uniform(-30, 30, pos.shape).astype(np.float32)
 
 
