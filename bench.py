@@ -421,6 +421,22 @@ def bench_phase_profile(n: int = 102400, cell: float = 300.0,
         return nb._drain_bits(p, packed_e, cx, cz, sm, table, jnp.int32(0))
 
     out["drain_ms"] = t(phase_drain, packed_e, cx, cz, sm, table)
+    # Per-mode drain attribution: same inputs, each select strategy.
+    import dataclasses as _dc
+
+    for dm in DRAIN_SWEEP:
+        if dm == p.drain_mode:
+            out[f"drain_{dm}_ms"] = out["drain_ms"]
+            continue
+        pm = _dc.replace(p, drain_mode=dm)
+
+        def phase_drain_m(packed_e, cx, cz, sm, table, pm=pm):
+            return nb._drain_bits(pm, packed_e, cx, cz, sm, table,
+                                  jnp.int32(0))
+
+        out[f"drain_{dm}_ms"] = t(
+            jax.jit(phase_drain_m), packed_e, cx, cz, sm, table
+        )
     step = nb._jitted_step_packed(p, "pallas")
     cxp, czp, smp = nb._bins(p, ppos, spc)
     bucp = (smp * p.grid_z + czp) * p.grid_x + cxp
