@@ -175,6 +175,11 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
 
     if n is None:
         n = int(os.environ.get("BENCH_N", "102400"))  # ~100k entities
+    # Never fold into more slots than there are spaces: the kernel grid is
+    # space_slots * gz * gx programs, so a 1-space world on 4 slots runs
+    # 75% EMPTY slabs — full halo DMA + pair math on NaN rows (and 4x the
+    # table/feats footprint). The r3 headline paid exactly that.
+    space_slots = max(1, min(space_slots, n_spaces))
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
         # Pallas path: supercells (radius 100 still fits the 3x3 gather) for
@@ -342,7 +347,9 @@ def bench_boids() -> dict:
 def bench_phase_profile(n: int = 102400, cell: float = 300.0,
                         grid: int = 44) -> dict:
     """Attribute the tick budget: time each stage of the Pallas step in
-    isolation (VERDICT r2 #8 — name the phase that owns the p99 gap)."""
+    isolation (VERDICT r2 #8 — name the phase that owns the p99 gap).
+    space_slots=1 matches the headline config (one space, no empty
+    slabs)."""
     import jax
     import jax.numpy as jnp
 
@@ -350,7 +357,7 @@ def bench_phase_profile(n: int = 102400, cell: float = 300.0,
 
     p = nb.NeighborParams(
         capacity=n, cell_size=cell, grid_x=grid, grid_z=grid,
-        space_slots=4, cell_capacity=128, max_events=131072,
+        space_slots=1, cell_capacity=128, max_events=131072,
     )
     rng = np.random.default_rng(0)
     world = grid * cell
