@@ -229,6 +229,35 @@ def test_chunked_drain_small_buffer(backend):
     assert total > 3 * p.max_events
 
 
+def test_pallas_single_space_slot():
+    """space_slots=1 (the headline bench config after the empty-slab fix)
+    through the REAL kernel path (interpret): grid dim 1 on the slab axis
+    must produce oracle-exact events — this shape had no coverage and
+    chip day would otherwise run it first on hardware."""
+    p = NeighborParams(
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=1, cell_capacity=64, max_events=65536,
+    )
+    eng = NeighborEngine(p, backend="pallas_interpret")
+    ref = NeighborEngine(p, backend="jnp")
+    eng.reset()
+    ref.reset()
+    rng = np.random.default_rng(13)
+    pos, active, space, radius = make_world(256, 220, seed=13, n_spaces=1)
+    for tick in range(3):
+        enters, leaves, dropped = eng.step(pos, active, space, radius)
+        e2, l2, d2 = ref.step(pos, active, space, radius)
+        assert dropped == d2 == 0
+        assert pairs_to_setlist(enters, 256) == pairs_to_setlist(e2, 256)
+        assert pairs_to_setlist(leaves, 256) == pairs_to_setlist(l2, 256)
+        if tick == 0:
+            want = brute_force_sets(pos, active, space, radius)
+            assert pairs_to_setlist(enters, 256) == want
+        pos = np.clip(
+            pos + rng.normal(0, 20, pos.shape), 0, 1600
+        ).astype(np.float32)
+
+
 def test_drain_modes_match_bsearch():
     """drain_mode=grouped and drain_mode=scatter must produce the identical
     event stream as the default bsearch select, including under storm
