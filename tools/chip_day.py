@@ -1,0 +1,94 @@
+"""One-shot chip-session runner: everything queued for the moment the
+axon relay answers, in dependency order, with one log.
+
+    python -u tools/chip_day.py [--skip-cluster]
+
+Sequence (serialized — the tunnel is single-client):
+  1. relay probe (fast fail if 8082 refuses)
+  2. tools/quick_chip_check.py — oracle smoke + small pipelined bench
+  3. python bench.py (full: headline + sweeps incl. drain modes + boids
+     + phases + self-tune) → JSON saved to BENCH_LOCAL_r04.json
+  4. unless --skip-cluster: 100-strict-bot cluster run with game1 ON the
+     chip (aoi_platform=tpu for game1 only, cpu for game2)
+
+Every subprocess inherits the env (JAX_PLATFORMS=axon stays — stripping
+it hangs autodiscovery). Never SIGKILL anything here: a killed
+chip-holding process wedges the relay for the rest of the round
+(BENCH_NOTES.md operational notes).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def probe_relay(port: int = 8082, timeout: float = 3.0) -> bool:
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout):
+            return True
+    except OSError:
+        return False
+
+
+def run(name: str, cmd: list[str], timeout: float) -> subprocess.CompletedProcess:
+    print(f"=== {name}: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    r = subprocess.run(cmd, cwd=REPO, timeout=timeout,
+                       capture_output=True, text=True)
+    dt = time.time() - t0
+    print(f"=== {name}: rc={r.returncode} ({dt:.0f}s)", flush=True)
+    if r.returncode != 0:
+        print(r.stdout[-2000:])
+        print(r.stderr[-2000:])
+    return r
+
+
+def main() -> int:
+    if not probe_relay():
+        print("relay CLOSED (8082 refused) — nothing to do")
+        return 1
+    print("relay OPEN — starting chip sequence", flush=True)
+
+    r = run("quick_check", [sys.executable, "-u", "tools/quick_chip_check.py"],
+            timeout=900)
+    if r.returncode != 0:
+        print("quick check failed; NOT proceeding to the full bench")
+        print(r.stdout[-3000:])
+        return 2
+    print(r.stdout[-1500:], flush=True)
+
+    r = run("bench", [sys.executable, "bench.py"], timeout=3600)
+    line = (r.stdout or "").strip().splitlines()
+    if line:
+        try:
+            data = json.loads(line[-1])
+            with open(os.path.join(REPO, "BENCH_LOCAL_r04.json"), "w") as f:
+                json.dump(data, f, indent=1)
+            print("headline:", data.get("value"), data.get("unit"),
+                  "backend:", data.get("actual_backend"),
+                  "vs_baseline:", data.get("vs_baseline"), flush=True)
+            phases = data.get("phases") or (
+                data.get("configs", {})
+                .get("default_config_headline", {})
+                .get("phases")
+            )
+            if phases:
+                print("phases:", phases, flush=True)
+        except json.JSONDecodeError:
+            print("bench output not JSON:", line[-1][:500])
+
+    if "--skip-cluster" not in sys.argv:
+        print("=== cluster-on-chip run is manual (needs ini + fleet); see "
+              "ROUND4.md chip queue", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
