@@ -39,6 +39,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from goworld_tpu.ops.neighbor import sorted_ranks
+
 LANES = 128  # cell capacity = one TPU lane dimension
 _F = 8  # padded feature count (x, z, vx, vz, valid, 3 spare) — f32 sublane
 
@@ -46,16 +48,33 @@ _F = 8  # padded feature count (x, z, vx, vz, valid, 3 spare) — f32 sublane
 @dataclasses.dataclass(frozen=True)
 class BoidsParams:
     capacity: int = 65536  # max agents (N)
-    cell_size: float = 100.0  # interaction radius; grid cell side
+    cell_size: float = 100.0  # grid cell side; must be >= radius
     grid_x: int = 64
     grid_z: int = 64
-    sep_frac: float = 0.3  # separation acts inside sep_frac * cell_size
+    # Interaction radius; 0.0 = cell_size. Decoupled so SUPERCELLS can pack
+    # more agents per 128-lane cell at a fixed radius (low lane occupancy
+    # wastes pair math on empty lanes — the same tuning axis the neighbor
+    # bench sweeps as cell_size).
+    radius: float = 0.0
+    sep_frac: float = 0.3  # separation acts inside sep_frac * radius
     w_sep: float = 1.5
     w_align: float = 1.0
     w_coh: float = 1.0
     max_speed: float = 8.0
     max_accel: float = 2.0
     dt: float = 1.0
+
+    def __post_init__(self) -> None:
+        if self.radius > self.cell_size:
+            # The 3x3 halo only covers one cell ring: a larger radius
+            # would silently miss true neighbors.
+            raise ValueError(
+                f"radius {self.radius} exceeds cell_size {self.cell_size}"
+            )
+
+    @property
+    def r_eff(self) -> float:
+        return self.radius or self.cell_size
 
     @property
     def world_x(self) -> float:
@@ -80,10 +99,7 @@ def _build_cells(p: BoidsParams, pos, vel, active):
     num_buckets = p.grid_x * p.grid_z
 
     key = jnp.where(active, bucket, num_buckets)
-    order = jnp.argsort(key)
-    sorted_key = key[order]
-    first = jnp.searchsorted(sorted_key, sorted_key, side="left")
-    rank = jnp.arange(n, dtype=jnp.int32) - first.astype(jnp.int32)
+    order, sorted_key, rank = sorted_ranks(key, n, num_buckets)
     ok = (sorted_key < num_buckets) & (rank < LANES)
 
     flat_size = num_buckets * LANES
@@ -170,7 +186,7 @@ def _boids_kernel(p: BoidsParams, cells_hbm, out_ref, scratch, sem):
     dz = dz - wz * jnp.round(dz / wz)
     d2 = dx * dx + dz * dz
 
-    r2 = jnp.float32(p.cell_size * p.cell_size)
+    r2 = jnp.float32(p.r_eff * p.r_eff)
     # Self-pairs: the center cell occupies candidate block 4 (row-major 3x3).
     lane = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 0)
     cidx = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 1)
@@ -187,7 +203,7 @@ def _boids_kernel(p: BoidsParams, cells_hbm, out_ref, scratch, sem):
     inv_count = jnp.where(has_n, 1.0 / jnp.maximum(count, 1.0), 0.0)
 
     # Separation: inverse-square push away inside the close radius.
-    sep_r2 = jnp.float32((p.cell_size * p.sep_frac) ** 2)
+    sep_r2 = jnp.float32((p.r_eff * p.sep_frac) ** 2)
     close = vf * (d2 < sep_r2).astype(jnp.float32)
     inv_d2 = close / (d2 + 1e-6)
     sep_x = -jnp.sum(dx * inv_d2, axis=1)
@@ -313,11 +329,11 @@ def reference_accel(p: BoidsParams, pos, vel, active):
         d[:, 0] -= wx * np.round(d[:, 0] / wx)
         d[:, 1] -= wz * np.round(d[:, 1] / wz)
         d2 = np.sum(d * d, axis=1)
-        mask = active & (d2 <= p.cell_size**2)
+        mask = active & (d2 <= p.r_eff**2)
         mask[i] = False
         if not mask.any():
             continue
-        close = mask & (d2 < (p.cell_size * p.sep_frac) ** 2)
+        close = mask & (d2 < (p.r_eff * p.sep_frac) ** 2)
         inv = np.where(close, 1.0 / (d2 + 1e-6), 0.0)
         sep = -np.sum(d * inv[:, None], axis=0)
         align = vel[mask].mean(axis=0) - vel[i]

@@ -174,6 +174,37 @@ def bins_reference(p: NeighborParams, pos: np.ndarray, space: np.ndarray):
     return cx, cz, sm
 
 
+def sorted_ranks(key: jax.Array, n: int, num_buckets: int):
+    """Stable sort of bucket keys + within-bucket ranks, shared by the
+    neighbor and boids table builds.
+
+    Returns (order, sorted_key, rank): ``order`` is the stable argsort of
+    ``key`` (sentinel ``num_buckets`` for inactive rows sorts last),
+    ``rank`` the position of each sorted row within its key run.
+
+    Fused single-array sort when ``(num_buckets+1)*n`` fits int32:
+    key*n + iota is unique, sorts by (key, iota) — the stable-argsort
+    order — and decomposes back without the pair-sort's payload lanes or
+    the key[order] regather (the table build was 17.8 ms of the 112 ms
+    on-chip tick, 2026-07-30; sort is its dominant term). Ranks come from
+    segment boundaries + cummax — O(N) scan instead of searchsorted's
+    log(N) gather passes.
+    """
+    iota = jnp.arange(n, dtype=jnp.int32)
+    if (num_buckets + 1) * n < 2**31:
+        fused = jnp.sort(key * jnp.int32(n) + iota)
+        order = jax.lax.rem(fused, jnp.int32(n))
+        sorted_key = fused // jnp.int32(n)
+    else:
+        order = jnp.argsort(key).astype(jnp.int32)  # stable
+        sorted_key = key[order]
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+    )
+    first = jax.lax.cummax(jnp.where(boundary, iota, 0))
+    return order, sorted_key, iota - first
+
+
 def _build_table(
     p: NeighborParams, bucket: jax.Array, active: jax.Array, stride: int
 ):
@@ -190,26 +221,7 @@ def _build_table(
     n = p.capacity
     cap = min(p.cell_capacity, stride)
     key = jnp.where(active, bucket, p.num_buckets)
-    iota = jnp.arange(n, dtype=jnp.int32)
-    if (p.num_buckets + 1) * n < 2**31:
-        # Fused single-array sort: key*n + iota is unique, sorts by
-        # (key, iota) — same order as a stable argsort — and decomposes
-        # back without the pair-sort's payload lanes or the key[order]
-        # regather (the table build was 17.8 ms of the 112 ms on-chip
-        # tick, 2026-07-30; sort is its dominant term).
-        fused = jnp.sort(key * jnp.int32(n) + iota)
-        order = jax.lax.rem(fused, jnp.int32(n))
-        sorted_key = fused // jnp.int32(n)
-    else:
-        order = jnp.argsort(key).astype(jnp.int32)  # stable
-        sorted_key = key[order]
-    # First-occurrence index per key run via segment boundaries + cummax —
-    # O(N) scan instead of searchsorted's log(N) gather passes.
-    boundary = jnp.concatenate(
-        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
-    )
-    first = jax.lax.cummax(jnp.where(boundary, iota, 0))
-    rank = iota - first
+    order, sorted_key, rank = sorted_ranks(key, n, p.num_buckets)
     ok = (sorted_key < p.num_buckets) & (rank < cap)
     dropped = jnp.sum((sorted_key < p.num_buckets) & ~ok).astype(jnp.int32)
     table_size = p.num_buckets * stride

@@ -52,6 +52,23 @@ def test_accel_matches_oracle_dense_wrap():
     )
 
 
+def test_accel_matches_oracle_supercells():
+    """radius decoupled from cell_size (the bench's supercell sweep):
+    cell 250 at radius 100 must give the same forces as the oracle — the
+    3x3 halo over-covers and the r2 predicate prunes."""
+    p = make_params(cell_size=250.0, grid_x=4, grid_z=4, radius=100.0)
+    pos, vel, active = make_world(p, 400, seed=5)
+    eng = BoidsEngine(p)
+    _, _, accel = eng.step(pos, vel, active)
+    want = reference_accel(p, pos, vel, active)
+    np.testing.assert_allclose(
+        np.asarray(accel, np.float64)[active], want[active],
+        rtol=2e-3, atol=2e-3,
+    )
+    with pytest.raises(ValueError, match="radius"):
+        make_params(radius=150.0)  # > cell_size 100
+
+
 def test_isolated_agent_no_force():
     p = make_params()
     pos = np.zeros((p.capacity, 2), np.float32)

@@ -1,20 +1,24 @@
 """One-shot chip-session runner: everything queued for the moment the
 axon relay answers, in dependency order, with one log.
 
-    python -u tools/chip_day.py [--skip-cluster]
+    python -u tools/chip_day.py
 
 Sequence (serialized — the tunnel is single-client):
   1. relay probe (fast fail if 8082 refuses)
   2. tools/quick_chip_check.py — oracle smoke + small pipelined bench
   3. python bench.py (full: headline + sweeps incl. drain modes + boids
-     + phases + self-tune) → JSON saved to BENCH_LOCAL_r04.json
-  4. unless --skip-cluster: 100-strict-bot cluster run with game1 ON the
-     chip (aoi_platform=tpu for game1 only, cpu for game2)
+     + phases + self-tune) → JSON saved to BENCH_LOCAL_r04.json on
+     success (BENCH_LOCAL_r04_failed.json otherwise, never overwriting a
+     good result with a failed one)
+
+The 100-bot cluster-on-chip run is NOT automated here (it needs an ini,
+per-game aoi_platform assignment and a fleet — see ROUND4.md's chip
+queue); this script covers the unattended-capture part only.
 
 Every subprocess inherits the env (JAX_PLATFORMS=axon stays — stripping
-it hangs autodiscovery). Never SIGKILL anything here: a killed
+it hangs autodiscovery). NOTHING here ever kills a child: a killed
 chip-holding process wedges the relay for the rest of the round
-(BENCH_NOTES.md operational notes).
+(BENCH_NOTES.md operational notes). Timeouts only WARN and keep waiting.
 """
 
 from __future__ import annotations
@@ -37,17 +41,34 @@ def probe_relay(port: int = 8082, timeout: float = 3.0) -> bool:
         return False
 
 
-def run(name: str, cmd: list[str], timeout: float) -> subprocess.CompletedProcess:
+def run(name: str, cmd: list[str], soft_timeout: float) -> tuple[int, str, str]:
+    """Run to COMPLETION, warning (never killing) past soft_timeout —
+    SIGKILLing a chip-holding child is exactly the wedge this tool exists
+    to avoid."""
     print(f"=== {name}: {' '.join(cmd)}", flush=True)
     t0 = time.time()
-    r = subprocess.run(cmd, cwd=REPO, timeout=timeout,
-                       capture_output=True, text=True)
+    with subprocess.Popen(
+        cmd, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    ) as p:
+        warned = False
+        while True:
+            try:
+                out, err = p.communicate(timeout=60)
+                break
+            except subprocess.TimeoutExpired:
+                if time.time() - t0 > soft_timeout and not warned:
+                    warned = True
+                    print(
+                        f"=== {name}: past {soft_timeout:.0f}s soft budget —"
+                        " waiting (never killing a chip process)", flush=True
+                    )
     dt = time.time() - t0
-    print(f"=== {name}: rc={r.returncode} ({dt:.0f}s)", flush=True)
-    if r.returncode != 0:
-        print(r.stdout[-2000:])
-        print(r.stderr[-2000:])
-    return r
+    print(f"=== {name}: rc={p.returncode} ({dt:.0f}s)", flush=True)
+    if p.returncode != 0:
+        print(out[-2000:])
+        print(err[-2000:])
+    return p.returncode, out or "", err or ""
 
 
 def main() -> int:
@@ -56,38 +77,41 @@ def main() -> int:
         return 1
     print("relay OPEN — starting chip sequence", flush=True)
 
-    r = run("quick_check", [sys.executable, "-u", "tools/quick_chip_check.py"],
-            timeout=900)
-    if r.returncode != 0:
+    rc, out, _ = run(
+        "quick_check", [sys.executable, "-u", "tools/quick_chip_check.py"],
+        soft_timeout=900,
+    )
+    if rc != 0:
         print("quick check failed; NOT proceeding to the full bench")
-        print(r.stdout[-3000:])
+        print(out[-3000:])
         return 2
-    print(r.stdout[-1500:], flush=True)
+    print(out[-1500:], flush=True)
 
-    r = run("bench", [sys.executable, "bench.py"], timeout=3600)
-    line = (r.stdout or "").strip().splitlines()
-    if line:
-        try:
-            data = json.loads(line[-1])
-            with open(os.path.join(REPO, "BENCH_LOCAL_r04.json"), "w") as f:
-                json.dump(data, f, indent=1)
-            print("headline:", data.get("value"), data.get("unit"),
-                  "backend:", data.get("actual_backend"),
-                  "vs_baseline:", data.get("vs_baseline"), flush=True)
-            phases = data.get("phases") or (
-                data.get("configs", {})
-                .get("default_config_headline", {})
-                .get("phases")
-            )
-            if phases:
-                print("phases:", phases, flush=True)
-        except json.JSONDecodeError:
-            print("bench output not JSON:", line[-1][:500])
-
-    if "--skip-cluster" not in sys.argv:
-        print("=== cluster-on-chip run is manual (needs ini + fleet); see "
-              "ROUND4.md chip queue", flush=True)
-    return 0
+    rc, out, _ = run("bench", [sys.executable, "bench.py"], soft_timeout=3600)
+    line = out.strip().splitlines()
+    if not line:
+        print("bench produced no output")
+        return 3
+    try:
+        data = json.loads(line[-1])
+    except json.JSONDecodeError:
+        print("bench output not JSON:", line[-1][:500])
+        return 3
+    ok = rc == 0 and data.get("actual_backend") == "tpu" and not data.get("error")
+    dest = "BENCH_LOCAL_r04.json" if ok else "BENCH_LOCAL_r04_failed.json"
+    with open(os.path.join(REPO, dest), "w") as f:
+        json.dump(data, f, indent=1)
+    print("saved", dest, "| headline:", data.get("value"), data.get("unit"),
+          "backend:", data.get("actual_backend"),
+          "vs_baseline:", data.get("vs_baseline"), flush=True)
+    phases = data.get("phases") or (
+        data.get("configs", {})
+        .get("default_config_headline", {})
+        .get("phases")
+    )
+    if phases:
+        print("phases:", phases, flush=True)
+    return 0 if ok else 3
 
 
 if __name__ == "__main__":
