@@ -333,13 +333,17 @@ def bench_boids(cell: float = 100.0, label: str = "boids") -> dict:
     pos, vel, _ = eng.step(pos, vel, active)  # compile
     jax.block_until_ready(pos)
     steps = max(2, int(os.environ.get("BENCH_BOIDS_STEPS", "60")))
+    drops = []  # device scalars: read only AFTER the timed loop (no syncs)
     t0 = time.perf_counter()
     for _ in range(steps):
         # Device-resident chaining: no host copies between ticks.
         pos, vel, _ = eng.step(pos, vel, active)
+        drops.append(eng.last_dropped)
     jax.block_until_ready(pos)
     t_all = time.perf_counter() - t0
-    dropped = int(eng.last_dropped)
+    # Accumulated across EVERY tick: condensing flocks can overflow
+    # mid-run and be clean on the last tick (code-review r4).
+    dropped = int(sum(int(d) for d in drops))
     ticks_per_sec = steps / t_all
     updates_per_sec = ticks_per_sec * n
     baseline = 50_000 * 30  # 50k agents @ 30 Hz

@@ -161,7 +161,14 @@ def _sharded_step(
             jnp.stack([ei[events_inline - 1], li[events_inline - 1]]),
         ]
     ).astype(jnp.int32)
-    out = jnp.concatenate([header, globalize(ep), globalize(lp)], axis=0)
+    # EVERY shard's counts, replicated into each block: a multi-controller
+    # host (parallel/multihost.py) can only read its own shards, but storm
+    # paging must dispatch the SAME number of global drain calls on every
+    # process — the replicated counts are what make the loops converge.
+    counts_all = jax.lax.all_gather(header[0], SHARD_AXIS)  # [D, 2]
+    out = jnp.concatenate(
+        [header, counts_all, globalize(ep), globalize(lp)], axis=0
+    )
     return enter_ids, leave_ids, out
 
 
@@ -271,7 +278,9 @@ def _sharded_step_pallas(
             jnp.stack([zero, zero]),  # rank paging resumes at events_inline
         ]
     ).astype(jnp.int32)
-    out = jnp.concatenate([header, ep, lp], axis=0)
+    # Replicated per-shard counts — see _sharded_step (multihost paging).
+    counts_all = jax.lax.all_gather(header[0], SHARD_AXIS)  # [D, 2]
+    out = jnp.concatenate([header, counts_all, ep, lp], axis=0)
     enter_ctx = (packed_e, cxc, czc, smc, table_c)
     leave_ctx = (packed_l, lcx, lcz, lsm, ltable)
     return enter_ctx + leave_ctx + (out,)
@@ -396,21 +405,24 @@ class ShardedPendingStep:
         self._collected = True
         eng = self._engine
         e = eng.events_inline
-        block = 3 + 2 * e
+        nd = eng.n_devices
+        # Block layout: 3 header rows, nd replicated-counts rows
+        # (multihost paging convergence), e enter pairs, e leave pairs.
+        block = 3 + nd + 2 * e
         out = np.asarray(self._out)  # THE round trip
         enters, leaves = [], []
-        enter_deficit = np.zeros(eng.n_devices, np.int64)
-        leave_deficit = np.zeros(eng.n_devices, np.int64)
-        enter_starts = np.zeros(eng.n_devices, np.int32)
-        leave_starts = np.zeros(eng.n_devices, np.int32)
+        enter_deficit = np.zeros(nd, np.int64)
+        leave_deficit = np.zeros(nd, np.int64)
+        enter_starts = np.zeros(nd, np.int32)
+        leave_starts = np.zeros(nd, np.int32)
         dropped = 0
         rank_paging = eng.backend != "jnp"
-        for d in range(eng.n_devices):
+        for d in range(nd):
             o = out[d * block:(d + 1) * block]
             n_e, n_l = int(o[0, 0]), int(o[0, 1])
             dropped = int(o[1, 0])  # replicated diagnostic, same on all
-            enters.append(o[3:3 + min(n_e, e)])
-            leaves.append(o[3 + e:3 + e + min(n_l, e)])
+            enters.append(o[3 + nd:3 + nd + min(n_e, e)])
+            leaves.append(o[3 + nd + e:3 + nd + e + min(n_l, e)])
             enter_deficit[d] = max(0, n_e - e)
             leave_deficit[d] = max(0, n_l - e)
             if rank_paging:  # resume by event rank

@@ -1,0 +1,74 @@
+"""Worker process for the multi-host engine test: joins the 2-process
+Gloo cluster, steps the engine with ITS local entity rows over a shared
+seeded world, dumps its local events per tick to an .npz.
+
+Run by tests/test_multihost.py — not a test module itself.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def main() -> int:
+    proc = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    outfile = sys.argv[4]
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import numpy as np
+
+    from goworld_tpu.ops.neighbor import NeighborParams
+    from goworld_tpu.parallel.multihost import (
+        MultiHostNeighborEngine,
+        init_multihost,
+    )
+
+    init_multihost(coord, nprocs, proc)
+    assert len(jax.devices()) == 4 * nprocs, jax.devices()
+
+    # Tiny inline budget so the FIRST tick storm pages on every shard —
+    # the multi-controller paging convergence is the point of the test.
+    p = NeighborParams(
+        capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=256,
+    )
+    eng = MultiHostNeighborEngine(p)
+    eng.reset()
+
+    # The SAME seeded world on every process; each passes only its rows.
+    rng = np.random.default_rng(17)
+    n = p.capacity
+    pos = rng.uniform(0, 1500, (n, 2)).astype(np.float32)
+    active = np.ones(n, bool)
+    active[400:] = False
+    space = rng.integers(0, 3, n).astype(np.int32)
+    radius = np.full(n, 100.0, np.float32)
+
+    lo, lc = eng.local_lo, eng.local_capacity
+    dump = {}
+    for tick in range(3):
+        e, l, dropped = eng.step(
+            pos[lo:lo + lc], active[lo:lo + lc],
+            space[lo:lo + lc], radius[lo:lo + lc],
+        )
+        dump[f"enter_{tick}"] = e
+        dump[f"leave_{tick}"] = l
+        dump[f"dropped_{tick}"] = np.array([dropped])
+        pos = np.clip(
+            pos + rng.normal(0, 25, pos.shape), 0, 1500
+        ).astype(np.float32)
+    dump["local_lo"] = np.array([lo])
+    dump["local_capacity"] = np.array([lc])
+    np.savez(outfile, **dump)
+    print(f"worker {proc} ok: lo={lo} lc={lc}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

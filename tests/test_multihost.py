@@ -1,0 +1,116 @@
+"""Multi-host (multi-process) sharded AOI: the DCN tier.
+
+Two REAL OS processes (4 virtual CPU devices each) form one 8-device
+global mesh over jax.distributed's Gloo backend — the localhost analog of
+a multi-host pod, mirroring how the reference CI tests its multi-process
+cluster on one machine (SURVEY.md §4.3). Each process steps the engine
+with only ITS entity rows and receives only ITS events; the union must
+equal the single-device engine's stream exactly, through a storm tick
+that forces multi-controller paging on every shard.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_engine_reference():
+    """The same seeded trace on the plain single-device engine."""
+    from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
+
+    p = NeighborParams(
+        capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=256,
+    )
+    eng = NeighborEngine(p, backend="jnp")
+    eng.reset()
+    rng = np.random.default_rng(17)
+    n = p.capacity
+    pos = rng.uniform(0, 1500, (n, 2)).astype(np.float32)
+    active = np.ones(n, bool)
+    active[400:] = False
+    space = rng.integers(0, 3, n).astype(np.int32)
+    radius = np.full(n, 100.0, np.float32)
+    out = []
+    for tick in range(3):
+        e, l, d = eng.step(pos, active, space, radius)
+        out.append((e, l, d))
+        pos = np.clip(
+            pos + rng.normal(0, 25, pos.shape), 0, 1500
+        ).astype(np.float32)
+    return out
+
+
+def _to_sets(pairs, n=512):
+    sets = [set() for _ in range(n)]
+    for a, b in pairs:
+        sets[int(a)].add(int(b))
+    return sets
+
+
+@pytest.mark.slow
+def test_two_process_engine_matches_single(tmp_path):
+    port = _free_port()
+    coord = f"127.0.0.1:{port}"
+    outs = [str(tmp_path / f"mh_out_{i}.npz") for i in range(2)]
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("JAX_PLATFORMS", None)  # worker forces cpu via jax.config
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
+             str(i), "2", coord, outs[i]],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    logs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=420)
+        logs.append(out)
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, log[-3000:]
+
+    ref = _single_engine_reference()
+    data = [np.load(f) for f in outs]
+    # Row-ownership split covers the whole space disjointly.
+    spans = sorted(
+        (int(d["local_lo"][0]), int(d["local_capacity"][0])) for d in data
+    )
+    assert spans[0][0] == 0 and spans[0][0] + spans[0][1] == spans[1][0]
+    assert spans[1][0] + spans[1][1] == 512
+
+    for tick in range(3):
+        want_e, want_l, want_d = ref[tick]
+        union_e = np.concatenate([d[f"enter_{tick}"] for d in data])
+        union_l = np.concatenate([d[f"leave_{tick}"] for d in data])
+        # Exact COUNTS first: set comparison alone would mask duplicate
+        # delivery, the characteristic failure of broken paging resume.
+        assert len(union_e) == len(want_e), f"enter count @ {tick}"
+        assert len(union_l) == len(want_l), f"leave count @ {tick}"
+        assert _to_sets(union_e) == _to_sets(want_e), f"enters @ {tick}"
+        assert _to_sets(union_l) == _to_sets(want_l), f"leaves @ {tick}"
+        for d in data:
+            assert int(d[f"dropped_{tick}"][0]) == want_d
+            # Ownership: each process got only ITS entities' events.
+            lo = int(d["local_lo"][0])
+            lc = int(d["local_capacity"][0])
+            ent = d[f"enter_{tick}"][:, 0]
+            assert ((ent >= lo) & (ent < lo + lc)).all()
+        if tick == 0:
+            # The storm must have paged: way beyond the inline budget.
+            assert len(union_e) > 8 * 32  # n_devices * events_inline
