@@ -39,11 +39,18 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from goworld_tpu.ops.neighbor import NeighborParams, check_radius
+from goworld_tpu.ops.neighbor import (
+    LANES,
+    NeighborParams,
+    check_radius,
+    check_space_ids,
+)
 from goworld_tpu.parallel.mesh import (
     SHARD_AXIS,
     _jitted_sharded_drain,
+    _jitted_sharded_drain_bits,
     _jitted_sharded_step,
+    _jitted_sharded_step_pallas,
     make_mesh,
     start_host_copy,
 )
@@ -68,12 +75,12 @@ def init_multihost(
 class MultiHostPendingStep:
     """In-flight multi-host tick: collect() reads only LOCAL shards."""
 
-    __slots__ = ("_engine", "_enter_ids", "_leave_ids", "_out", "_collected")
+    __slots__ = ("_engine", "_enter_ctx", "_leave_ctx", "_out", "_collected")
 
-    def __init__(self, engine, enter_ids, leave_ids, out) -> None:
+    def __init__(self, engine, enter_ctx, leave_ctx, out) -> None:
         self._engine = engine
-        self._enter_ids = enter_ids
-        self._leave_ids = leave_ids
+        self._enter_ctx = enter_ctx  # per-backend paging payload tuple
+        self._leave_ctx = leave_ctx
         self._out = out
         self._collected = False
         start_host_copy(out)
@@ -111,25 +118,27 @@ class MultiHostPendingStep:
         # Storm paging: loop counts derive from the REPLICATED counts, so
         # every process dispatches the same global drain sequence and then
         # keeps only its local shards' chunks.
-        for which, ids, bucket in (
-            ("enter", self._enter_ids, enters),
-            ("leave", self._leave_ids, leaves),
+        rank_paging = eng.backend != "jnp"
+        for which, ctx, bucket in (
+            ("enter", self._enter_ctx, enters),
+            ("leave", self._leave_ctx, leaves),
         ):
             col = 0 if which == "enter" else 1
             deficit = np.maximum(
                 0, counts_all[:, col].astype(np.int64) - e
             )
-            # jnp-path paging resumes AFTER the last drained flat position,
-            # which is per-shard data — read from local header, but the
-            # DISPATCH count uses the replicated deficits.
+            # jnp-path paging resumes AFTER the last drained flat position
+            # (per-shard data, read from the local header); the pallas path
+            # pages by event RANK — a globally known cursor.
             local_starts = {
-                d: int(o[2, col]) + 1 for d, o in local.items()
+                d: (e if rank_paging else int(o[2, col]) + 1)
+                for d, o in local.items()
             }
             rounds = int(np.ceil(deficit / e).max()) if deficit.any() else 0
             cursor = np.zeros(nd, np.int64)
             for _ in range(rounds):
                 start_global = eng._make_starts(local_starts)
-                pairs, aux = eng._jit_drain(ids, start_global)
+                pairs, aux = eng._jit_drain(*ctx, start_global)
                 for s in sorted(
                     pairs.addressable_shards,
                     key=lambda s: s.index[0].start,
@@ -140,11 +149,12 @@ class MultiHostPendingStep:
                         arr = np.asarray(s.data)
                         bucket.append(arr[:take])
                 for s in aux.addressable_shards:
-                    d = s.index[0].start  # aux is [D, E]: one row per shard
+                    d = s.index[0].start  # aux is [D, E] (jnp) / [D, 1]
                     taken = int(min(e, max(0, deficit[d] - cursor[d])))
                     if taken > 0:
                         local_starts[d] = (
-                            int(np.asarray(s.data)[0, taken - 1]) + 1
+                            local_starts[d] + taken if rank_paging
+                            else int(np.asarray(s.data)[0, taken - 1]) + 1
                         )
                 cursor += np.minimum(e, np.maximum(0, deficit - cursor))
         eng.last_grid_dropped = dropped
@@ -156,18 +166,21 @@ class MultiHostPendingStep:
 
 
 class MultiHostNeighborEngine:
-    """Per-process handle on the cross-host engine (jnp path).
+    """Per-process handle on the cross-host engine.
 
     Every process constructs it with identical params over the same global
     mesh and steps it with its LOCAL entity rows — rows
-    [process_lo, process_lo + local_capacity). The Pallas slab path is a
-    TPU-pod follow-up; the jnp path already validates the multi-controller
-    mechanics (sharding, collectives, paging convergence) end to end.
+    [process_lo, process_lo + local_capacity). ``backend``: "jnp" (CPU
+    rigs), "pallas" (TPU pods — grid-row kernel slabs per device, as in
+    ShardedNeighborEngine), or "pallas_interpret" (tests).
     """
 
-    def __init__(self, params: NeighborParams, mesh: Mesh | None = None):
+    def __init__(self, params: NeighborParams, mesh: Mesh | None = None,
+                 backend: str = "jnp"):
         if mesh is None:
             mesh = make_mesh()  # ALL global devices
+        if backend not in ("jnp", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown backend {backend!r}")
         n_dev = mesh.devices.size
         if params.capacity % (8 * n_dev) != 0:
             raise ValueError(
@@ -177,18 +190,32 @@ class MultiHostNeighborEngine:
             raise ValueError(
                 f"max_events {params.max_events} must be divisible by {n_dev}"
             )
+        if backend != "jnp" and params.grid_z % n_dev != 0:
+            raise ValueError(
+                f"pallas path needs grid_z {params.grid_z} divisible by "
+                f"{n_dev} (one slab of rows per device)"
+            )
         self.params = params
         self.mesh = mesh
-        self.backend = "jnp"
+        self.backend = backend
         self.n_devices = n_dev
         self.chunk = params.capacity // n_dev
         self.events_inline = params.max_events // n_dev
-        self._jit_step = _jitted_sharded_step(
-            params, mesh, self.events_inline
-        )
-        self._jit_drain = _jitted_sharded_drain(
-            params, mesh, self.events_inline, self.chunk
-        )
+        if backend == "jnp":
+            self._jit_step = _jitted_sharded_step(
+                params, mesh, self.events_inline
+            )
+            self._jit_drain = _jitted_sharded_drain(
+                params, mesh, self.events_inline, self.chunk
+            )
+        else:
+            self._jit_step = _jitted_sharded_step_pallas(
+                params, mesh, self.events_inline,
+                backend == "pallas_interpret",
+            )
+            self._jit_drain = _jitted_sharded_drain_bits(
+                params, mesh, self.events_inline
+            )
         self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
         self._starts_sharding = NamedSharding(mesh, P(SHARD_AXIS))
         # This process's slice of the entity-row space.
@@ -249,6 +276,8 @@ class MultiHostNeighborEngine:
             f"pass LOCAL rows ({self.local_capacity}), got {len(pos)}"
         )
         check_radius(self.params, radius, active)
+        if self.backend != "jnp":
+            check_space_ids(space, active)
         if meta_dirty:
             meta = (
                 self._put(np.array(active, bool)),
@@ -258,9 +287,22 @@ class MultiHostNeighborEngine:
         else:
             meta = self._state[1:4]
         cur = (self._put(np.array(pos, np.float32)),) + meta
-        enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
+        if self.backend == "jnp":
+            # Entity-row sharding: a process's local events are exactly
+            # its own entities' events.
+            enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
+            enter_ctx: tuple = (enter_ids,)
+            leave_ctx: tuple = (leave_ids,)
+        else:
+            # Grid-row (SPATIAL) sharding: each device emits the events of
+            # entities binned in ITS slab — every event exactly once, but
+            # a process receives events by CELL ownership, not row
+            # ownership (spatial partitioning; route or re-shard if row
+            # ownership is required).
+            res = self._jit_step(*self._state, *cur)
+            enter_ctx, leave_ctx, out = res[0:5], res[5:10], res[10]
         self._state = cur
-        return MultiHostPendingStep(self, enter_ids, leave_ids, out)
+        return MultiHostPendingStep(self, enter_ctx, leave_ctx, out)
 
     def step(self, pos, active, space, radius):
         return self.step_async(pos, active, space, radius).collect()

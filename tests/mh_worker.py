@@ -16,6 +16,7 @@ def main() -> int:
     nprocs = int(sys.argv[2])
     coord = sys.argv[3]
     outfile = sys.argv[4]
+    backend = sys.argv[5] if len(sys.argv) > 5 else "jnp"
     os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
     import jax
 
@@ -38,7 +39,7 @@ def main() -> int:
         capacity=512, cell_size=100.0, grid_x=16, grid_z=16,
         space_slots=4, cell_capacity=64, max_events=256,
     )
-    eng = MultiHostNeighborEngine(p)
+    eng = MultiHostNeighborEngine(p, backend=backend)
     eng.reset()
 
     # The SAME seeded world on every process; each passes only its rows.

@@ -63,7 +63,8 @@ def _to_sets(pairs, n=512):
 
 
 @pytest.mark.slow
-def test_two_process_engine_matches_single(tmp_path):
+@pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
+def test_two_process_engine_matches_single(tmp_path, backend):
     port = _free_port()
     coord = f"127.0.0.1:{port}"
     outs = [str(tmp_path / f"mh_out_{i}.npz") for i in range(2)]
@@ -72,7 +73,7 @@ def test_two_process_engine_matches_single(tmp_path):
     procs = [
         subprocess.Popen(
             [sys.executable, os.path.join(REPO, "tests", "mh_worker.py"),
-             str(i), "2", coord, outs[i]],
+             str(i), "2", coord, outs[i], backend],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True,
         )
@@ -106,11 +107,14 @@ def test_two_process_engine_matches_single(tmp_path):
         assert _to_sets(union_l) == _to_sets(want_l), f"leaves @ {tick}"
         for d in data:
             assert int(d[f"dropped_{tick}"][0]) == want_d
-            # Ownership: each process got only ITS entities' events.
-            lo = int(d["local_lo"][0])
-            lc = int(d["local_capacity"][0])
-            ent = d[f"enter_{tick}"][:, 0]
-            assert ((ent >= lo) & (ent < lo + lc)).all()
+            if backend == "jnp":
+                # Entity-row sharding: each process got only ITS entities'
+                # events. (The pallas path shards by grid rows — events
+                # arrive by CELL ownership, multihost.py docstring.)
+                lo = int(d["local_lo"][0])
+                lc = int(d["local_capacity"][0])
+                ent = d[f"enter_{tick}"][:, 0]
+                assert ((ent >= lo) & (ent < lo + lc)).all()
         if tick == 0:
             # The storm must have paged: way beyond the inline budget.
             assert len(union_e) > 8 * 32  # n_devices * events_inline
