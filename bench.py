@@ -158,6 +158,17 @@ def _resolve_platform(diag: dict) -> str:
     return platform
 
 
+
+def _exc_line() -> str:
+    """One diagnosable line for a caught exception: jax's filtered
+    tracebacks end in boilerplate, so format_exc()'s last line is useless —
+    name the exception type and message instead."""
+    import sys as _sys
+
+    tp, exc, _ = _sys.exc_info()
+    return f"{tp.__name__}: {str(exc)[:300]}"
+
+
 # --- configs -----------------------------------------------------------------
 
 
@@ -383,7 +394,7 @@ def bench_boids_tuned() -> dict:
                 candidates.append((r["value"], cell))
         except Exception:
             sweep[f"cell_{int(cell)}"] = {
-                "error": traceback.format_exc(limit=2).splitlines()[-1]
+                "error": _exc_line()
             }
     if saved is None:
         os.environ.pop("BENCH_BOIDS_STEPS", None)
@@ -574,7 +585,7 @@ def main() -> int:
                 )
             except Exception:
                 configs["multispace_32"] = {
-                    "error": traceback.format_exc(limit=2).splitlines()[-1]
+                    "error": _exc_line()
                 }
             configs["unity_200"] = {
                 "covered_by": "tests/test_examples.py unity_demo suite "
@@ -589,13 +600,13 @@ def main() -> int:
                     )
                 except Exception:
                     configs["synthetic_10k"] = {
-                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        "error": _exc_line()
                     }
                 try:
                     configs["boids_50k"] = bench_boids_tuned()
                 except Exception:
                     configs["boids_50k"] = {
-                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        "error": _exc_line()
                     }
                 # Per-phase attribution + cell-size sweep (same world span,
                 # 13200 units) — VERDICT r2 #8.
@@ -603,7 +614,7 @@ def main() -> int:
                     result["phases"] = bench_phase_profile()
                 except Exception:
                     result["phases"] = {
-                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        "error": _exc_line()
                     }
                 sweep = {}
                 saved_steps = os.environ.get("BENCH_STEPS")
@@ -620,7 +631,7 @@ def main() -> int:
                         }
                     except Exception:
                         sweep[f"cell_{int(cell)}"] = {
-                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                            "error": _exc_line()
                         }
                 configs["cell_sweep"] = sweep
                 # Event-budget sweep: drain cost scales with max_events and
@@ -635,7 +646,7 @@ def main() -> int:
                         }
                     except Exception:
                         esweep[f"max_events_{me}"] = {
-                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                            "error": _exc_line()
                         }
                 configs["events_sweep"] = esweep
                 # Drain word-select strategy sweep (identical event streams,
@@ -650,7 +661,7 @@ def main() -> int:
                         }
                     except Exception:
                         dsweep[f"drain_{dm}"] = {
-                            "error": traceback.format_exc(limit=2).splitlines()[-1]
+                            "error": _exc_line()
                         }
                 if saved_steps is None:
                     os.environ.pop("BENCH_STEPS", None)
@@ -733,7 +744,7 @@ def main() -> int:
                     }
                 except Exception:
                     configs["self_tune"] = {
-                        "error": traceback.format_exc(limit=2).splitlines()[-1]
+                        "error": _exc_line()
                     }
             else:
                 # Pallas interpret mode at 50k agents takes hours on CPU —
