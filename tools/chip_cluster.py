@@ -1,0 +1,173 @@
+"""Chip-day cluster run: >=100 strict bots through a cluster whose game1
+AOI engine runs ON the real TPU (VERDICT r3 #5).
+
+    python -u tools/chip_cluster.py [bots] [duration_s]
+
+Deployment: 2 dispatchers x 2 games x 2 gates, [aoi] backend=tpu;
+game1 aoi_platform=tpu (the ONE process allowed to hold the single-client
+tunnel), game2 aoi_platform=cpu. Captures steady-state CPU%, scenario
+counts, and the game1 log's [aoi] lines (backend/device/cadence evidence).
+
+Run AFTER tools/chip_day.py succeeds (serialize chip users; never start
+this while a bench is on the chip).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+INI = """\
+[deployment]
+dispatchers = 2
+games = 2
+gates = 2
+
+[dispatcher1]
+port = {d1}
+
+[dispatcher2]
+port = {d2}
+
+[game_common]
+boot_entity = Account
+save_interval = 600
+
+[game1]
+aoi_platform = tpu
+
+[game2]
+aoi_platform = cpu
+
+[gate_common]
+heartbeat_timeout = 90
+compress_connection = true
+
+[gate1]
+port = {g1}
+
+[gate2]
+port = {g2}
+
+[storage]
+type = filesystem
+directory = {dir}/es
+
+[kvdb]
+type = sqlite
+directory = {dir}/kv
+
+[aoi]
+backend = tpu
+max_entities = 4096
+"""
+
+
+def cpu_sample(pids: dict, dur: float) -> dict:
+    def ticks(pid):
+        with open(f"/proc/{pid}/stat") as f:
+            p = f.read().split()
+        return int(p[13]) + int(p[14])
+
+    t0 = {k: ticks(v) for k, v in pids.items()}
+    time.sleep(dur)
+    t1 = {k: ticks(v) for k, v in pids.items()}
+    hz = os.sysconf("SC_CLK_TCK")
+    return {k: round((t1[k] - t0[k]) / hz / dur * 100, 1) for k in pids}
+
+
+def main() -> int:
+    bots = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    duration = int(sys.argv[2]) if len(sys.argv) > 2 else 120
+    try:
+        with socket.create_connection(("127.0.0.1", 8082), 3):
+            pass
+    except OSError:
+        print("relay CLOSED — game1 could not reach the chip; aborting")
+        return 1
+
+    run_dir = os.path.join("/tmp", f"chip_cluster_{os.getpid()}")
+    os.makedirs(run_dir, exist_ok=True)
+    ports = {k: free_port() for k in ("d1", "d2", "g1", "g2")}
+    with open(os.path.join(run_dir, "goworld.ini"), "w") as f:
+        f.write(INI.format(dir=run_dir, **ports))
+    env = dict(os.environ, PYTHONPATH=REPO)
+    print("starting cluster in", run_dir, flush=True)
+    r = subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.cli", "start",
+         "examples.test_game"],
+        cwd=run_dir, env=env, capture_output=True, text=True, timeout=600,
+    )
+    print("start rc:", r.returncode, flush=True)
+    if r.returncode != 0:
+        print(r.stdout[-2000:], r.stderr[-2000:])
+        return 2
+    ps = subprocess.run(["ps", "axo", "pid,args"], capture_output=True,
+                        text=True).stdout
+    pids = {}
+    for line in ps.splitlines():
+        for tag, pat in (("game1", ("test_game", "-gid 1")),
+                         ("game2", ("test_game", "-gid 2")),
+                         ("gate1", ("goworld_tpu.gate", "-gid 1")),
+                         ("disp1", ("goworld_tpu.dispatcher", "-dispid 1"))):
+            if all(p in line for p in pat):
+                pids[tag] = int(line.split()[0])
+    print("pids:", pids, flush=True)
+
+    import threading
+    samples = []
+
+    def sampler():
+        time.sleep(min(40, duration // 3))
+        samples.append(cpu_sample(pids, 25))
+
+    th = threading.Thread(target=sampler)
+    th.start()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu.client", "-N", str(bots),
+             "-strict", "-duration", str(duration), "-compress",
+             "-timeout", "45",
+             "-gate", f"127.0.0.1:{ports['g1']}",
+             "-gate", f"127.0.0.1:{ports['g2']}"],
+            cwd=run_dir, env=env, capture_output=True, text=True,
+            timeout=duration + 420,
+        )
+        th.join()
+        print("bots rc:", r.returncode, flush=True)
+        print(r.stdout[-1200:])
+        if r.returncode != 0:
+            print(r.stderr[-1200:])
+        print("CPU% mid-run:", samples, flush=True)
+    finally:
+        # SIGTERM via the CLI stop path only — game1 holds the chip and a
+        # SIGKILL would wedge the relay (BENCH_NOTES operational notes).
+        subprocess.run(
+            [sys.executable, "-m", "goworld_tpu.cli", "stop"],
+            cwd=run_dir, env=env, capture_output=True, text=True,
+            timeout=300,
+        )
+    # Evidence: game1's AOI plane really rode the chip.
+    log = os.path.join(run_dir, "game1.out.log")
+    if os.path.exists(log):
+        with open(log) as f:
+            aoi_lines = [ln for ln in f if "aoi" in ln.lower()]
+        print("game1 [aoi] evidence:")
+        print("".join(aoi_lines[-12:]))
+    return 0 if r.returncode == 0 else 3
+
+
+if __name__ == "__main__":
+    sys.exit(main())
