@@ -302,7 +302,15 @@ class ScenarioBot:
             # (ClientEntity.go doSomething when space kind == 0).
             t0 = time.monotonic()
             kind_max = max(2, self.n_clients // 400)
-            while self.space_kind == 0 and time.monotonic() - t0 < 30.0:
+            # The entry barrier scales with fleet size: a 600-bot login
+            # storm on a single-core host legitimately takes >30 s of
+            # server work before the last bots' first EnterSpace lands
+            # (measured: fixed 30 s failed at N=600, flaked at N=400).
+            entry_budget = max(30.0, 0.15 * self.n_clients)
+            while (
+                self.space_kind == 0
+                and time.monotonic() - t0 < entry_budget
+            ):
                 self.bot.player.call_server(
                     "EnterSpace_Client", 1 + self.rng.randrange(kind_max)
                 )
