@@ -398,12 +398,39 @@ class MySpace(Space):
             self.create_entity("Monster", Vector3())
 
     def on_entity_enter_space(self, entity: Entity):
+        if self.kind <= 0:
+            return  # nil space: never registered with SpaceService
         if entity.typename == "Avatar":
+            # Authoritative counting: the service's avatar_num moves ONLY
+            # on these symmetric space hooks. Counting at routing time
+            # drifted +1 whenever an avatar re-requested the space it was
+            # already in (no leave ever matched the increment), inflating
+            # spaces to "full" and churning fresh ones (measured: 52
+            # spaces for 60 bots and 2 kinds).
+            goworld.call_service_shard_key(
+                "SpaceService", str(self.kind), "AvatarEntered",
+                self.kind, self.id,
+            )
             self._clear_destroy_check_timer()
 
     def on_entity_leave_space(self, entity: Entity):
-        if entity.typename == "Avatar" and self.count_entities("Avatar") == 0:
-            self._set_destroy_check_timer()
+        if self.kind <= 0:
+            return
+        if entity.typename == "Avatar":
+            # Keep the SpaceService's per-space avatar count honest: the
+            # reference declares AvatarNum but never updates it (dead
+            # field — its spaces can never report full), while round 3's
+            # port incremented at ROUTING time without decrementing, so
+            # every ~100 aggregate enters marked a space full and churned
+            # a fresh MySpace + 10 Monsters, unbounded. (The ~1-space-per
+            # -bot world population itself is faithful: the reference
+            # randomizes spaceKind over 100 kinds, Avatar.go:70.)
+            goworld.call_service_shard_key(
+                "SpaceService", str(self.kind), "AvatarLeft",
+                self.kind, self.id,
+            )
+            if self.count_entities("Avatar") == 0:
+                self._set_destroy_check_timer()
 
     def _set_destroy_check_timer(self):
         if self._destroy_check_timer:
@@ -464,23 +491,36 @@ class SpaceService(Entity):
     def describe_entity_type(cls, desc):
         pass
 
+    # Routed-but-not-yet-entered reservations expire after this horizon —
+    # they bound overfill during the enter round-trip without reintroducing
+    # the permanent count drift of routing-time increments.
+    INFLIGHT_HORIZON = 10.0
+
     def on_init(self):
-        # kind → {space_id → info dict(avatar_num, last_enter_time)}
+        # kind → {space_id → info dict(avatar_num, inflight, last_enter_time)}
         self.space_kinds: dict[int, dict[str, dict]] = {}
         self.pending_requests: list[tuple[str, int]] = []
+        self._creating_since: dict[int, float] = {}  # kind → first create t
 
     def _kind_info(self, kind: int) -> dict[str, dict]:
         return self.space_kinds.setdefault(kind, {})
 
+    def _occupancy(self, info: dict) -> int:
+        horizon = goworld.now() - self.INFLIGHT_HORIZON
+        info["inflight"] = [t for t in info.get("inflight", []) if t > horizon]
+        return info["avatar_num"] + len(info["inflight"])
+
     def _choose(self, kind: int) -> str | None:
         """The space with the most avatars that is not full
-        (SpaceService.go:26-39)."""
+        (SpaceService.go:26-39); counts include un-expired in-flight
+        routings so a burst can't overfill one space past the cap."""
         best_id, best = None, None
         for sid, info in self._kind_info(kind).items():
-            if info["avatar_num"] >= MAX_AVATAR_COUNT_PER_SPACE:
+            occ = self._occupancy(info)
+            if occ >= MAX_AVATAR_COUNT_PER_SPACE:
                 continue
-            if best is None or info["avatar_num"] > best["avatar_num"]:
-                best_id, best = sid, info
+            if best is None or occ > best:
+                best_id, best = sid, occ
         return best_id
 
     def EnterSpace(self, avatar_id: str, kind: int):
@@ -488,22 +528,50 @@ class SpaceService(Entity):
         if sid is not None:
             info = self._kind_info(kind)[sid]
             info["last_enter_time"] = goworld.now()
-            info["avatar_num"] += 1
+            info.setdefault("inflight", []).append(goworld.now())
             self.call(avatar_id, "DoEnterSpace", kind, sid)
         else:
+            # One creation per kind per storm: NotifySpaceLoaded satisfies
+            # EVERY pending request of the kind, so concurrent requesters
+            # only need the first to trigger the create. (The reference
+            # creates one space PER REQUEST here — a 60-bot cold start
+            # spawned ~80 spaces + 800 monsters that only 5-minute idle
+            # destroy reaps.) A lost create (target game froze before
+            # NotifySpaceLoaded) re-fires after the horizon instead of
+            # wedging the kind forever.
+            now = goworld.now()
+            since = self._creating_since.get(kind)
             self.pending_requests.append((avatar_id, kind))
-            goworld.create_space_somewhere(kind)
+            if since is None or now - since > self.INFLIGHT_HORIZON:
+                self._creating_since[kind] = now
+                goworld.create_space_somewhere(kind)
 
     def NotifySpaceLoaded(self, kind: int, space_id: str):
+        self._creating_since.pop(kind, None)
         self._kind_info(kind)[space_id] = {
             "avatar_num": 0,
+            "inflight": [],
             "last_enter_time": goworld.now(),
         }
         satisfied = [r for r in self.pending_requests if r[1] == kind]
         self.pending_requests = [r for r in self.pending_requests if r[1] != kind]
+        info = self._kind_info(kind)[space_id]
         for avatar_id, _ in satisfied:
-            self._kind_info(kind)[space_id]["avatar_num"] += 1
+            info["inflight"].append(goworld.now())
             self.call(avatar_id, "DoEnterSpace", kind, space_id)
+
+    def AvatarEntered(self, kind: int, space_id: str):
+        info = self._kind_info(kind).get(space_id)
+        if info is not None:
+            info["avatar_num"] += 1
+            if info.get("inflight"):
+                info["inflight"].pop(0)  # reservation completed
+            info["last_enter_time"] = goworld.now()
+
+    def AvatarLeft(self, kind: int, space_id: str):
+        info = self._kind_info(kind).get(space_id)
+        if info is not None and info["avatar_num"] > 0:
+            info["avatar_num"] -= 1
 
     def RequestDestroy(self, kind: int, space_id: str):
         info = self._kind_info(kind).get(space_id)

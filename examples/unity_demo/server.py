@@ -267,6 +267,27 @@ class MySpace(Space):
                 "Monster", Vector3(float(random.randint(-10, 10)), 0.0, float(random.randint(-10, 10)))
             )
 
+    def on_entity_enter_space(self, entity):
+        # Authoritative symmetric counting on the space hooks — see
+        # test_game MySpace for the drift analysis (routing-time counting
+        # leaks +1 per same-space re-enter and churns spaces).
+        if self.kind <= 0:
+            return  # nil space: never registered with SpaceService
+        if entity.typename == "Player":
+            goworld.call_service_shard_key(
+                "SpaceService", str(self.kind), "AvatarEntered",
+                self.kind, self.id,
+            )
+
+    def on_entity_leave_space(self, entity):
+        if self.kind <= 0:
+            return
+        if entity.typename == "Player":
+            goworld.call_service_shard_key(
+                "SpaceService", str(self.kind), "AvatarLeft",
+                self.kind, self.id,
+            )
+
 
 class OnlineService(Entity):
     """Same bookkeeping as test_game's (unity_demo/OnlineService.go)."""
@@ -292,34 +313,65 @@ class SpaceService(Entity):
     def describe_entity_type(cls, desc):
         pass
 
+    INFLIGHT_HORIZON = 10.0  # see test_game SpaceService
+
     def on_init(self):
         self.space_kinds: dict[int, dict[str, dict]] = {}
         self.pending_requests: list[tuple[str, int]] = []
+        self._creating_since: dict[int, float] = {}
 
     def _kind_info(self, kind: int) -> dict[str, dict]:
         return self.space_kinds.setdefault(kind, {})
 
+    def _occupancy(self, info: dict) -> int:
+        horizon = goworld.now() - self.INFLIGHT_HORIZON
+        info["inflight"] = [t for t in info.get("inflight", []) if t > horizon]
+        return info["avatar_num"] + len(info["inflight"])
+
     def EnterSpace(self, avatar_id: str, kind: int):
-        chosen = None
+        chosen, best = None, None
         for sid, info in self._kind_info(kind).items():
-            if info["avatar_num"] >= MAX_AVATAR_COUNT_PER_SPACE:
+            occ = self._occupancy(info)
+            if occ >= MAX_AVATAR_COUNT_PER_SPACE:
                 continue
-            if chosen is None or info["avatar_num"] > self._kind_info(kind)[chosen]["avatar_num"]:
-                chosen = sid
+            if chosen is None or occ > best:
+                chosen, best = sid, occ
         if chosen is not None:
-            self._kind_info(kind)[chosen]["avatar_num"] += 1
+            info = self._kind_info(kind)[chosen]
+            info.setdefault("inflight", []).append(goworld.now())
             self.call(avatar_id, "DoEnterSpace", kind, chosen)
         else:
+            # Deduplicate creation per kind with a retry horizon (see
+            # test_game SpaceService).
+            now = goworld.now()
+            since = self._creating_since.get(kind)
             self.pending_requests.append((avatar_id, kind))
-            goworld.create_space_somewhere(kind)
+            if since is None or now - since > self.INFLIGHT_HORIZON:
+                self._creating_since[kind] = now
+                goworld.create_space_somewhere(kind)
 
     def NotifySpaceLoaded(self, kind: int, space_id: str):
-        self._kind_info(kind)[space_id] = {"avatar_num": 0}
+        self._creating_since.pop(kind, None)
+        info = self._kind_info(kind)[space_id] = {
+            "avatar_num": 0, "inflight": [],
+        }
         satisfied = [r for r in self.pending_requests if r[1] == kind]
         self.pending_requests = [r for r in self.pending_requests if r[1] != kind]
         for avatar_id, _ in satisfied:
-            self._kind_info(kind)[space_id]["avatar_num"] += 1
+            info["inflight"].append(goworld.now())
             self.call(avatar_id, "DoEnterSpace", kind, space_id)
+
+    def AvatarEntered(self, kind: int, space_id: str):
+        info = self._kind_info(kind).get(space_id)
+        if info is not None:
+            info["avatar_num"] += 1
+            if info.get("inflight"):
+                info["inflight"].pop(0)
+
+    def AvatarLeft(self, kind: int, space_id: str):
+        info = self._kind_info(kind).get(space_id)
+        if info is not None and info["avatar_num"] > 0:
+            info["avatar_num"] -= 1
 
 
 def register() -> None:

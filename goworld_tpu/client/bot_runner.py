@@ -247,6 +247,15 @@ class ScenarioBot:
                     except asyncio.TimeoutError:
                         if time.perf_counter() >= deadline:
                             raise
+                        if (
+                            self.bot.player is None
+                            or self.bot.player.typename != "Avatar"
+                        ):
+                            # Player mirror mid-recreate (GiveClientTo /
+                            # migration / reload): the run loop guards the
+                            # FIRST issue but the retry path must too —
+                            # keep waiting, retry once it's back.
+                            continue
                         self.retries[thing] = self.retries.get(thing, 0) + 1
                         self._start_thing(thing)
             else:

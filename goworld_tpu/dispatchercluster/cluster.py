@@ -27,7 +27,12 @@ from goworld_tpu.utils import gwlog
 # Delegate signature: (dispatcher_index, msgtype, packet) — must be fast/non-blocking.
 PacketHandler = Callable[[int, int, Packet], None]
 # Handshake factory: given the fresh GoWorldConnection, performs the hello.
-Handshaker = Callable[[GoWorldConnection], None]
+# Receives (dispatcher_index, proxy): the game handshake must send each
+# dispatcher ONLY the entity ids it owns by hash (the reference's
+# GetEntityIDsForDispatcher, DispatcherConnMgr.go:79) — a full list creates
+# stale entries on non-owner dispatchers that later REJECT the entity at a
+# restore after it migrated (its REAL_MIGRATE only updated the owner).
+Handshaker = Callable[[int, GoWorldConnection], None]
 
 
 class DispatcherConnMgr:
@@ -69,7 +74,7 @@ class DispatcherConnMgr:
             proxy = GoWorldConnection(PacketConnection(reader, writer))
             self.proxy = proxy
             try:
-                self._handshake(proxy)
+                self._handshake(self.index, proxy)
                 self._connected_event.set()
                 while True:
                     msgtype, packet = await proxy.recv()

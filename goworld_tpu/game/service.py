@@ -58,6 +58,8 @@ class GameService:
         self._last_sync_collect = 0.0
         self._last_aoi_tick = 0.0
         self._aoi_wedge_warned = False
+        self._last_packet_at = 0.0
+        self._freeze_acked_at = 0.0
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -137,6 +139,14 @@ class GameService:
             # Debug HTTP server (binutil.SetupHTTPServer; game.go:107) + gwvar.
             gwvar.set_var("IsDeploymentReady", lambda: self.deployment_ready)
             gwvar.set_var("NumEntities", lambda: len(entity_manager.entities()))
+            # Per-type counts: the leak-hunting view (a soak that grows
+            # NumEntities names its culprit here).
+            def _counts():
+                out: dict[str, int] = {}
+                for e in entity_manager.entities().values():
+                    out[e.typename] = out.get(e.typename, 0) + 1
+                return out
+            gwvar.set_var("EntityCounts", _counts)
             debug_srv = await setup_http_server(game_cfg.http_addr if game_cfg else "")
             lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
             gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
@@ -156,13 +166,26 @@ class GameService:
             dispatchercluster.set_cluster(None)
         return self.exit_code or 0
 
-    def _handshake(self, proxy) -> None:
+    def _handshake(self, index: int, proxy) -> None:
+        # Per-dispatcher entity list: each dispatcher gets ONLY the ids it
+        # owns by hash (GetEntityIDsForDispatcher, DispatcherConnMgr.go:79).
+        # Sending the full list seeds stale entries on non-owner
+        # dispatchers; after a migration (which updates only the owner),
+        # the next restore's reconciliation on a non-owner then REJECTS
+        # the entity and its game destroys it (seen as vanished avatars +
+        # wedged clients in the double-reload soak).
+        from goworld_tpu.common import hash_entity_id
+
+        n = len(self.cfg.dispatchers)
         proxy.send_set_game_id(
             self.gameid,
             is_reconnect=self.deployment_ready,
             is_restore=self.restore,
             is_ban_boot_entity=not self.boot_entity,
-            entity_ids=list(entity_manager.entities().keys()),
+            entity_ids=[
+                eid for eid in entity_manager.entities()
+                if hash_entity_id(eid) % n == index
+            ],
         )
 
     def _on_packet(self, index: int, msgtype: int, packet: Packet) -> None:
@@ -187,6 +210,7 @@ class GameService:
         while True:
             try:
                 msgtype, packet = await asyncio.wait_for(self._queue.get(), timeout=tick)
+                self._last_packet_at = time.monotonic()
                 self._handle_packet(msgtype, packet)
                 # Drain whatever else arrived without waiting.
                 while True:
@@ -251,8 +275,26 @@ class GameService:
                 self._do_terminate()
                 return
             if self.run_state == RS_FREEZING and self._freeze_acks >= len(self.cfg.dispatchers):
-                self._do_freeze()
-                return
+                # Drain to QUIESCENCE before freezing: every dispatcher has
+                # blocked this game's stream (that is what the acks mean),
+                # but packets sent BEFORE the block — e.g. a REAL_MIGRATE
+                # carrying an avatar's entire state — can still be in
+                # flight on another dispatcher's socket. Freezing with one
+                # unread loses the entity forever (seen in a 60-bot
+                # double-reload soak: avatars vanished at the second
+                # restore and their clients wedged on "unknown entity").
+                # Nothing NEW can arrive past the blocks, so a short quiet
+                # window bounds the wait; the cap guards against clock
+                # weirdness, not traffic.
+                if not self._freeze_acked_at:
+                    self._freeze_acked_at = now
+                quiet = now - self._last_packet_at
+                if (
+                    quiet >= consts.FREEZE_QUIESCENT_WINDOW
+                    or now - self._freeze_acked_at > consts.FREEZE_DRAIN_CAP
+                ):
+                    self._do_freeze()
+                    return
 
     def _send_entity_sync_infos(self) -> None:
         """Push batched position syncs, one packet per gate (§3.3)."""

@@ -174,7 +174,7 @@ class GateService:
         ctx.load_cert_chain(self.gate_cfg.rsa_cert, self.gate_cfg.rsa_key)
         return ctx
 
-    def _handshake(self, proxy: GoWorldConnection) -> None:
+    def _handshake(self, index: int, proxy: GoWorldConnection) -> None:
         proxy.send_set_gate_id(self.gateid)
 
     def _on_dispatcher_disconnect(self, index: int) -> None:

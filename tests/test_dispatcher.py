@@ -42,7 +42,7 @@ class FakePeer:
 
 def make_game_cluster(addr, gameid, peer, entity_ids=(),
                       is_reconnect=False, is_restore=False):
-    def handshake(proxy):
+    def handshake(index, proxy):
         proxy.send_set_game_id(
             gameid, is_reconnect, is_restore, False, list(entity_ids)
         )
@@ -51,7 +51,7 @@ def make_game_cluster(addr, gameid, peer, entity_ids=(),
 
 
 def make_gate_cluster(addr, gateid, peer):
-    def handshake(proxy):
+    def handshake(index, proxy):
         proxy.send_set_gate_id(gateid)
 
     return ClusterClient([addr], handshake, peer.on_packet)
