@@ -205,3 +205,48 @@ def test_freeze_and_restore_round_trip(clean_entities, tmp_path):
         await stop_stack(disp, svc2, task2, cg)
 
     asyncio.run(run())
+
+
+def test_handshake_entity_list_filtered_per_dispatcher(clean_entities, tmp_path):
+    """Each dispatcher's SET_GAME_ID must carry ONLY the entity ids it owns
+    by hash (the reference's GetEntityIDsForDispatcher contract,
+    DispatcherConnMgr.go:79). Sending the full list seeds stale entries on
+    non-owner dispatchers; after a migration (which updates only the
+    owner), the next restore's reconciliation on a non-owner REJECTS the
+    entity and the game destroys it — live avatars vanished in the
+    double-reload soak before this was fixed (round 4)."""
+    from goworld_tpu.common import hash_entity_id
+
+    cfg = make_cfg(0, tmp_path)
+    cfg.deployment.desired_dispatchers = 3
+    cfg.dispatchers = {i: DispatcherConfig(port=14000 + i) for i in (1, 2, 3)}
+    svc = GameService(1, cfg, restore=False)
+
+    class CaptureProxy:
+        def __init__(self):
+            self.calls = []
+
+        def send_set_game_id(self, gameid, is_reconnect, is_restore,
+                             is_ban_boot_entity, entity_ids):
+            self.calls.append(list(entity_ids))
+
+    em.register_space(TSpace)
+    em.register_entity(BootAccount)
+    em.create_nil_space(1)
+    eids = [em.create_entity_locally("BootAccount").id for _ in range(40)]
+    all_ids = set(em.entities().keys())
+
+    per_index = []
+    for index in range(3):
+        proxy = CaptureProxy()
+        svc._handshake(index, proxy)
+        (sent,) = proxy.calls
+        per_index.append(set(sent))
+        for eid in sent:
+            assert hash_entity_id(eid) % 3 == index, (eid, index)
+    # Disjoint partition covering EVERY local entity (incl. the nil space).
+    assert per_index[0] | per_index[1] | per_index[2] == all_ids
+    assert not (per_index[0] & per_index[1])
+    assert not (per_index[1] & per_index[2])
+    assert not (per_index[0] & per_index[2])
+    assert len(eids) == 40  # sanity: the partition had real members
