@@ -186,11 +186,18 @@ def cmd_build(args) -> int:
         sys.exit(f"server module {args.server_module!r} not found")
     targets = spec.submodule_search_locations or [os.path.dirname(spec.origin or "")]
     ok = all(compileall.compile_dir(t, quiet=1) for t in targets)
+    from goworld_tpu import native
+
+    print(f"native wire framing: {native.prebuild()}")
     print(f"build {'ok' if ok else 'FAILED'}: {list(targets)}")
     return 0 if ok else 1
 
 
 def cmd_start(args) -> int:
+    from goworld_tpu import native
+
+    impl = native.prebuild()  # one compile here, not N racing in children
+    print(f"native wire framing: {impl}")
     cfg = get_config()
     run_dir = os.path.abspath(args.dir)
     names = _process_names(cfg)

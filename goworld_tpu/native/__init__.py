@@ -8,10 +8,17 @@ motivation).
 
 Build strategy: compile on first import into the package directory
 (atomic rename, so concurrent process startups race benignly) using the
-toolchain baked into the image (``cc -O2 -shared -fPIC ... -lz``). Any
-failure — missing compiler, sandboxed FS, exotic platform — degrades to
-the pure-Python implementations in ``pyframe.py`` with identical
-semantics; ``GWT_NO_NATIVE=1`` forces the fallback (tests exercise BOTH).
+toolchain baked into the image (``cc -O2 -shared -fPIC ... -lz``). The
+built artifact carries a sidecar ``.srchash`` recording the sha256 of the
+``fastframe.c`` it was compiled from; an .so whose sidecar does not match
+the current source is rebuilt, never trusted — so a stale or foreign
+binary can't silently shadow the reviewed C source (ADVICE r4). The CLI
+calls :func:`prebuild` before spawning a fleet so the whole cluster pays
+for ONE compile in the CLI process instead of N racing compiles in the
+children (each child then just hash-checks and dlopens). Any failure —
+missing compiler, sandboxed FS, exotic platform — degrades to the
+pure-Python implementations in ``pyframe.py`` with identical semantics;
+``GWT_NO_NATIVE=1`` forces the fallback (tests exercise BOTH).
 
 Public surface (same signatures either way):
 
@@ -26,6 +33,7 @@ Public surface (same signatures either way):
 
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
 import subprocess
@@ -34,14 +42,29 @@ import sysconfig
 from goworld_tpu.native import pyframe as _py
 
 
-def _build_and_import():
+def _paths() -> tuple[str, str, str]:
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
     so_path = os.path.join(pkg_dir, "_fastframe" + suffix)
-    src = os.path.join(pkg_dir, "fastframe.c")
-    if not os.path.exists(so_path) or (
-        os.path.getmtime(so_path) < os.path.getmtime(src)
-    ):
+    return so_path, so_path + ".srchash", os.path.join(pkg_dir, "fastframe.c")
+
+
+def _source_hash(src: str) -> str:
+    with open(src, "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()
+
+
+def _build_and_import():
+    so_path, hash_path, src = _paths()
+    want = _source_hash(src)
+    have = None
+    if os.path.exists(so_path):
+        try:
+            with open(hash_path) as f:
+                have = f.read().strip()
+        except OSError:
+            pass  # no sidecar → unverifiable artifact → rebuild
+    if have != want:
         include = sysconfig.get_path("include")
         cc = os.environ.get("CC", "cc")
         tmp = so_path + f".tmp{os.getpid()}"
@@ -51,12 +74,33 @@ def _build_and_import():
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
+        htmp = hash_path + f".tmp{os.getpid()}"
+        with open(htmp, "w") as f:
+            f.write(want)
+        os.replace(htmp, hash_path)
+        # (A crash between the two replaces leaves hash != source, which
+        # just forces a rebuild next import — never a stale .so in use.)
     # Load by explicit path — no sys.path mutation (a package-dir entry
     # would let native/ files shadow top-level module names process-wide).
     spec = importlib.util.spec_from_file_location("_fastframe", so_path)
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def prebuild() -> str:
+    """Ensure the native module is built and verified against the current
+    source hash; returns the active IMPL ("c" or "python"). Called by the
+    CLI before spawning a fleet so children skip the compile entirely."""
+    global IMPL, split, pack
+    if os.environ.get("GWT_NO_NATIVE", "") == "1":
+        return IMPL
+    try:
+        _c = _build_and_import()
+        split, pack, IMPL = _c.split, _c.pack, "c"
+    except Exception:  # pragma: no cover - environment-dependent
+        pass
+    return IMPL
 
 
 IMPL = "python"

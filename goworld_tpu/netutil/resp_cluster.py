@@ -23,6 +23,7 @@ single storageRoutine.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Optional
 
 from goworld_tpu.netutil.resp import Reply, RespClient, RespError
@@ -73,6 +74,7 @@ class RespClusterClient:
         start_nodes: list[str],
         password: Optional[str] = None,
         timeout: float = 10.0,
+        probe_timeout: Optional[float] = None,
     ) -> None:
         if not start_nodes:
             raise ValueError("redis_cluster requires at least one start node")
@@ -84,6 +86,16 @@ class RespClusterClient:
         self._slot_owner: dict[int, tuple[str, int]] = {}
         self._masters: list[tuple[str, int]] = []
         self._lock = threading.Lock()
+        # Topology probes use a short timeout and skip recently-dead
+        # nodes, so one unreachable master costs at most ~_probe_timeout
+        # per refresh instead of the full command timeout per candidate.
+        # Scales with the command timeout (slow/cross-region clusters
+        # stay reachable) but never exceeds it.
+        if probe_timeout is None:
+            probe_timeout = max(2.0, timeout * 0.2)
+        self._probe_timeout = min(timeout, probe_timeout)
+        self._dead_until: dict[tuple[str, int], float] = {}
+        self._DEAD_BACKOFF = 5.0
 
     @staticmethod
     def _parse_addr(addr: str) -> tuple[str, int]:
@@ -104,14 +116,33 @@ class RespClusterClient:
     # --- topology -----------------------------------------------------------
 
     def _refresh_slots(self) -> None:
-        """Rebuild the slot map from CLUSTER SLOTS via any live node."""
+        """Rebuild the slot map from CLUSTER SLOTS via any live node.
+
+        Probes use ``_probe_timeout`` (not the command timeout) on a
+        throwaway connection and skip nodes marked dead within the last
+        ``_DEAD_BACKOFF`` seconds, bounding the stall a dead node can
+        inject into the refresh sweep (ADVICE r4)."""
         last_err: Exception | None = None
-        for addr in list(self._masters) + self._seeds:
+        now = time.monotonic()
+        # dict.fromkeys: dedupe (a seed that is also a listed master must
+        # not be probed twice per sweep) while preserving masters-first order.
+        candidates = list(dict.fromkeys(list(self._masters) + self._seeds))
+        dead = {a for a in candidates if self._dead_until.get(a, 0) > now}
+        live_first = [a for a in candidates if a not in dead]
+        live_first += [a for a in candidates if a in dead]  # last, not never
+        for addr in live_first:
+            probe = RespClient(
+                host=addr[0], port=addr[1], db=0,
+                password=self._password, timeout=self._probe_timeout,
+            )
             try:
-                reply = self._conn(addr).execute("CLUSTER", "SLOTS")
+                reply = probe.execute("CLUSTER", "SLOTS")
             except (OSError, ConnectionError, RespError) as e:
+                self._dead_until[addr] = time.monotonic() + self._DEAD_BACKOFF
                 last_err = e
                 continue
+            finally:
+                probe.close()
             owner: dict[int, tuple[str, int]] = {}
             masters: list[tuple[str, int]] = []
             for rng in reply or []:
@@ -127,6 +158,7 @@ class RespClusterClient:
                 continue
             self._slot_owner = owner
             self._masters = masters
+            self._dead_until.pop(addr, None)
             return
         raise ClusterDownError(f"no cluster node reachable: {last_err}")
 
@@ -142,11 +174,19 @@ class RespClusterClient:
     # --- command execution --------------------------------------------------
 
     @staticmethod
-    def _parse_redirect(msg: str) -> tuple[str, tuple[str, int]] | None:
-        """``MOVED 3999 127.0.0.1:6381`` / ``ASK ...`` → (kind, addr)."""
+    def _parse_redirect(
+        msg: str, issuer: tuple[str, int] | None = None
+    ) -> tuple[str, tuple[str, int]] | None:
+        """``MOVED 3999 127.0.0.1:6381`` / ``ASK ...`` → (kind, addr).
+
+        Redis emits ``MOVED 3999 :6381`` (empty host) when
+        cluster-announce-ip is unset; standard cluster-client behavior is
+        to reuse the host of the node that issued the redirect."""
         parts = msg.split()
         if len(parts) == 3 and parts[0] in ("MOVED", "ASK"):
             host, _, port = parts[2].rpartition(":")
+            if not host and issuer is not None:
+                host = issuer[0]
             return parts[0], (host, int(port))
         return None
 
@@ -176,7 +216,7 @@ class RespClusterClient:
                         conn.execute("ASKING")
                     return conn.execute(*args)
                 except RespError as e:
-                    redirect = self._parse_redirect(str(e))
+                    redirect = self._parse_redirect(str(e), issuer=addr)
                     if redirect is None:
                         raise
                     kind, addr = redirect
@@ -188,6 +228,9 @@ class RespClusterClient:
                         asking = True
                 except (OSError, ConnectionError):
                     # Node died: re-discover and retry on the new owner.
+                    self._dead_until[addr] = (
+                        time.monotonic() + self._DEAD_BACKOFF
+                    )
                     self._refresh_slots()
                     naddr = self._slot_owner.get(key_slot(key))
                     if naddr is None or naddr == addr:

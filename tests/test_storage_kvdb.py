@@ -245,6 +245,81 @@ def test_cluster_ask_redirect_window():
         srv.stop()
 
 
+def test_cluster_empty_host_redirect_uses_issuer_host():
+    """Redis emits ``MOVED 3999 :6381`` (no host) when cluster-announce-ip
+    is unset; the client must substitute the issuing node's host instead of
+    dialing host "" (ADVICE r4)."""
+    from goworld_tpu.netutil.resp_cluster import RespClusterClient
+
+    parse = RespClusterClient._parse_redirect
+    assert parse("MOVED 3999 :6381", issuer=("10.0.0.5", 6379)) == (
+        "MOVED", ("10.0.0.5", 6381))
+    assert parse("ASK 42 :7001", issuer=("192.168.1.2", 7000)) == (
+        "ASK", ("192.168.1.2", 7001))
+    # Explicit host wins over the issuer.
+    assert parse("MOVED 3999 10.0.0.9:6381", issuer=("10.0.0.5", 6379)) == (
+        "MOVED", ("10.0.0.9", 6381))
+    assert parse("WRONGTYPE whatever", issuer=("h", 1)) is None
+
+
+def test_cluster_refresh_bounded_by_silent_node():
+    """A node that accepts but never answers must cost at most the short
+    probe timeout during topology refresh, not the full command timeout
+    (ADVICE r4: one dead node serialized tens of seconds into every
+    command)."""
+    import socket
+    import threading
+    import time as _time
+
+    from miniredis_cluster import MiniRedisCluster
+
+    from goworld_tpu.netutil.resp_cluster import RespClusterClient
+
+    # A listener that accepts connections and then says nothing.
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(8)
+    silent_port = silent.getsockname()[1]
+    stop = threading.Event()
+
+    def _sink():
+        silent.settimeout(0.2)
+        held = []
+        while not stop.is_set():
+            try:
+                conn, _ = silent.accept()
+                held.append(conn)  # keep open, never reply
+            except socket.timeout:
+                continue
+            except OSError:
+                return  # listener closed during teardown
+
+    t = threading.Thread(target=_sink, daemon=True)
+    t.start()
+    srv = MiniRedisCluster(n_nodes=3)
+    try:
+        seeds = [f"127.0.0.1:{silent_port}"] + srv.start_nodes
+        c = RespClusterClient(seeds, timeout=10.0)
+        t0 = _time.monotonic()
+        c.set("boundkey", "v")
+        assert c.get("boundkey") == "v"
+        elapsed = _time.monotonic() - t0
+        # Silent seed costs ≤ probe timeout (2 s), not the 10 s command
+        # timeout; allow generous slack for CI.
+        assert elapsed < 6.0, f"refresh stalled {elapsed:.1f}s on silent node"
+        # Second refresh skips the now-marked-dead node entirely.
+        t1 = _time.monotonic()
+        with c._lock:
+            c._refresh_slots()
+        assert _time.monotonic() - t1 < 2.0
+        c.close()
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+        srv.stop()
+        silent.close()
+
+
 def test_cluster_mget_splits_per_slot_and_scan_merges():
     """mget across arbitrary keys must split per slot (cluster MGET is
     CROSSSLOT otherwise); scan_keys must merge every master's keyspace
