@@ -33,9 +33,13 @@ DISPATCHER_LOAD_TIMEOUT = 60.0
 # per game, restarted sequentially by the CLI); 10 s leaves no headroom on a
 # loaded box and an expired block DROPS packets instead of buffering them.
 DISPATCHER_FREEZE_GAME_TIMEOUT = 30.0
-# Freeze drain-to-quiescence: after the last freeze ack, wait for the packet
-# stream to go quiet (in-flight packets from already-blocked dispatchers —
-# e.g. a REAL_MIGRATE — must land before the process exits), capped.
+# Freeze fence: each dispatcher's ack is emitted on the same TCP stream
+# strictly after it installs the block, so processing the N-th ack IS the
+# proof that every pre-block packet has been processed (game/service.py
+# main loop). The quiescence knobs below are only the SAFETY NET for the
+# all-acks-never-arrive case (dead dispatcher), entered after
+# FREEZE_ACK_TIMEOUT.
+FREEZE_ACK_TIMEOUT = 10.0
 FREEZE_QUIESCENT_WINDOW = 0.3
 FREEZE_DRAIN_CAP = 5.0
 RECONNECT_INTERVAL = 1.0  # DispatcherConnMgr reconnect backoff

@@ -568,7 +568,15 @@ class DispatcherService:
 
     def _handle_start_freeze_game(self, proxy: GoWorldConnection, packet: Packet) -> None:
         """Buffer the game's packets for the freeze window then ack
-        (DispatcherService.go:478-494)."""
+        (DispatcherService.go:478-494).
+
+        FENCE CONTRACT (relied on by the game's freeze path): the ack is
+        written to the SAME stream as every packet this dispatcher has
+        forwarded to the game, strictly AFTER the block is installed, in
+        the single logic task — game-bound sends here are synchronous
+        transport writes, so there is no side queue the ack could
+        overtake. Receiving this ack therefore proves all of this
+        dispatcher's pre-block packets have been delivered."""
         gameid = self._gameid_of(proxy)
         if not gameid:
             return

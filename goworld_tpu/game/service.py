@@ -60,6 +60,7 @@ class GameService:
         self._aoi_wedge_warned = False
         self._last_packet_at = 0.0
         self._freeze_acked_at = 0.0
+        self._freeze_started_at = 0.0
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -274,27 +275,49 @@ class GameService:
             if self.run_state == RS_TERMINATING:
                 self._do_terminate()
                 return
-            if self.run_state == RS_FREEZING and self._freeze_acks >= len(self.cfg.dispatchers):
-                # Drain to QUIESCENCE before freezing: every dispatcher has
-                # blocked this game's stream (that is what the acks mean),
-                # but packets sent BEFORE the block — e.g. a REAL_MIGRATE
-                # carrying an avatar's entire state — can still be in
-                # flight on another dispatcher's socket. Freezing with one
-                # unread loses the entity forever (seen in a 60-bot
-                # double-reload soak: avatars vanished at the second
-                # restore and their clients wedged on "unknown entity").
-                # Nothing NEW can arrive past the blocks, so a short quiet
-                # window bounds the wait; the cap guards against clock
-                # weirdness, not traffic.
-                if not self._freeze_acked_at:
-                    self._freeze_acked_at = now
-                quiet = now - self._last_packet_at
-                if (
-                    quiet >= consts.FREEZE_QUIESCENT_WINDOW
-                    or now - self._freeze_acked_at > consts.FREEZE_DRAIN_CAP
-                ):
+            if self.run_state == RS_FREEZING:
+                if self._freeze_acks >= len(self.cfg.dispatchers):
+                    # Deterministic fence (ADVICE r4): each dispatcher
+                    # emits its ack on the SAME TCP stream strictly after
+                    # installing the block, and acks are counted here at
+                    # PROCESSING time — so per-connection FIFO (socket →
+                    # reader task → logic queue) guarantees that every
+                    # packet a dispatcher forwarded pre-block (e.g. a
+                    # REAL_MIGRATE carrying an avatar's entire state) has
+                    # already been processed by the time the count reaches
+                    # N. Packets a dispatcher received post-block go to
+                    # its pending buffer and are delivered after restore.
+                    # Nothing can still be in flight: freeze NOW — no
+                    # probabilistic quiet-window wait (a migrate delayed
+                    # past the old 0.3 s window by kernel buffering was
+                    # still lost; the fence cannot miss it).
                     self._do_freeze()
                     return
+                if (
+                    self._freeze_started_at
+                    and now - self._freeze_started_at
+                    > consts.FREEZE_ACK_TIMEOUT
+                ):
+                    # Safety net: a dead/hung dispatcher would otherwise
+                    # wedge the freeze forever. Fall back to the
+                    # quiescence heuristic — freeze after a quiet window,
+                    # bounded by the drain cap.
+                    if not self._freeze_acked_at:
+                        gwlog.errorf(
+                            "game %d: only %d/%d freeze acks after %.0f s "
+                            "— falling back to quiescent-window freeze",
+                            self.gameid, self._freeze_acks,
+                            len(self.cfg.dispatchers),
+                            consts.FREEZE_ACK_TIMEOUT,
+                        )
+                        self._freeze_acked_at = now
+                    quiet = now - self._last_packet_at
+                    if (
+                        quiet >= consts.FREEZE_QUIESCENT_WINDOW
+                        or now - self._freeze_acked_at > consts.FREEZE_DRAIN_CAP
+                    ):
+                        self._do_freeze()
+                        return
 
     def _send_entity_sync_infos(self) -> None:
         """Push batched position syncs, one packet per gate (§3.3)."""
@@ -467,6 +490,7 @@ class GameService:
             return
         gwlog.infof("game %d freezing: notifying %d dispatchers", self.gameid, len(self.cfg.dispatchers))
         self._freeze_acks = 0
+        self._freeze_started_at = time.monotonic()
         self.run_state = RS_FREEZING
         for sender in dispatchercluster.select_all():
             sender.send_start_freeze_game()

@@ -106,12 +106,4 @@ def prebuild() -> str:
 IMPL = "python"
 split = _py.split
 pack = _py.pack
-
-if os.environ.get("GWT_NO_NATIVE", "") != "1":
-    try:
-        _c = _build_and_import()
-        split = _c.split
-        pack = _c.pack
-        IMPL = "c"
-    except Exception:  # pragma: no cover - environment-dependent
-        pass  # degraded to pyframe; semantics identical
+prebuild()  # also makes later explicit prebuild() calls cheap no-ops

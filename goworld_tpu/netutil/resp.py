@@ -138,6 +138,15 @@ class RespClient:
                 self._connect()
                 return self._roundtrip(args)
 
+    def execute_once(self, *args) -> Reply:
+        """Single attempt, NO reconnect-retry: for liveness probes whose
+        worst case must be bounded by one timeout, not two (the transparent
+        retry in :meth:`execute` would double a dead node's cost)."""
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            return self._roundtrip(args)
+
     # Typed helpers (str in/out; values are UTF-8).
 
     def get(self, key: str) -> Optional[str]:

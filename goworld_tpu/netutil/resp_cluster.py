@@ -136,7 +136,7 @@ class RespClusterClient:
                 password=self._password, timeout=self._probe_timeout,
             )
             try:
-                reply = probe.execute("CLUSTER", "SLOTS")
+                reply = probe.execute_once("CLUSTER", "SLOTS")
             except (OSError, ConnectionError, RespError) as e:
                 self._dead_until[addr] = time.monotonic() + self._DEAD_BACKOFF
                 last_err = e

@@ -207,6 +207,64 @@ def test_freeze_and_restore_round_trip(clean_entities, tmp_path):
     asyncio.run(run())
 
 
+def test_freeze_fence_is_immediate(clean_entities, tmp_path, monkeypatch):
+    """The freeze fence is deterministic (ADVICE r4): once every
+    dispatcher's ack is processed, per-connection FIFO proves all
+    pre-block packets have landed — the game must freeze immediately, NOT
+    sit out a quiescent window (the ack itself used to reset the quiet
+    clock, making the window a hard floor). The window is monkeypatched
+    UP to 2 s so the pass band is an order of magnitude, not 20 ms."""
+    import time as _time
+
+    from goworld_tpu import consts
+
+    monkeypatch.setattr(consts, "FREEZE_QUIESCENT_WINDOW", 2.0)
+
+    async def run():
+        disp, svc, task, cg, gate_peer = await start_stack(tmp_path)
+        t0 = _time.monotonic()
+        svc.start_freeze()
+        rc = await asyncio.wait_for(task, timeout=10)
+        elapsed = _time.monotonic() - t0
+        assert rc == 2
+        assert elapsed < 1.0, (
+            f"freeze took {elapsed:.3f}s — quiescent-window wait is back?"
+        )
+        await cg.stop()
+        await disp.stop()
+
+    asyncio.run(run())
+
+
+def test_freeze_falls_back_when_a_dispatcher_never_acks(
+    clean_entities, tmp_path, monkeypatch
+):
+    """A dead dispatcher must not wedge the freeze forever: after
+    FREEZE_ACK_TIMEOUT with acks missing, the game falls back to the
+    quiescent-window freeze (safety net)."""
+    from goworld_tpu import consts
+    from goworld_tpu.config.read_config import DispatcherConfig
+
+    monkeypatch.setattr(consts, "FREEZE_ACK_TIMEOUT", 0.4)
+    monkeypatch.setattr(consts, "FREEZE_DRAIN_CAP", 0.5)
+
+    async def run():
+        disp, svc, task, cg, gate_peer = await start_stack(tmp_path)
+        # Phantom second dispatcher in the config: its ack can never
+        # arrive, so the deterministic fence cannot complete.
+        svc.cfg.dispatchers[2] = DispatcherConfig(port=1)
+        svc.start_freeze()
+        rc = await asyncio.wait_for(task, timeout=10)
+        assert rc == 2  # froze anyway, via the safety net
+        import os
+
+        assert os.path.exists("game1_freezed.dat")
+        await cg.stop()
+        await disp.stop()
+
+    asyncio.run(run())
+
+
 def test_handshake_entity_list_filtered_per_dispatcher(clean_entities, tmp_path):
     """Each dispatcher's SET_GAME_ID must carry ONLY the entity ids it owns
     by hash (the reference's GetEntityIDsForDispatcher contract,
