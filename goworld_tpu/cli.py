@@ -213,11 +213,28 @@ def cmd_start(args) -> int:
                [sys.executable, "-m", "goworld_tpu.dispatcher", "-dispid", str(i)] + cfg_argv,
                consts.DISPATCHER_STARTED_TAG)
     print(f"starting {len(names['game'])} game(s) [{args.server_module}] ...")
+    # Spawn the whole game batch BEFORE waiting on any tag: an AOI
+    # multihost game blocks at the jax.distributed barrier until every
+    # peer game is up, so sequential spawn-then-wait would deadlock (and
+    # batching is faster for plain deploys too).
+    spawned = []
     for i, name in zip(sorted(cfg.games), names["game"]):
         argv = [sys.executable, "-m", args.server_module, "-gid", str(i)] + cfg_argv
         if args.restore:
             argv.append("-restore")
-        _spawn(run_dir, name, argv, consts.GAME_STARTED_TAG)
+        spawned.append((name,) + _spawn_nowait(run_dir, name, argv))
+    try:
+        for name, proc, offset in spawned:
+            _wait_tag(run_dir, name, consts.GAME_STARTED_TAG, proc, offset)
+    except SystemExit:
+        # One game failed to boot: reap its batch-mates — otherwise they
+        # linger daemonized (a multihost peer sits wedged at the mesh
+        # barrier holding its ports) and the next `start` fails on
+        # port conflicts until a manual `kill`.
+        for name, proc, _ in spawned:
+            if proc.poll() is None:
+                proc.terminate()
+        raise
     print(f"starting {len(names['gate'])} gate(s) ...")
     for i, name in zip(sorted(cfg.gates), names["gate"]):
         _spawn(run_dir, name,

@@ -117,6 +117,15 @@ class AOIConfig:
     grid: int = 0  # cells per side (grid_x = grid_z)
     cell_size: float = 0.0  # cell side length; must be >= max AOI distance
     space_slots: int = 0  # space-id folding slots
+    # Multi-HOST (DCN) tier: every game process joins ONE jax.distributed
+    # mesh and the AOI step runs as multi-controller SPMD across them
+    # (parallel/multihost.py). Set the coordinator to "host:port" (served
+    # by the first game); processes defaults to the number of games. The
+    # AOI tick then runs in LOCKSTEP at the fixed position_sync_interval
+    # cadence on every game (collectives require every process to dispatch
+    # the same op sequence). Mutually exclusive with mesh_shards > 1.
+    multihost_coordinator: str = ""  # "" = disabled
+    multihost_processes: int = 0  # 0 = len(games)
 
 
 @dataclasses.dataclass
@@ -280,6 +289,8 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             grid=int(s.get("grid", 0)),
             cell_size=float(s.get("cell_size", 0.0)),
             space_slots=int(s.get("space_slots", 0)),
+            multihost_coordinator=s.get("multihost_coordinator", "").strip(),
+            multihost_processes=int(s.get("multihost_processes", 0)),
         )
     if cp.has_section("debug"):
         cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
@@ -320,6 +331,48 @@ def _validate(cfg: GoWorldConfig) -> None:
             raise ValueError(
                 f"game{gid}: aoi_platform must be auto|cpu|tpu, "
                 f"got {g.aoi_platform!r}"
+            )
+    if a.multihost_coordinator:
+        if a.backend == "xzlist":
+            raise ValueError(
+                "[aoi] multihost_coordinator requires the batched backend "
+                "(backend = tpu or auto), not xzlist"
+            )
+        if a.mesh_shards > 1:
+            raise ValueError(
+                "[aoi] multihost_coordinator and mesh_shards > 1 are "
+                "mutually exclusive (single-host ICI tier vs multi-host "
+                "DCN tier)"
+            )
+        nproc = a.multihost_processes or len(cfg.games)
+        if nproc < 2:
+            raise ValueError(
+                "[aoi] multihost needs >= 2 processes (games); for one "
+                "process use mesh_shards instead"
+            )
+        if a.multihost_processes and a.multihost_processes != len(cfg.games):
+            raise ValueError(
+                f"[aoi] multihost_processes ({a.multihost_processes}) must "
+                f"match the number of games ({len(cfg.games)}) — every game "
+                f"joins the mesh"
+            )
+        plats = {
+            (g.aoi_platform or a.platform) for g in cfg.games.values()
+        }
+        if len(plats) > 1:
+            raise ValueError(
+                "[aoi] multihost requires every game on the SAME jax "
+                f"platform (one global mesh); got {sorted(plats)}"
+            )
+        cadences = {g.position_sync_interval for g in cfg.games.values()}
+        if len(cadences) > 1:
+            # Dispatches are readiness-gated so differing cadences cannot
+            # diverge the global op sequence, but the slowest game would
+            # silently pace every other game's AOI — surprising enough to
+            # reject outright.
+            raise ValueError(
+                "[aoi] multihost requires the same position_sync_interval "
+                f"on every game; got {sorted(cadences)}"
             )
     for section, c in (("storage", cfg.storage), ("kvdb", cfg.kvdb)):
         if c.type == "redis_cluster" and not c.start_nodes:

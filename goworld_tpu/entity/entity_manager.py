@@ -46,6 +46,10 @@ class Runtime:
         self.aoi_service = None  # BatchAOIService, lazily created
         self.aoi_params = None  # NeighborParams override
         self.aoi_mesh_shards: int = 1  # [aoi] mesh_shards: devices to shard over
+        # Multi-HOST (DCN) tier: True once this process has joined the
+        # jax.distributed mesh ([aoi] multihost_coordinator; the game
+        # service calls init_multihost before any jax use).
+        self.aoi_multihost: bool = False
         self.storage = None  # object with .save/.load/.exists (storage module)
         self.game_service = None  # the running GameService, if any
 
@@ -67,7 +71,8 @@ class Runtime:
 
             params = self.aoi_params or NeighborParams()
             self.aoi_service = BatchAOIService(
-                params, mesh_shards=self.aoi_mesh_shards
+                params, mesh_shards=self.aoi_mesh_shards,
+                multihost=self.aoi_multihost,
             )
         return self.aoi_service
 

@@ -104,6 +104,33 @@ class GameService:
                 import jax
 
                 jax.config.update("jax_platforms", "cpu")
+            if self.cfg.aoi.multihost_coordinator:
+                # DCN tier: every game joins ONE jax.distributed mesh;
+                # process_id is this game's rank among the configured games
+                # (read_config validates processes == len(games)). Must run
+                # before any other jax use; blocks until every game is up
+                # (the CLI spawns the game batch before waiting on tags).
+                from goworld_tpu.parallel.multihost import init_multihost
+
+                games_sorted = sorted(self.cfg.games)
+                pid = games_sorted.index(self.gameid)
+                nprocs = len(games_sorted)
+                gwlog.infof(
+                    "game %d joining AOI multihost mesh as process %d/%d "
+                    "via %s", self.gameid, pid, nprocs,
+                    self.cfg.aoi.multihost_coordinator,
+                )
+                init_multihost(
+                    self.cfg.aoi.multihost_coordinator, nprocs, pid
+                )
+                rt.aoi_multihost = True
+                import jax
+
+                gwlog.infof(
+                    "game %d AOI multihost mesh joined: %d processes, "
+                    "%d global devices", self.gameid, jax.process_count(),
+                    jax.device_count(),
+                )
             # Compile the engine BEFORE the ready barrier admits clients —
             # the first dispatch otherwise freezes the loop for the whole
             # jit compile (seconds) right as the first clients log in.
@@ -223,6 +250,15 @@ class GameService:
             except asyncio.TimeoutError:
                 pass
             rt.timer_service.tick()
+            # NOTE on the multi-HOST (DCN) tier: the wait=False machinery
+            # below is lockstep-SAFE as is. Frame-skip only DEFERS a
+            # dispatch index (tick dispatches 0,1,2,... on every process,
+            # never skipping one), and delivery happens only when the
+            # in-flight step is observed ready — so a fast game is paced by
+            # readiness gating instead of blocking in a collective, a dead
+            # peer degrades to the wedge-watchdog warning (RPCs keep
+            # flowing) instead of freezing the loop, and per-process
+            # adaptive cadences cannot diverge the global op sequence.
             if rt.aoi_service is not None:
                 # AOI rides the position-sync cadence (reference §3.3: AOI
                 # updates feed client create/destroy alongside position

@@ -62,6 +62,110 @@ def _to_sets(pairs, n=512):
     return sets
 
 
+MH_CLUSTER_INI = """\
+[deployment]
+dispatchers = 1
+games = 2
+gates = 1
+
+[dispatcher1]
+port = {disp}
+
+[game_common]
+boot_entity = Account
+save_interval = 600
+
+[game1]
+[game2]
+
+[gate1]
+port = {gate}
+heartbeat_timeout = 60
+
+[storage]
+type = filesystem
+directory = {dir}/es
+
+[kvdb]
+type = sqlite
+directory = {dir}/kv
+
+[aoi]
+backend = tpu
+platform = cpu
+max_entities = 512
+multihost_coordinator = 127.0.0.1:{coord}
+"""
+
+
+@pytest.mark.slow
+def test_multihost_cluster_two_games(tmp_path):
+    """PRODUCT wiring of the DCN tier (VERDICT r4 item 6): a real CLI
+    deployment where BOTH game processes join one jax.distributed mesh via
+    ``[aoi] multihost_coordinator`` and run lockstep AOI over it, driven by
+    strict bots (whose TestAOI probes exercise AOI delivery on whichever
+    game hosts each avatar — boot entities round-robin across games, so
+    both mesh members serve live AOI). Strictness also asserts isolation:
+    any cross-game space leakage through the shared global engine would
+    surface as duplicate-create / unknown-entity bot errors. A mid-run
+    reload then exercises the freeze-time dispatch-count alignment
+    protocol (batched.py _align_multihost_for_flush) and mesh re-join."""
+    import asyncio
+
+    from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+    d = str(tmp_path)
+    ports = {"disp": _free_port(), "gate": _free_port(),
+             "coord": _free_port()}
+    with open(os.path.join(d, "goworld.ini"), "w") as f:
+        f.write(MH_CLUSTER_INI.format(dir=d, **ports))
+
+    def cli(*args, timeout=180):
+        env = dict(os.environ, PYTHONPATH=REPO)
+        return subprocess.run(
+            [sys.executable, "-m", "goworld_tpu.cli", *args],
+            cwd=d, env=env, capture_output=True, text=True, timeout=timeout,
+        )
+
+    r = cli("start", "examples.test_game")
+    try:
+        assert r.returncode == 0, r.stdout + r.stderr
+        for game in ("game1", "game2"):
+            with open(os.path.join(d, f"{game}.out.log")) as f:
+                log = f.read()
+            assert "AOI multihost mesh joined: 2 processes" in log, (
+                f"{game} did not join the mesh:\n{log[-2000:]}"
+            )
+
+        async def scenario():
+            fleet = asyncio.create_task(
+                run_fleet(
+                    10, [("127.0.0.1", ports["gate"])], 45.0,
+                    strict=True, seed=11, thing_timeout=40.0,
+                )
+            )
+            await asyncio.sleep(20.0)
+            rr = await asyncio.to_thread(
+                cli, "reload", "examples.test_game"
+            )
+            assert rr.returncode == 0, rr.stdout + rr.stderr
+            assert "reload complete" in rr.stdout
+            return await fleet
+
+        report = asyncio.run(scenario())
+        assert report["errors"] == [], format_report(report)
+        # Both games rejoined the mesh after the reload.
+        for game in ("game1", "game2"):
+            with open(os.path.join(d, f"{game}.out.log")) as f:
+                log = f.read()
+            assert log.count("AOI multihost mesh joined: 2 processes") >= 2, (
+                f"{game} did not re-join after reload:\n{log[-2000:]}"
+            )
+        print(format_report(report))
+    finally:
+        cli("kill", "examples.test_game")
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("backend", ["jnp", "pallas_interpret"])
 def test_two_process_engine_matches_single(tmp_path, backend):
