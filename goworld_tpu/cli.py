@@ -341,8 +341,18 @@ def cmd_reload(args) -> int:
              "-restore"] + cfg_argv,
         )
         started.append((name, proc, offset))
-    for name, proc, offset in started:
-        _wait_tag(run_dir, name, consts.GAME_STARTED_TAG, proc, offset)
+    try:
+        for name, proc, offset in started:
+            _wait_tag(run_dir, name, consts.GAME_STARTED_TAG, proc, offset)
+    except SystemExit:
+        # Same reap as cmd_start's batch spawn: one failed restore must
+        # not leave its batch-mates daemonized (a multihost peer sits
+        # wedged at the mesh barrier holding its ports, and the next
+        # start/reload fails on port conflicts until a manual `kill`).
+        for name, proc, _ in started:
+            if proc.poll() is None:
+                proc.terminate()
+        raise
     print("reload complete")
     return 0
 

@@ -919,6 +919,13 @@ class PendingStep:
         except AttributeError:  # older jax array types
             return True
 
+    def wait_device(self) -> None:
+        """Block until the device step has finished computing the packed
+        result (collect() after this times only the host copy + unpack).
+        Latency instrumentation seam: the BASELINE p99 diff-latency budget
+        is measured from step completion to events-on-host (bench.py)."""
+        jax.block_until_ready(self._out)
+
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         """Fetch (enter_pairs, leave_pairs, dropped); one blocking read."""
         assert not self._collected, "PendingStep already collected"

@@ -400,6 +400,11 @@ class ShardedPendingStep:
         except AttributeError:
             return True
 
+    def wait_device(self) -> None:
+        """Block until the sharded step finishes computing (parity with
+        PendingStep.wait_device — the aoi.drain latency seam)."""
+        jax.block_until_ready(self._out)
+
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         assert not self._collected, "ShardedPendingStep already collected"
         self._collected = True

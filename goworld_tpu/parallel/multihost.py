@@ -91,6 +91,11 @@ class MultiHostPendingStep:
         except AttributeError:
             return True
 
+    def wait_device(self) -> None:
+        """Block until the local shard's step finishes computing (parity
+        with PendingStep.wait_device — the aoi.drain latency seam)."""
+        jax.block_until_ready(self._out)
+
     def collect(self) -> tuple[np.ndarray, np.ndarray, int]:
         """(local_enters, local_leaves, dropped): pairs whose ENTITY side
         lives on this process (global ids)."""

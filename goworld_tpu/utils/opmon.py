@@ -13,13 +13,31 @@ import time
 from goworld_tpu.utils import gwlog
 
 
+_RING = 512  # per-op sample ring for percentiles (beyond reference parity:
+# the BASELINE p99 delivery-latency axis needs live percentiles, not just
+# count/avg/max — bounded memory, O(1) record, sort only at dump time)
+
+
 class _OpStat:
-    __slots__ = ("count", "total", "max")
+    __slots__ = ("count", "total", "max", "ring", "ring_i")
 
     def __init__(self) -> None:
         self.count = 0
         self.total = 0.0
         self.max = 0.0
+        self.ring: list[float] = []
+        self.ring_i = 0
+
+    def record(self, took: float) -> None:
+        self.count += 1
+        self.total += took
+        if took > self.max:
+            self.max = took
+        if len(self.ring) < _RING:
+            self.ring.append(took)
+        else:
+            self.ring[self.ring_i] = took
+            self.ring_i = (self.ring_i + 1) % _RING
 
 
 _lock = threading.Lock()
@@ -41,10 +59,7 @@ class Operation:
             st = _stats.get(self.name)
             if st is None:
                 st = _stats[self.name] = _OpStat()
-            st.count += 1
-            st.total += took
-            if took > st.max:
-                st.max = took
+            st.record(took)
         if warn_threshold and took > warn_threshold:
             gwlog.warnf("opmon: operation %s took %.3fs > %.3fs", self.name, took, warn_threshold)
         return took
@@ -54,19 +69,30 @@ def dump() -> dict[str, dict[str, float]]:
     with _lock:
         out = {}
         for name, st in _stats.items():
-            out[name] = {
+            entry = {
                 "count": st.count,
                 "avg": st.total / st.count if st.count else 0.0,
                 "max": st.max,
             }
+            if st.ring:
+                s = sorted(st.ring)
+                # Nearest-rank percentiles: ceil(q*n)-1, NOT int(q*n) —
+                # the latter returns the max (p100) for n in 100..101 and
+                # overstates p99 generally.
+                entry["p50"] = s[max(0, -(-len(s) * 50 // 100) - 1)]
+                entry["p99"] = s[max(0, -(-len(s) * 99 // 100) - 1)]
+            out[name] = entry
         return out
 
 
 def dump_log() -> None:
     for name, st in sorted(dump().items()):
         gwlog.infof(
-            "opmon: %-32s count=%-8d avg=%.3fms max=%.3fms",
-            name, st["count"], st["avg"] * 1000, st["max"] * 1000,
+            "opmon: %-32s count=%-8d avg=%.3fms p50=%.3fms p99=%.3fms "
+            "max=%.3fms",
+            name, st["count"], st["avg"] * 1000,
+            st.get("p50", 0.0) * 1000, st.get("p99", 0.0) * 1000,
+            st["max"] * 1000,
         )
 
 

@@ -434,6 +434,24 @@ def test_step_async_pipeline_matches_sync():
     assert sync_stream == pipe_stream
 
 
+def test_wait_device_then_collect_matches_sync():
+    """wait_device() (the bench's post-step drain-latency seam) must not
+    perturb the event stream: step_async + wait_device + collect == step."""
+    eng_sync, eng_wait = engine(), engine()
+    pos, active, space, radius = make_world(256, 220, seed=5)
+    rng = np.random.default_rng(5)
+    for _ in range(4):
+        e1, l1, _ = eng_sync.step(pos, active, space, radius)
+        pend = eng_wait.step_async(pos, active, space, radius)
+        pend.wait_device()
+        assert pend.is_ready()
+        e2, l2, _ = pend.collect()
+        assert sorted(map(tuple, e1)) == sorted(map(tuple, e2))
+        assert sorted(map(tuple, l1)) == sorted(map(tuple, l2))
+        pos = np.clip(pos + rng.normal(0, 30.0, pos.shape), 0, 1500).astype(
+            np.float32)
+
+
 # --- Pallas path (interpret mode = the kernel itself, CPU-executed) ---------
 
 PALLAS_PARAMS = NeighborParams(

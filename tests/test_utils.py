@@ -88,6 +88,9 @@ def test_opmon():
     op.finish()
     d = opmon.dump()
     assert d["test.op"]["count"] == 2
+    # Percentiles from the bounded sample ring (beyond reference parity:
+    # the live p99 delivery-latency axis).
+    assert 0.0 <= d["test.op"]["p50"] <= d["test.op"]["p99"] <= d["test.op"]["max"]
 
 
 def test_crontab_every_n_minutes():

@@ -98,7 +98,7 @@ def main() -> int:
         print("bench output not JSON:", line[-1][:500])
         return 3
     ok = rc == 0 and data.get("actual_backend") == "tpu" and not data.get("error")
-    dest = "BENCH_LOCAL_r04.json" if ok else "BENCH_LOCAL_r04_failed.json"
+    dest = "BENCH_LOCAL_r05.json" if ok else "BENCH_LOCAL_r05_failed.json"
     with open(os.path.join(REPO, dest), "w") as f:
         json.dump(data, f, indent=1)
     print("saved", dest, "| headline:", data.get("value"), data.get("unit"),
