@@ -126,6 +126,13 @@ class AOIConfig:
     # the same op sequence). Mutually exclusive with mesh_shards > 1.
     multihost_coordinator: str = ""  # "" = disabled
     multihost_processes: int = 0  # 0 = len(games)
+    # Delivery model of the batched engine: "pipelined" (default — diffs
+    # land one game tick late, the loop never stalls on device compute) or
+    # "sync" (diffs land the same tick, within one readback of the step
+    # completing — the p99 < 5 ms axis — at the cost of the logic loop
+    # stalling for the step's device time every AOI tick). xzlist is
+    # inherently synchronous and ignores this.
+    delivery: str = "pipelined"  # pipelined | sync
 
 
 @dataclasses.dataclass
@@ -291,6 +298,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             space_slots=int(s.get("space_slots", 0)),
             multihost_coordinator=s.get("multihost_coordinator", "").strip(),
             multihost_processes=int(s.get("multihost_processes", 0)),
+            delivery=s.get("delivery", "pipelined").strip().lower(),
         )
     if cp.has_section("debug"):
         cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
@@ -326,6 +334,22 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[aoi] cell_size must be >= 0 (0 = default)")
     if a.space_slots < 0:
         raise ValueError("[aoi] space_slots must be >= 0 (0 = default)")
+    if a.delivery not in ("pipelined", "sync"):
+        raise ValueError(
+            f"[aoi] delivery must be pipelined|sync, got {a.delivery!r}"
+        )
+    if a.delivery == "sync" and a.multihost_coordinator:
+        # Sync delivery stalls the loop inside device collectives; on the
+        # DCN tier a dead peer would turn that stall into a permanent
+        # wedge of every survivor's logic loop AND defeat the freeze
+        # flush's liveness bound (code-review r5). The multihost tier is
+        # pipelined by design — frame-skipping keeps a dead peer
+        # degraded-but-live.
+        raise ValueError(
+            "[aoi] delivery = sync is incompatible with "
+            "multihost_coordinator (a dead peer would wedge every "
+            "survivor's logic loop inside a collective); use pipelined"
+        )
     for gid, g in cfg.games.items():
         if g.aoi_platform not in ("", "auto", "cpu", "tpu"):
             raise ValueError(

@@ -50,6 +50,7 @@ class Runtime:
         # jax.distributed mesh ([aoi] multihost_coordinator; the game
         # service calls init_multihost before any jax use).
         self.aoi_multihost: bool = False
+        self.aoi_delivery: str = "pipelined"  # [aoi] delivery: pipelined | sync
         self.storage = None  # object with .save/.load/.exists (storage module)
         self.game_service = None  # the running GameService, if any
 
@@ -74,6 +75,7 @@ class Runtime:
                 params, mesh_shards=self.aoi_mesh_shards,
                 multihost=self.aoi_multihost,
             )
+            self.aoi_service.delivery = self.aoi_delivery
         return self.aoi_service
 
     def new_aoi_manager(self, distance: float):

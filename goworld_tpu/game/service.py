@@ -84,6 +84,7 @@ class GameService:
         # [aoi] capacity/cell/mesh knobs → engine params (ini is the single
         # source of truth; tests may pre-seed rt.aoi_params to override).
         rt.aoi_mesh_shards = max(1, self.cfg.aoi.mesh_shards)
+        rt.aoi_delivery = self.cfg.aoi.delivery
         if rt.aoi_backend != "xzlist" and rt.aoi_params is None:
             from goworld_tpu.entity.aoi.batched import params_from_config
 

@@ -94,6 +94,45 @@ def test_storage_kvdb_aoi(cfg):
     assert cfg.kvdb.type == "filesystem"
     assert cfg.aoi.backend == "xzlist"
     assert cfg.aoi.max_entities == 4096
+    assert cfg.aoi.delivery == "pipelined"  # default
+
+
+def test_aoi_delivery_knob(cfg, tmp_path):
+    """[aoi] delivery parses and validates (pipelined | sync only)."""
+    good = SAMPLE.replace("backend = xzlist",
+                          "backend = xzlist\ndelivery = sync")
+    p = tmp_path / "sync.ini"
+    p.write_text(good)
+    read_config.set_config_file(str(p))
+    try:
+        assert read_config.get().aoi.delivery == "sync"
+    finally:
+        read_config.set_config_file(None)
+    bad = SAMPLE.replace("backend = xzlist",
+                         "backend = xzlist\ndelivery = later")
+    p = tmp_path / "bad_delivery.ini"
+    p.write_text(bad)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match="delivery"):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
+    # sync + multihost is a wedge factory (a dead peer stalls every
+    # survivor's loop inside a collective) — must be rejected up front.
+    mh = SAMPLE.replace(
+        "backend = xzlist",
+        "backend = tpu\ndelivery = sync\n"
+        "multihost_coordinator = 127.0.0.1:18890",
+    )
+    p = tmp_path / "sync_multihost.ini"
+    p.write_text(mh)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match="multihost"):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
 
 
 def test_per_game_aoi_platform(cfg, tmp_path):

@@ -6,6 +6,8 @@ Mirrors the reference test strategy (SURVEY.md §4.1): attr tree behavior
 client ownership, and AOI interest with both backends.
 """
 
+import time
+
 import pytest
 
 from goworld_tpu.entity import attrs as attrs_mod
@@ -301,6 +303,55 @@ def test_batched_aoi_equivalent_behavior():
     em.runtime.tick()
     em.runtime.tick()
     assert not a.is_interested_in(b)
+    assert a.leave_events == [b]
+
+
+def test_batched_aoi_sync_delivery_same_tick():
+    """[aoi] delivery = sync: enter/leave diffs land the SAME tick (one
+    runtime.tick per observable transition, vs two in pipelined mode —
+    compare test_batched_aoi_equivalent_behavior)."""
+    _setup_batched()
+    em.runtime.aoi_delivery = "sync"
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(50, 0, 0))
+    em.runtime.tick()
+    assert a.is_interested_in(b) and b.is_interested_in(a)
+    b.set_position(Vector3(500, 0, 0))
+    em.runtime.tick()
+    assert not a.is_interested_in(b)
+    assert a.leave_events == [b]
+
+
+def test_batched_aoi_sync_stream_equals_pipelined_shifted():
+    """Mode parity: the sync event stream is the pipelined stream with the
+    one-tick delivery lag removed — same events, earlier timing. Also
+    crosses modes mid-run (sync-mode tick after pipelined dispatches must
+    first deliver the leftover in-flight step, not drop it)."""
+    _setup_batched()
+    sp = _setup_space()
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(50, 0, 0))
+    em.runtime.tick()  # pipelined dispatch; delivery still pending
+    svc = em.runtime.aoi_service
+    svc.delivery = "sync"
+    # The sync tick delivers the leftover pipelined step once it is
+    # OBSERVED ready (it frame-skips while the device is still busy —
+    # same backpressure as pipelined wait=False), so tick until the
+    # events land rather than assuming readiness on the first call.
+    deadline = time.monotonic() + 30.0
+    while not a.is_interested_in(b):
+        assert time.monotonic() < deadline, "sync delivery never landed"
+        em.runtime.tick()
+    b.set_position(Vector3(500, 0, 0))
+    deadline = time.monotonic() + 30.0
+    while a.is_interested_in(b):
+        assert time.monotonic() < deadline, "sync leave never landed"
+        em.runtime.tick()
     assert a.leave_events == [b]
 
 
