@@ -106,12 +106,14 @@ class ClientBot:
         heartbeat_interval: float = 5.0,
         tls: bool = False,
         compress: bool = False,
+        compress_format: str = "snappy",
     ) -> None:
         self.name = name
         self.strict = strict
         self.heartbeat_interval = heartbeat_interval
         self.tls = tls
         self.compress = compress
+        self.compress_format = compress_format
         self.conn: Optional[GoWorldConnection] = None
         self.entities: dict[str, ClientEntity] = {}
         self.player: Optional[ClientEntity] = None
@@ -135,7 +137,7 @@ class ClientBot:
         reader, writer = await asyncio.open_connection(host, port, ssl=ssl_ctx)
         pconn = PacketConnection(reader, writer)
         if self.compress:
-            pconn.enable_compression()
+            pconn.enable_compression(self.compress_format)
         self.conn = GoWorldConnection(pconn)
         self._start_pumps()
 
@@ -172,7 +174,7 @@ class ClientBot:
 
         pconn = await connect_rudp(host, port, loss_simulation)
         if self.compress:
-            pconn.enable_compression()
+            pconn.enable_compression(self.compress_format)
         self.conn = GoWorldConnection(pconn)
         self._start_pumps()
 

@@ -67,6 +67,9 @@ class GateConfig:
     log_file: str = ""
     log_level: str = "info"
     compress_connection: bool = False
+    # Codec when compress_connection is on: snappy is the reference's
+    # gate↔client codec (ClientProxy.go:42-45); zlib retained as an option.
+    compress_format: str = "snappy"  # snappy | zlib
     encrypt_connection: bool = False
     rsa_key: str = ""
     rsa_cert: str = ""
@@ -259,6 +262,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             log_file=s.get("log_file", ""),
             log_level=s.get("log_level", "info"),
             compress_connection=s.get("compress_connection", "false").lower() in ("1", "true", "yes"),
+            compress_format=s.get("compress_format", "snappy").strip().lower(),
             encrypt_connection=s.get("encrypt_connection", "false").lower() in ("1", "true", "yes"),
             rsa_key=s.get("rsa_key", ""),
             rsa_cert=s.get("rsa_cert", ""),
@@ -350,6 +354,12 @@ def _validate(cfg: GoWorldConfig) -> None:
             "multihost_coordinator (a dead peer would wedge every "
             "survivor's logic loop inside a collective); use pipelined"
         )
+    for gid, g in cfg.gates.items():
+        if g.compress_format not in ("snappy", "zlib"):
+            raise ValueError(
+                f"gate{gid}: compress_format must be snappy|zlib, "
+                f"got {g.compress_format!r}"
+            )
     for gid, g in cfg.games.items():
         if g.aoi_platform not in ("", "auto", "cpu", "tpu"):
             raise ValueError(

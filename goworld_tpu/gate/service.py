@@ -189,7 +189,7 @@ class GateService:
     async def _serve_client(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         pconn = PacketConnection(reader, writer)
         if self.gate_cfg.compress_connection:
-            pconn.enable_compression()
+            pconn.enable_compression(self.gate_cfg.compress_format)
         await self._pump_client(GoWorldConnection(pconn))
 
     async def _start_rudp_server(self) -> None:
@@ -202,7 +202,7 @@ class GateService:
 
         def accept(pconn) -> None:
             if self.gate_cfg.compress_connection:
-                pconn.enable_compression()
+                pconn.enable_compression(self.gate_cfg.compress_format)
             loop.create_task(self._pump_client(GoWorldConnection(pconn)))
 
         self._rudp_listener = RUDPListener(accept)

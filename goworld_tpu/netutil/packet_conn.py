@@ -8,10 +8,11 @@ transport write buffering plus an explicit ``flush_interval`` drain task
 provides the same batching.
 
 Optional per-packet compression (the reference wraps gate↔client conns in
-snappy, ClientProxy.go:42-45; snappy isn't in this image, so zlib): when
-enabled on both ends, payloads over a small threshold are deflated and the
-length prefix's high bit marks them (the bit the reference reserves,
-PAYLOAD_LEN_MASK).
+snappy, ClientProxy.go:42-45): payloads over a small threshold are
+compressed with snappy (from-scratch codec in native/ — the library isn't
+in the image; zlib remains selectable) and a length-prefix flag bit marks
+the codec per packet (the bit role the reference reserves via
+PAYLOAD_LEN_MASK), so recv auto-detects and enabling is one-sided safe.
 """
 
 from __future__ import annotations
@@ -45,7 +46,7 @@ class PacketConnection:
         self._pending: list[bytes] = []
         self._flush_task: asyncio.Task | None = None
         self._closed = False
-        self._compress = False
+        self._compress = 0  # 0 off | 1 zlib | 2 snappy (native.pack modes)
         self.dropped = 0  # packets discarded because the conn was closed
         # Batched recv: raw bytes accumulate here and whole chunks are
         # deframed in one native.split call (C when available) — one await
@@ -57,10 +58,14 @@ class PacketConnection:
         self._rframes: collections.deque = collections.deque()
         self._recv_error: str | None = None
 
-    def enable_compression(self) -> None:
-        """Turn on per-packet zlib for SENDS (recv always auto-detects via
-        the length-prefix flag bit, so enabling is one-sided safe)."""
-        self._compress = True
+    def enable_compression(self, fmt: str = "snappy") -> None:
+        """Turn on per-packet compression for SENDS (recv always
+        auto-detects via the length-prefix flag bits, so enabling is
+        one-sided safe). ``fmt``: "snappy" (reference parity,
+        ClientProxy.go:42-45) or "zlib"."""
+        if fmt not in ("snappy", "zlib"):
+            raise ValueError(f"unknown compression format {fmt!r}")
+        self._compress = 2 if fmt == "snappy" else 1
 
     @property
     def peername(self):

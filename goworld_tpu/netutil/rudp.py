@@ -304,7 +304,7 @@ class RUDPPacketConnection:
     def __init__(self, endpoint: RUDPEndpoint, peername=None) -> None:
         self._ep = endpoint
         self._peername = peername
-        self._compress = False
+        self._compress = 0  # 0 off | 1 zlib | 2 snappy (native.pack modes)
 
     @property
     def peername(self):
@@ -314,8 +314,12 @@ class RUDPPacketConnection:
     def dropped(self) -> int:
         return self._ep.dropped
 
-    def enable_compression(self) -> None:
-        self._compress = True
+    def enable_compression(self, fmt: str = "snappy") -> None:
+        """Same contract as PacketConnection.enable_compression (recv
+        auto-detects per packet via the length-prefix flag bits)."""
+        if fmt not in ("snappy", "zlib"):
+            raise ValueError(f"unknown compression format {fmt!r}")
+        self._compress = 2 if fmt == "snappy" else 1
 
     def send_packet(self, msgtype: int, packet: Packet) -> None:
         self._ep.send_bytes(

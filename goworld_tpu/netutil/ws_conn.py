@@ -46,7 +46,7 @@ class WSPacketConnection:
         except Exception:
             return None
 
-    def enable_compression(self) -> None:
+    def enable_compression(self, fmt: str = "snappy") -> None:
         pass  # permessage-deflate is negotiated at the WS handshake
 
     # --- send --------------------------------------------------------------

@@ -40,19 +40,21 @@ def test_c_module_built():
 
 def test_pack_split_round_trip_cross_impl():
     """Frames packed by either impl split identically under BOTH impls,
-    compressed and not, across a fuzzed corpus."""
+    across compression modes (0 off / 1 zlib / 2 snappy) and a fuzzed
+    corpus. This is the cross-check of the two independent snappy codecs:
+    they need not emit identical bytes, but each must decode the other."""
     import random
 
     rng = random.Random(3)
     msgs = []
-    for i in range(200):
+    for i in range(300):
         mt = rng.randrange(0, 65536)
-        n = rng.choice([0, 1, 2, 63, 64, 256, 1000, 5000])
+        n = rng.choice([0, 1, 2, 63, 64, 256, 1000, 5000, 70000])
         payload = bytes(rng.getrandbits(8) for _ in range(min(n, 200))) * (
             max(1, n // 200)
         )
         payload = payload[:n]
-        compress = rng.random() < 0.5
+        compress = rng.choice([0, 1, 2])
         msgs.append((mt, payload, compress))
 
     for pname, _, ppack in impls():
@@ -140,10 +142,119 @@ def test_pack_rejects_oversize_and_bad_msgtype():
 
 def test_pack_skips_unhelpful_compression():
     """Incompressible payloads ship uncompressed even with compress on
-    (flag bit clear), in both impls."""
+    (flag bits clear), in both impls and both codecs."""
     payload = os.urandom(1000)
     for name, _, pack in impls():
-        buf = pack(3, payload, True, 64, MAXP)
+        for mode in (1, 2):
+            buf = pack(3, payload, mode, 64, MAXP)
+            (raw,) = struct.unpack_from("<I", buf, 0)
+            assert not (raw & 0xC0000000), (name, mode)
+            assert buf[6:] == payload
+
+
+# --- snappy codec (from-scratch; reference gate codec ClientProxy.go:42-45) --
+
+
+def test_snappy_known_vectors():
+    """Hand-computed vectors pin the BLOCK FORMAT itself (round-trip tests
+    alone could pass on a self-consistent-but-wrong codec): varint
+    preamble, literal tags, 11-bit copy, overlapping copy replication."""
+    # "" -> just the varint 0 preamble
+    assert pyframe.snappy_compress(b"") == b"\x00"
+    # one literal byte: varint 1, tag (len-1)<<2 = 0, the byte
+    assert pyframe.snappy_compress(b"a") == b"\x01\x00a"
+    assert pyframe.snappy_decompress(b"\x01\x00a", 100) == b"a"
+    # literal 'a' + copy1 offset=1 len=10 replicates 'a' (overlap rule)
+    manual = bytes([11, 0x00, ord("a"), 1 | ((10 - 4) << 2), 1])
+    assert pyframe.snappy_decompress(manual, 100) == b"a" * 11
+    # two-byte-offset copy: "abcd"*3 = lit "abcd" + copy off 4 len 8
+    comp = pyframe.snappy_compress(b"abcd" * 3)
+    assert pyframe.snappy_decompress(comp, 100) == b"abcd" * 3
+    # varint preamble > 0x7f uses the continuation bit
+    data = bytes(200)
+    comp = pyframe.snappy_compress(data)
+    assert comp[0] == 0xC8 and comp[1] == 0x01  # 200 = 0b11001000 -> c8 01
+    assert pyframe.snappy_decompress(comp, 300) == data
+
+
+def test_snappy_bomb_and_malformed():
+    """Declared-size cap guard + malformed streams must error cleanly in
+    BOTH impls (split surfaces them as connection-fatal errors)."""
+    huge = struct.pack("<I", 5 | 0x40000000) + b"\xff\xff\xff\x7f\x00"
+    truncated = struct.pack("<I", 3 | 0x40000000) + b"\x0a\xf0\x41"
+    bad_offset = struct.pack("<I", 4 | 0x40000000) + bytes(
+        [4, 0x00, ord("x"), 0x09]  # copy1 needs an offset byte: truncated
+    )
+    both_flags = struct.pack("<I", 3 | 0xC0000000) + b"abc"
+    good = pyframe.pack(5, b"ok", 2, 1, MAXP)
+    for case, bad in {
+        "bomb": huge, "trunc": truncated, "badcopy": bad_offset,
+        "both_flags": both_flags,
+    }.items():
+        for name, split, _ in impls():
+            frames, consumed, err = split(good + bad, MAXP)
+            assert err is not None, (name, case)
+            assert consumed == len(good), (name, case)
+            assert [(mt, bytes(p)) for mt, p in frames] == [(5, b"ok")], (
+                name, case
+            )
+
+
+def test_snappy_adversarial_expansion_payload():
+    """Regression (code-review r5): a payload engineered so the greedy
+    encoder's output EXCEEDS the input (61-byte junk runs + cycling 4-byte
+    sentinels whose recurrence gap forces 3-byte copies that gain only 1)
+    overran the C scratch buffer sized by a too-small worst-case bound —
+    glibc heap corruption from one remote-influenced packet. The encoder
+    is now hard-bounded by its buffer and ships such payloads
+    uncompressed (flag bits clear)."""
+    import random
+
+    rng = random.Random(5)
+    chunks = []
+    sentinels = [bytes([0xF0 | (k >> 2), 0xA0 | (k & 3), 0x55, k])
+                 for k in range(33)]
+    k = 0
+    while sum(map(len, chunks)) < 32047:
+        chunks.append(rng.randbytes(61))
+        chunks.append(sentinels[k % 33])
+        k += 1
+    data = b"".join(chunks)[:32047]
+    for name, split_, pack in impls():
+        buf = pack(7, data, 2, 16, MAXP)  # must not crash / corrupt
         (raw,) = struct.unpack_from("<I", buf, 0)
-        assert not (raw & 0x80000000), name
-        assert buf[6:] == payload
+        frames, consumed, err = split_(buf, MAXP)
+        assert err is None and frames[0] == (7, data), name
+    # And larger random blobs keep round-tripping after the bound change.
+    blob = random.Random(6).randbytes(200000)
+    for name, split_, pack in impls():
+        buf = pack(7, blob, 2, 16, MAXP)
+        frames, _, err = split_(buf, MAXP)
+        assert err is None and bytes(frames[0][1]) == blob, name
+
+
+def test_snappy_structured_corpus_cross_impl():
+    """Compressible structure across block boundaries: long runs, repeats
+    straddling the 32 KiB fragment size, overlap-heavy periodic data —
+    each impl's output decoded by the other."""
+    import random
+
+    rng = random.Random(9)
+    corpus = [
+        bytes(100000),                      # long zero run
+        b"ab" * 40000,                      # period-2 overlap copies
+        b"hello world " * 8000,             # text-ish
+        rng.randbytes(3) * 30000,           # period-3
+        bytes([rng.randrange(4) for _ in range(70000)]),  # low-entropy
+        rng.randbytes(40000),               # incompressible > 1 block
+    ]
+    for d in corpus:
+        for pname, _, ppack in impls():
+            buf = ppack(9, d, 2, 16, MAXP)
+            for sname, ssplit, _ in impls():
+                frames, consumed, err = ssplit(buf, MAXP)
+                assert err is None, (pname, sname, len(d))
+                assert consumed == len(buf)
+                assert frames[0][0] == 9 and bytes(frames[0][1]) == d, (
+                    pname, sname, len(d)
+                )

@@ -211,15 +211,19 @@ def test_rudp_congestion_mode_delivers_under_loss():
 
 
 def test_rudp_packet_connection_compression_roundtrip():
+    """Both codecs, including the fmt-string call the gate/client make
+    (regression: enable_compression(fmt) raised TypeError on RUDP while
+    TCP worked — code-review r5)."""
     async def run():
-        a, b = _pipe_pair()
-        ca, cb = RUDPPacketConnection(a), RUDPPacketConnection(b)
-        ca.enable_compression()
-        pkt = Packet(b"Z" * 5000)  # compressible
-        ca.send_packet(42, pkt)
-        mt, p = await asyncio.wait_for(cb.recv_packet(), 10)
-        assert (mt, p.payload) == (42, b"Z" * 5000)
-        ca.close(); cb.close()
+        for fmt in ("snappy", "zlib"):
+            a, b = _pipe_pair()
+            ca, cb = RUDPPacketConnection(a), RUDPPacketConnection(b)
+            ca.enable_compression(fmt)
+            pkt = Packet(b"Z" * 5000)  # compressible
+            ca.send_packet(42, pkt)
+            mt, p = await asyncio.wait_for(cb.recv_packet(), 10)
+            assert (mt, p.payload) == (42, b"Z" * 5000), fmt
+            ca.close(); cb.close()
 
     asyncio.run(run())
 
