@@ -165,14 +165,22 @@ class ClientBot:
         self._start_pumps()
 
     async def connect_rudp(
-        self, host: str, port: int, loss_simulation: float = 0.0
+        self, host: str, port: int, loss_simulation: float = 0.0,
+        protocol: str = "kcp",
     ) -> None:
-        """Connect over the reliable-UDP transport (the reference's -mode
-        kcp; netutil/rudp.py). ``loss_simulation`` drops that fraction of
-        outgoing datagrams — the ARQ layer must recover (tests)."""
-        from goworld_tpu.netutil.rudp import connect_rudp
+        """Connect over reliable UDP. ``protocol``: "kcp" = the real KCP
+        wire protocol (the reference's -mode kcp; netutil/kcp.py) or
+        "native" = the in-repo ARQ (netutil/rudp.py). ``loss_simulation``
+        drops that fraction of outgoing datagrams — the ARQ layer must
+        recover (tests). Must match the gate's [gate] rudp_protocol."""
+        if protocol == "kcp":
+            from goworld_tpu.netutil.kcp import connect_kcp
 
-        pconn = await connect_rudp(host, port, loss_simulation)
+            pconn = await connect_kcp(host, port, loss_simulation)
+        else:
+            from goworld_tpu.netutil.rudp import connect_rudp
+
+            pconn = await connect_rudp(host, port, loss_simulation)
         if self.compress:
             pconn.enable_compression(self.compress_format)
         self.conn = GoWorldConnection(pconn)

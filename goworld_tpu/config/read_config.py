@@ -70,6 +70,10 @@ class GateConfig:
     # Codec when compress_connection is on: snappy is the reference's
     # gate↔client codec (ClientProxy.go:42-45); zlib retained as an option.
     compress_format: str = "snappy"  # snappy | zlib
+    # Reliable-UDP wire protocol beside TCP: "kcp" = the real KCP segment
+    # protocol (reference parity, GateService.go:134-165 via kcp-go;
+    # netutil/kcp.py); "native" = the in-repo ARQ (netutil/rudp.py).
+    rudp_protocol: str = "kcp"  # kcp | native
     encrypt_connection: bool = False
     rsa_key: str = ""
     rsa_cert: str = ""
@@ -263,6 +267,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             log_level=s.get("log_level", "info"),
             compress_connection=s.get("compress_connection", "false").lower() in ("1", "true", "yes"),
             compress_format=s.get("compress_format", "snappy").strip().lower(),
+            rudp_protocol=s.get("rudp_protocol", "kcp").strip().lower(),
             encrypt_connection=s.get("encrypt_connection", "false").lower() in ("1", "true", "yes"),
             rsa_key=s.get("rsa_key", ""),
             rsa_cert=s.get("rsa_cert", ""),
@@ -359,6 +364,11 @@ def _validate(cfg: GoWorldConfig) -> None:
             raise ValueError(
                 f"gate{gid}: compress_format must be snappy|zlib, "
                 f"got {g.compress_format!r}"
+            )
+        if g.rudp_protocol not in ("kcp", "native"):
+            raise ValueError(
+                f"gate{gid}: rudp_protocol must be kcp|native, "
+                f"got {g.rudp_protocol!r}"
             )
     for gid, g in cfg.games.items():
         if g.aoi_platform not in ("", "auto", "cpu", "tpu"):

@@ -195,9 +195,10 @@ class GateService:
     async def _start_rudp_server(self) -> None:
         """Serve the reliable-UDP transport on the SAME port number as TCP
         (the reference serves KCP beside TCP on one address,
-        GateService.go:134-165; protocol in netutil/rudp.py)."""
-        from goworld_tpu.netutil.rudp import RUDPListener
-
+        GateService.go:134-165). [gate] rudp_protocol picks the wire
+        protocol: "kcp" = the real KCP segment protocol (netutil/kcp.py,
+        stock-KCP interoperable) or "native" = the in-repo ARQ
+        (netutil/rudp.py)."""
         loop = asyncio.get_running_loop()
 
         def accept(pconn) -> None:
@@ -205,7 +206,14 @@ class GateService:
                 pconn.enable_compression(self.gate_cfg.compress_format)
             loop.create_task(self._pump_client(GoWorldConnection(pconn)))
 
-        self._rudp_listener = RUDPListener(accept)
+        if self.gate_cfg.rudp_protocol == "kcp":
+            from goworld_tpu.netutil.kcp import KCPListener
+
+            self._rudp_listener = KCPListener(accept)
+        else:
+            from goworld_tpu.netutil.rudp import RUDPListener
+
+            self._rudp_listener = RUDPListener(accept)
         try:
             await loop.create_datagram_endpoint(
                 lambda: self._rudp_listener,

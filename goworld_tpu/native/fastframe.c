@@ -56,8 +56,6 @@ static uint32_t rd_u32le(const unsigned char *p) {
 #define SNAPPY_BLOCK 32768 /* fragment size: offsets always fit 2 bytes */
 #define SNAPPY_HASH_BITS 14
 
-static uint32_t rd_u32le_u(const unsigned char *p) { return rd_u32le(p); }
-
 static unsigned snappy_hash(uint32_t v) {
     return (unsigned)((v * 0x1e35a7bdu) >> (32 - SNAPPY_HASH_BITS));
 }
@@ -149,14 +147,14 @@ static Py_ssize_t snappy_encode(const unsigned char *src, Py_ssize_t n,
                 i = block_end;
                 break;
             }
-            uint32_t key = rd_u32le_u(src + i);
+            uint32_t key = rd_u32le(src + i);
             unsigned h = snappy_hash(key);
             Py_ssize_t cand = table[h] == 0xffff
                                   ? -1
                                   : base + (Py_ssize_t)table[h];
             table[h] = (uint16_t)(i - base);
             if (cand >= base && cand < i &&
-                rd_u32le_u(src + cand) == key) {
+                rd_u32le(src + cand) == key) {
                 w = snappy_emit_literal(w, end, src + lit_start,
                                         i - lit_start);
                 Py_ssize_t m = i + 4, c = cand + 4;

@@ -50,7 +50,9 @@ from typing import Callable, Optional
 
 from goworld_tpu import consts, native
 from goworld_tpu.netutil.packet import Packet
-from goworld_tpu.netutil.packet_conn import ConnectionClosed
+from goworld_tpu.netutil.packet_conn import (
+    _COMPRESS_THRESHOLD, ConnectionClosed,
+)
 
 _HDR = struct.Struct("<IBII")
 CMD_DATA = 1
@@ -324,7 +326,8 @@ class RUDPPacketConnection:
     def send_packet(self, msgtype: int, packet: Packet) -> None:
         self._ep.send_bytes(
             native.pack(
-                msgtype, packet.payload, self._compress, 64,
+                msgtype, packet.payload, self._compress,
+                _COMPRESS_THRESHOLD,
                 consts.MAX_PACKET_SIZE,
             )
         )
