@@ -32,6 +32,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-ws", action="store_true", help="connect over WebSocket")
     ap.add_argument("-rudp", action="store_true",
                     help="connect over reliable UDP (the reference's kcp mode)")
+    ap.add_argument("-rudp-protocol", dest="rudp_protocol", default="kcp",
+                    choices=("kcp", "native"),
+                    help="reliable-UDP wire protocol; must match the "
+                         "gate's [gate] rudp_protocol")
+    ap.add_argument("-rudp-fec", dest="rudp_fec", default="10,3",
+                    help="kcp FEC shards 'data,parity' or 'off'; must "
+                         "match the gate's [gate] rudp_fec")
     ap.add_argument("-tls", action="store_true", help="TLS client link")
     ap.add_argument("-compress", action="store_true",
                     help="compressed client link")
@@ -66,7 +73,9 @@ def main(argv: list[str] | None = None) -> int:
     report = asyncio.run(
         run_fleet(
             args.N, gates, args.duration,
-            strict=args.strict, ws=args.ws, rudp=args.rudp, tls=args.tls,
+            strict=args.strict, ws=args.ws, rudp=args.rudp,
+            rudp_protocol=args.rudp_protocol, rudp_fec=args.rudp_fec,
+            tls=args.tls,
             compress=args.compress, seed=args.seed,
             thing_timeout=args.timeout,
         )

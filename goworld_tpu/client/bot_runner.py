@@ -63,6 +63,8 @@ class ScenarioBot:
         n_clients: int = 1,
         ws: bool = False,
         rudp: bool = False,
+        rudp_protocol: str = "kcp",
+        rudp_fec: str = "10,3",
         tls: bool = False,
         compress: bool = False,
         seed: Optional[int] = None,
@@ -74,6 +76,8 @@ class ScenarioBot:
         self.port = port
         self.ws = ws
         self.rudp = rudp
+        self.rudp_protocol = rudp_protocol
+        self.rudp_fec = rudp_fec
         self.n_clients = n_clients
         self.rng = random.Random(seed)
         self.bot = ClientBot(
@@ -288,7 +292,12 @@ class ScenarioBot:
         if self.ws:
             await self.bot.connect_ws(self.host, self.port)
         elif self.rudp:
-            await self.bot.connect_rudp(self.host, self.port)
+            from goworld_tpu.config.read_config import parse_fec
+
+            await self.bot.connect_rudp(
+                self.host, self.port, protocol=self.rudp_protocol,
+                fec=parse_fec(self.rudp_fec),
+            )
         else:
             await self.bot.connect(self.host, self.port)
         sync_task: Optional[asyncio.Task] = None
@@ -351,6 +360,8 @@ async def run_fleet(
     strict: bool = False,
     ws: bool = False,
     rudp: bool = False,
+    rudp_protocol: str = "kcp",
+    rudp_fec: str = "10,3",
     tls: bool = False,
     compress: bool = False,
     seed: Optional[int] = None,
@@ -367,7 +378,8 @@ async def run_fleet(
     bots = [
         ScenarioBot(
             i, *gates[i % len(gates)], strict=strict, n_clients=n,
-            ws=ws, rudp=rudp, tls=tls, compress=compress,
+            ws=ws, rudp=rudp, rudp_protocol=rudp_protocol,
+            rudp_fec=rudp_fec, tls=tls, compress=compress,
             seed=rng.randrange(2**31), thing_timeout=thing_timeout,
         )
         for i in range(n)

@@ -166,17 +166,20 @@ class ClientBot:
 
     async def connect_rudp(
         self, host: str, port: int, loss_simulation: float = 0.0,
-        protocol: str = "kcp",
+        protocol: str = "kcp", fec: tuple[int, int] | None = (10, 3),
     ) -> None:
         """Connect over reliable UDP. ``protocol``: "kcp" = the real KCP
         wire protocol (the reference's -mode kcp; netutil/kcp.py) or
-        "native" = the in-repo ARQ (netutil/rudp.py). ``loss_simulation``
-        drops that fraction of outgoing datagrams — the ARQ layer must
-        recover (tests). Must match the gate's [gate] rudp_protocol."""
+        "native" = the in-repo ARQ (netutil/rudp.py). ``fec`` (kcp only)
+        must MATCH the gate's [gate] rudp_fec — the FEC framing is not
+        self-identifying; (10, 3) is both sides' default.
+        ``loss_simulation`` drops that fraction of outgoing datagrams —
+        the ARQ layer must recover (tests). Protocol must match the
+        gate's [gate] rudp_protocol."""
         if protocol == "kcp":
             from goworld_tpu.netutil.kcp import connect_kcp
 
-            pconn = await connect_kcp(host, port, loss_simulation)
+            pconn = await connect_kcp(host, port, loss_simulation, fec=fec)
         else:
             from goworld_tpu.netutil.rudp import connect_rudp
 

@@ -74,6 +74,12 @@ class GateConfig:
     # protocol (reference parity, GateService.go:134-165 via kcp-go;
     # netutil/kcp.py); "native" = the in-repo ARQ (netutil/rudp.py).
     rudp_protocol: str = "kcp"  # kcp | native
+    # FEC shards for the kcp protocol ("data,parity"; "off" disables).
+    # 10,3 is the reference's exact dial shape (ListenWithOptions(addr,
+    # nil, 10, 3)): every 10 data datagrams carry 3 Reed-Solomon parity
+    # datagrams so lost packets reconstruct without a retransmit RTT.
+    # Clients must match (netutil/fec.py).
+    rudp_fec: str = "10,3"
     encrypt_connection: bool = False
     rsa_key: str = ""
     rsa_cert: str = ""
@@ -268,6 +274,7 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             compress_connection=s.get("compress_connection", "false").lower() in ("1", "true", "yes"),
             compress_format=s.get("compress_format", "snappy").strip().lower(),
             rudp_protocol=s.get("rudp_protocol", "kcp").strip().lower(),
+            rudp_fec=s.get("rudp_fec", "10,3").strip().lower(),
             encrypt_connection=s.get("encrypt_connection", "false").lower() in ("1", "true", "yes"),
             rsa_key=s.get("rsa_key", ""),
             rsa_cert=s.get("rsa_cert", ""),
@@ -314,6 +321,23 @@ def _load(path: Optional[str]) -> GoWorldConfig:
 
     _validate(cfg)
     return cfg
+
+
+def parse_fec(spec: str, gid=None) -> tuple[int, int] | None:
+    """"data,parity" → (d, p); "off" → None; anything else raises."""
+    if spec == "off":
+        return None
+    where = f"gate{gid}: " if gid is not None else ""
+    try:
+        d_s, p_s = spec.split(",")
+        d, p = int(d_s), int(p_s)
+    except ValueError:
+        raise ValueError(
+            f"{where}rudp_fec must be 'data,parity' or 'off', got {spec!r}"
+        ) from None
+    if not (1 <= d <= 128 and 1 <= p <= 128):
+        raise ValueError(f"{where}rudp_fec shards must be in [1, 128]")
+    return d, p
 
 
 def _validate(cfg: GoWorldConfig) -> None:
@@ -370,6 +394,7 @@ def _validate(cfg: GoWorldConfig) -> None:
                 f"gate{gid}: rudp_protocol must be kcp|native, "
                 f"got {g.rudp_protocol!r}"
             )
+        parse_fec(g.rudp_fec, gid)  # raises on malformed spec
     for gid, g in cfg.games.items():
         if g.aoi_platform not in ("", "auto", "cpu", "tpu"):
             raise ValueError(

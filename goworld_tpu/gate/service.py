@@ -207,9 +207,11 @@ class GateService:
             loop.create_task(self._pump_client(GoWorldConnection(pconn)))
 
         if self.gate_cfg.rudp_protocol == "kcp":
+            from goworld_tpu.config.read_config import parse_fec
             from goworld_tpu.netutil.kcp import KCPListener
 
-            self._rudp_listener = KCPListener(accept)
+            self._rudp_listener = KCPListener(
+                accept, fec=parse_fec(self.gate_cfg.rudp_fec))
         else:
             from goworld_tpu.netutil.rudp import RUDPListener
 

@@ -560,12 +560,29 @@ class KCPPacketConnection:
         conv: int,
         transmit: Callable[[bytes], None],
         on_close: Optional[Callable[["KCPPacketConnection"], None]] = None,
+        fec: tuple[int, int] | None = (10, 3),
     ) -> None:
         self.conv = conv
         self._transmit = transmit
         self._on_close = on_close
         self.loss_simulation = 0.0
+        # FEC(10,3) is the reference's exact dial shape
+        # (ListenWithOptions(addr, nil, 10, 3)); None disables the FEC
+        # framing entirely (plain KCP segments on the wire). Both ends
+        # must agree — the 6-byte header is not self-identifying.
+        if fec is not None:
+            from goworld_tpu.netutil.fec import FECDecoder, FECEncoder
+
+            self._fec_enc = FECEncoder(*fec)
+            self._fec_dec = FECDecoder(*fec)
+        else:
+            self._fec_enc = self._fec_dec = None
         self.kcp = KCP(conv, self._output)
+        if fec is not None:
+            # Keep FEC-wrapped datagrams inside the 1400-byte budget: the
+            # wrap adds 8 bytes (6 header + 2 size), so shrink the kcp
+            # mtu by exactly that (kcp-go: SetMtu(mtuDefault-headerSize)).
+            self.kcp.set_mtu(MTU_DEF - 8)
         # Reference turbo tuning (consts.go:122-131) + stream mode.
         self.kcp.set_nodelay(1, 10, 2, 1)
         self.kcp.stream = True
@@ -585,9 +602,13 @@ class KCPPacketConnection:
         return self._peername
 
     def _output(self, data: bytes) -> None:
-        if self.loss_simulation and random.random() < self.loss_simulation:
-            return
-        self._transmit(data)
+        datagrams = (self._fec_enc.encode(data)
+                     if self._fec_enc is not None else (data,))
+        for d in datagrams:
+            if self.loss_simulation and \
+                    random.random() < self.loss_simulation:
+                continue
+            self._transmit(d)
 
     async def _tick_loop(self) -> None:
         # Event-driven clocking (code-review r5): while the conversation
@@ -613,8 +634,17 @@ class KCPPacketConnection:
                 pass
 
     def on_datagram(self, data: bytes) -> None:
-        """Feed one received UDP datagram."""
-        if self.kcp.input(data) < 0:
+        """Feed one received UDP datagram (FEC-unwrapped when enabled —
+        reconstructed lost datagrams feed kcp right behind the real one)."""
+        if self._fec_dec is not None:
+            payloads = self._fec_dec.decode(data)
+        else:
+            payloads = (data,)
+        ok = False
+        for p in payloads:
+            if self.kcp.input(p) >= 0:
+                ok = True
+        if not ok:
             return
         self._wake.set()  # un-park the ticker (acks/probes/window opened)
         # ACK_NO_DELAY: flush pending acks now, not at the next tick.
@@ -707,18 +737,22 @@ class KCPPacketConnection:
 
 
 class KCPListener(asyncio.DatagramProtocol):
-    """Server side: sessions keyed by (addr, conv) on one UDP socket (the
-    shape of kcp-go's Listener, GateService.go:134-144)."""
+    """Server side: sessions keyed by remote address on one UDP socket
+    (kcp-go's Listener shape, GateService.go:134-144 — FEC parity shards
+    carry no conv, so address is the only universal demux key; the conv
+    is pinned from the opening PUSH and enforced by kcp.input)."""
 
     _TOMBSTONES = 1024  # recently closed (addr, conv) keys remembered
 
     def __init__(
         self,
         on_accept: Callable[[KCPPacketConnection], None],
+        fec: tuple[int, int] | None = (10, 3),
     ) -> None:
         self._on_accept = on_accept
+        self._fec = fec
         self._transport: Optional[asyncio.DatagramTransport] = None
-        self._sessions: dict[tuple, KCPPacketConnection] = {}
+        self._sessions: dict = {}
         # Closed conversations must not resurrect (code-review r5): an
         # evicted client still retransmitting would otherwise re-create a
         # ghost session + boot flow on its next PUSH. FIFO-bounded so an
@@ -729,19 +763,33 @@ class KCPListener(asyncio.DatagramProtocol):
     def connection_made(self, transport) -> None:
         self._transport = transport
 
+    def _first_segment(self, data: bytes) -> bytes | None:
+        """The raw KCP bytes of a datagram for session-opening decisions:
+        None when it can't open one (parity shard, runt)."""
+        if self._fec is not None:
+            from goworld_tpu.netutil import fec as fecmod
+
+            if len(data) < fecmod.DATA_OFF:
+                return None
+            (flag,) = struct.unpack_from("<H", data, 4)
+            if flag != fecmod.TYPE_DATA:
+                return None
+            return data[fecmod.DATA_OFF:]
+        return data
+
     def datagram_received(self, data: bytes, addr) -> None:
-        if len(data) < OVERHEAD:
-            return
-        (conv,) = struct.unpack_from("<I", data, 0)
-        key = (addr, conv)
-        sess = self._sessions.get(key)
+        sess = self._sessions.get(addr)
         if sess is None:
-            if key in self._tombstones:
+            seg = self._first_segment(data)
+            if seg is None or len(seg) < OVERHEAD:
+                return
+            (conv,) = struct.unpack_from("<I", seg, 0)
+            if (addr, conv) in self._tombstones:
                 return  # closed conversation: never resurrect
-            cmd = data[4]
+            cmd = seg[4]
             if cmd != CMD_PUSH:
                 return  # stray control segment for a dead conversation
-            (sn,) = struct.unpack_from("<I", data, 12)
+            (sn,) = struct.unpack_from("<I", seg, 12)
             if sn != 0:
                 # A NEW conversation's first-arriving push is sn 0 (sn 0
                 # retransmits until acked, so loss can't starve this);
@@ -752,11 +800,12 @@ class KCPListener(asyncio.DatagramProtocol):
                 conv,
                 lambda d, a=addr: self._send_to(a, d),
                 on_close=self._session_closed,
+                fec=self._fec,
             )
             sess.loss_simulation = self.loss_simulation
             sess._peername = addr
-            sess._listener_key = key
-            self._sessions[key] = sess
+            sess._listener_key = addr
+            self._sessions[addr] = sess
             self._on_accept(sess)
         sess.on_datagram(data)
 
@@ -765,7 +814,7 @@ class KCPListener(asyncio.DatagramProtocol):
         if key is None:
             return
         self._sessions.pop(key, None)
-        self._tombstones[key] = True
+        self._tombstones[(key, sess.conv)] = True
         while len(self._tombstones) > self._TOMBSTONES:
             self._tombstones.popitem(last=False)
 
@@ -786,19 +835,20 @@ class _KCPClientProtocol(asyncio.DatagramProtocol):
 
     def datagram_received(self, data: bytes, addr) -> None:
         sess = self._ref[0]
-        if sess is None or len(data) < OVERHEAD:
+        if sess is None:
             return
-        (conv,) = struct.unpack_from("<I", data, 0)
-        if conv == sess.conv:
-            sess.on_datagram(data)
+        # The socket is connected to one server; conv/format checks happen
+        # inside the session (FEC unwrap + kcp.input conv enforcement).
+        sess.on_datagram(data)
 
 
 async def connect_kcp(
     host: str, port: int, loss_simulation: float = 0.0,
-    conv: int | None = None,
+    conv: int | None = None, fec: tuple[int, int] | None = (10, 3),
 ) -> KCPPacketConnection:
-    """Client side: open a KCP conversation (random conv, kcp-go dial
-    style) and return a PacketConnection-shaped transport."""
+    """Client side: open a KCP conversation (random conv + FEC(10,3), the
+    reference's exact dial shape, ClientBot.go:153) and return a
+    PacketConnection-shaped transport. ``fec`` must match the server."""
     loop = asyncio.get_running_loop()
     ref: list = [None]
     transport, _ = await loop.create_datagram_endpoint(
@@ -807,7 +857,7 @@ async def connect_kcp(
         conv = random.getrandbits(32) or 1
     sess = KCPPacketConnection(
         conv, transport.sendto,
-        on_close=lambda s: transport.close())
+        on_close=lambda s: transport.close(), fec=fec)
     sess.loss_simulation = loss_simulation
     sess._peername = (host, port)
     ref[0] = sess
