@@ -48,6 +48,16 @@ def main(argv: list[str] | None = None) -> int:
                          "(retries happen within it); large fleets on "
                          "loaded hosts need more than the reference's 5")
     args = ap.parse_args(argv)
+    # Normalize + fail fast (same rules as the gate-side config): a bad
+    # spec must die here as a usage error, not as N per-bot ValueErrors
+    # mid-fleet.
+    args.rudp_fec = args.rudp_fec.strip().lower()
+    from goworld_tpu.config.read_config import parse_fec
+
+    try:
+        parse_fec(args.rudp_fec)
+    except ValueError as exc:
+        ap.error(str(exc))
 
     gates: list[tuple[str, int]] = []
     for spec in args.gate:

@@ -337,6 +337,10 @@ def parse_fec(spec: str, gid=None) -> tuple[int, int] | None:
         ) from None
     if not (1 <= d <= 128 and 1 <= p <= 128):
         raise ValueError(f"{where}rudp_fec shards must be in [1, 128]")
+    if d + p > 255:
+        # GF(2^8) Vandermonde rows repeat at alpha^255 = 1: a 256-shard
+        # code silently degenerates (duplicate rows → singular subsets).
+        raise ValueError(f"{where}rudp_fec data+parity must be <= 255")
     return d, p
 
 

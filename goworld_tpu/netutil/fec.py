@@ -246,9 +246,13 @@ class FECDecoder:
         shards = self._groups.get(group)
         if shards is None:
             shards = self._groups.setdefault(group, [None] * self.n)
-            # Bound memory: evict the oldest groups beyond the window.
+            # Bound memory: evict the oldest-INSERTED group beyond the
+            # window (dict insertion order) — NOT min(): after the
+            # encoder's seqid wrap, new groups have small ids and min()
+            # would evict every new group on arrival, silently killing
+            # recovery for the rest of the connection (code-review r5).
             while len(self._groups) > self.window:
-                old = min(self._groups)
+                old = next(iter(self._groups))
                 self._groups.pop(old, None)
                 self._done.pop(old, None)
         shards[idx] = pkt[HEADER_SIZE:]

@@ -320,6 +320,28 @@ def test_fec_reconstructs_lost_data_shards():
         assert sorted(got) == sorted(payloads), f"lost={lost}"
 
 
+def test_fec_recovery_survives_seqid_wrap():
+    """Regression (code-review r5): the decoder's window eviction must be
+    insertion-ordered, not id-ordered — after the encoder's seqid wrap
+    new groups have SMALL ids, and min()-eviction would pop every new
+    group on arrival, silently killing recovery forever."""
+    import itertools
+
+    from goworld_tpu.netutil.fec import FECDecoder, FECEncoder
+
+    enc = FECEncoder(2, 1)
+    dec = FECDecoder(2, 1, window=4)
+    enc.next_seqid = enc._paws - 3  # one group before the wrap
+    msgs = [bytes([i]) * 20 for i in range(12)]
+    dgs = list(itertools.chain.from_iterable(enc.encode(m) for m in msgs))
+    got: list[bytes] = []
+    for i, d in enumerate(dgs):
+        if i % 3 == 0:
+            continue  # drop every group's first data shard
+        got.extend(dec.decode(d))
+    assert sorted(got) == sorted(msgs)
+
+
 def test_fec_rs_any_d_of_n():
     """Property: ANY 10 of the 13 shards reconstruct all 10 data shards."""
     import itertools

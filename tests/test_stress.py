@@ -313,6 +313,34 @@ def batched_cluster(tmp_path):
     cli(d, "kill", "examples.test_game")
 
 
+def test_bot_army_kcp_fec(cluster):
+    """A strict fleet over the REAL KCP wire protocol with FEC(10,3) and
+    snappy compression — the reference's exact client transport shape
+    (DialWithOptions(addr, nil, 10, 3) + snappy + turbo tuning). Gates
+    serve kcp by default; zero errors required."""
+    d, gates = cluster
+    from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+    async def scenario():
+        return await run_fleet(
+            max(6, N_BOTS // 3), gates, DURATION / 2,
+            strict=True, rudp=True, compress=True, seed=7,
+            thing_timeout=20.0,
+        )
+
+    try:
+        report = asyncio.run(scenario())
+    except Exception as exc:
+        _dump_cluster(d, f"kcp fleet raised: {exc!r}")
+        raise
+    text = format_report(report)
+    if report["errors"]:
+        _dump_cluster(d, text)
+    assert report["errors"] == [], text
+    done = sum(a["count"] for a in report["things"].values())
+    assert done >= max(6, N_BOTS // 3), text
+
+
 def test_bot_army_batched_aoi(batched_cluster):
     """Strict bots over the batched AOI plane: AOI create/destroy streams to
     clients must stay exactly consistent under migration and entity churn
