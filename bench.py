@@ -151,7 +151,12 @@ def _resolve_platform(diag: dict) -> str:
             diag["tpu_platform_name"] = info
         else:
             diag["error"] = f"tpu_unavailable: {info}"
-    if platform == "cpu":
+    if platform == "cpu" or os.environ.get("BENCH_REHEARSAL") == "1":
+        # BENCH_REHEARSAL=1: drive the FULL tpu control flow (sweeps,
+        # self-tune, boids, per-item error capture) on the CPU backend —
+        # the pre-chip-day dry run. Forcing via jax.config is required:
+        # the axon plugin ignores JAX_PLATFORMS and, with a dead relay,
+        # hangs backend init forever rather than falling back.
         import jax
 
         jax.config.update("jax_platforms", "cpu")
