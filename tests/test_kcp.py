@@ -450,19 +450,21 @@ def test_adapter_under_loss():
     asyncio.run(run())
 
 
-def test_listener_accept_and_echo():
-    """Real UDP sockets: connect_kcp → KCPListener accept → echo."""
+@pytest.mark.parametrize("fec", [(10, 3), None])
+def test_listener_accept_and_echo(fec):
+    """Real UDP sockets: connect_kcp → KCPListener accept → echo, with
+    and without the FEC framing (both ends must agree; [gate] rudp_fec)."""
     from goworld_tpu.netutil.kcp import KCPListener, connect_kcp
 
     async def run():
         accepted: asyncio.Queue = asyncio.Queue()
         loop = asyncio.get_running_loop()
         transport, listener = await loop.create_datagram_endpoint(
-            lambda: KCPListener(accepted.put_nowait),
+            lambda: KCPListener(accepted.put_nowait, fec=fec),
             local_addr=("127.0.0.1", 0))
         port = transport.get_extra_info("sockname")[1]
 
-        client = await connect_kcp("127.0.0.1", port)
+        client = await connect_kcp("127.0.0.1", port, fec=fec)
         client.send_packet(5, Packet(b"ping"))
         server_conn = await asyncio.wait_for(accepted.get(), 10)
         mt, p = await asyncio.wait_for(server_conn.recv_packet(), 10)
