@@ -651,20 +651,28 @@ class KCPPacketConnection:
         if self.kcp.acklist and self.kcp.updated:
             self.kcp.current = _now_ms()
             self.kcp.flush()
+        # Drain every ready message FIRST, then deframe the lot in ONE C
+        # split call — a restore burst delivers thousands of stream chunks
+        # per client, and a Python→C crossing per chunk is measurable at
+        # fleet scale (the TCP path batch-parses whole socket reads the
+        # same way).
+        got = False
         while True:
             msg = self.kcp.recv()
             if msg is None:
                 break
             self._rbytes += msg
-            frames, consumed, err = native.split(
-                self._rbytes, gwconsts.MAX_PACKET_SIZE)
-            if consumed:
-                del self._rbytes[:consumed]
-            for mt, payload in frames:
-                self._packets.put_nowait((mt, Packet(payload)))
-            if err is not None:
-                self.close()  # malformed framed stream is fatal
-                return
+            got = True
+        if not got:
+            return
+        from goworld_tpu.netutil.packet_conn import deframe
+
+        frames, err = deframe(self._rbytes)
+        for mt, payload in frames:
+            self._packets.put_nowait((mt, Packet(payload)))
+        if err is not None:
+            self.close()  # malformed framed stream is fatal
+            return
 
     # --- PacketConnection surface ------------------------------------------
 

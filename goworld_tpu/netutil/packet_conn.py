@@ -27,6 +27,20 @@ _COMPRESS_THRESHOLD = 256  # don't deflate tiny packets (heartbeats, syncs)
 _RECV_CHUNK = 65536
 
 
+def deframe(rbytes: bytearray, max_packet: int = 0):
+    """One batched native.split over ``rbytes``, consuming the parsed
+    prefix in place. Returns (frames, error): frames parsed BEFORE a
+    malformed one are still returned, and error != None is connection-
+    fatal for the caller. The single seam for the framing contract shared
+    by the TCP, rudp, and kcp transports (code-review r5)."""
+    frames, consumed, err = native.split(
+        rbytes, max_packet or consts.MAX_PACKET_SIZE
+    )
+    if consumed:
+        del rbytes[:consumed]
+    return frames, err
+
+
 class ConnectionClosed(Exception):
     pass
 
@@ -147,11 +161,7 @@ class PacketConnection:
             if not chunk:
                 raise ConnectionClosed("connection closed while reading")
             self._rbytes += chunk
-            frames, consumed, err = native.split(
-                self._rbytes, consts.MAX_PACKET_SIZE
-            )
-            if consumed:
-                del self._rbytes[:consumed]
+            frames, err = deframe(self._rbytes)
             self._rframes.extend(frames)
             if err is not None:
                 self._recv_error = err

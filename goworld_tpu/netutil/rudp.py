@@ -244,13 +244,12 @@ class RUDPEndpoint:
 
     def _parse_stream(self) -> None:
         """Parse [u32 len][u16 msgtype][payload] frames (TCP framing) out of
-        the ordered stream — batch-deframed via native.split (C when
-        available), with the same bounded-inflate guard as the TCP path."""
-        frames, consumed, err = native.split(
-            self._instream, consts.MAX_PACKET_SIZE
-        )
-        if consumed:
-            del self._instream[:consumed]
+        the ordered stream — the shared batched deframe seam
+        (packet_conn.deframe), with the same bounded-inflate guard as the
+        TCP path."""
+        from goworld_tpu.netutil.packet_conn import deframe
+
+        frames, err = deframe(self._instream)
         for msgtype, payload in frames:
             self._packets.put_nowait((msgtype, Packet(payload)))
         if err is not None:
