@@ -42,11 +42,12 @@ import sysconfig
 from goworld_tpu.native import pyframe as _py
 
 
-def _paths() -> tuple[str, str, str]:
+def _paths(mod: str = "_fastframe",
+           source: str = "fastframe.c") -> tuple[str, str, str]:
     pkg_dir = os.path.dirname(os.path.abspath(__file__))
     suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
-    so_path = os.path.join(pkg_dir, "_fastframe" + suffix)
-    return so_path, so_path + ".srchash", os.path.join(pkg_dir, "fastframe.c")
+    so_path = os.path.join(pkg_dir, mod + suffix)
+    return so_path, so_path + ".srchash", os.path.join(pkg_dir, source)
 
 
 def _source_hash(src: str) -> str:
@@ -54,8 +55,9 @@ def _source_hash(src: str) -> str:
         return hashlib.sha256(f.read()).hexdigest()
 
 
-def _build_and_import():
-    so_path, hash_path, src = _paths()
+def _build_and_import(mod: str = "_fastframe", source: str = "fastframe.c",
+                      libs: tuple[str, ...] = ("-lz",)):
+    so_path, hash_path, src = _paths(mod, source)
     want = _source_hash(src)
     have = None
     if os.path.exists(so_path):
@@ -70,7 +72,7 @@ def _build_and_import():
         tmp = so_path + f".tmp{os.getpid()}"
         cmd = [
             cc, "-O2", "-shared", "-fPIC", f"-I{include}",
-            src, "-lz", "-o", tmp,
+            src, *libs, "-o", tmp,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, so_path)  # atomic: concurrent builders race benignly
@@ -82,22 +84,28 @@ def _build_and_import():
         # just forces a rebuild next import — never a stale .so in use.)
     # Load by explicit path — no sys.path mutation (a package-dir entry
     # would let native/ files shadow top-level module names process-wide).
-    spec = importlib.util.spec_from_file_location("_fastframe", so_path)
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    spec = importlib.util.spec_from_file_location(mod, so_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
 
 
 def prebuild() -> str:
-    """Ensure the native module is built and verified against the current
-    source hash; returns the active IMPL ("c" or "python"). Called by the
-    CLI before spawning a fleet so children skip the compile entirely."""
-    global IMPL, split, pack
+    """Ensure the native modules are built and verified against the
+    current source hashes; returns the active IMPL ("c" or "python").
+    Called by the CLI before spawning a fleet so children skip the
+    compiles entirely."""
+    global IMPL, split, pack, KCPCore
     if os.environ.get("GWT_NO_NATIVE", "") == "1":
         return IMPL
     try:
         _c = _build_and_import()
         split, pack, IMPL = _c.split, _c.pack, "c"
+    except Exception:  # pragma: no cover - environment-dependent
+        pass
+    try:
+        _k = _build_and_import("_kcpcore", "kcpcore.c", libs=())
+        KCPCore = _k.KCPCore
     except Exception:  # pragma: no cover - environment-dependent
         pass
     return IMPL
@@ -106,4 +114,5 @@ def prebuild() -> str:
 IMPL = "python"
 split = _py.split
 pack = _py.pack
+KCPCore = None  # C KCP control block (netutil/kcp.py falls back to Python)
 prebuild()  # also makes later explicit prebuild() calls cheap no-ops

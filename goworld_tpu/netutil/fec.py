@@ -243,9 +243,12 @@ class FECDecoder:
         idx = seqid % self.n
         if self._done.get(group):
             return out
-        shards = self._groups.get(group)
-        if shards is None:
-            shards = self._groups.setdefault(group, [None] * self.n)
+        entry = self._groups.get(group)
+        if entry is None:
+            # [shards, have, data_have]: counters tracked on insert, not
+            # recounted per datagram (per-datagram hot path).
+            entry = self._groups.setdefault(
+                group, [[None] * self.n, 0, 0])
             # Bound memory: evict the oldest-INSERTED group beyond the
             # window (dict insertion order) — NOT min(): after the
             # encoder's seqid wrap, new groups have small ids and min()
@@ -255,9 +258,13 @@ class FECDecoder:
                 old = next(iter(self._groups))
                 self._groups.pop(old, None)
                 self._done.pop(old, None)
-        shards[idx] = pkt[HEADER_SIZE:]
-        have = sum(s is not None for s in shards)
-        data_have = sum(s is not None for s in shards[:self.rs.d])
+        shards = entry[0]
+        if shards[idx] is None:
+            shards[idx] = pkt[HEADER_SIZE:]
+            entry[1] += 1
+            if idx < self.rs.d:
+                entry[2] += 1
+        have, data_have = entry[1], entry[2]
         if have >= self.rs.d and data_have < self.rs.d:
             maxlen = max(len(s) for s in shards if s is not None)
             padded = [s.ljust(maxlen, b"\x00") if s is not None else None

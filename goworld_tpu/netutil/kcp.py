@@ -536,6 +536,12 @@ class KCP:
                 and not self.acklist and self.probe == 0
                 and self.rmt_wnd > 0)
 
+    @property
+    def has_acks(self) -> bool:
+        """Pending acks owed to the peer (shared seam with the C core —
+        native/kcpcore.c exposes the same attribute)."""
+        return bool(self.acklist)
+
     def waiting_send(self) -> int:
         return len(self.snd_buf) + len(self.snd_queue)
 
@@ -548,6 +554,21 @@ _MS_EPOCH = time.monotonic()
 
 def _now_ms() -> int:
     return int((time.monotonic() - _MS_EPOCH) * 1000) & 0xFFFFFFFF
+
+
+def make_core(conv: int, output: Callable[[bytes], None]):
+    """The KCP control block — the C hot path (native/kcpcore.c) when
+    built, else the pinned pure-Python reference above. Identical
+    semantics; the parity suite pumps random lossy transfers through
+    MIXED C/Python pairs and asserts identical delivered streams."""
+    import os
+
+    from goworld_tpu import native
+
+    if native.KCPCore is not None and \
+            os.environ.get("GWT_NO_NATIVE", "") != "1":
+        return native.KCPCore(conv, output)
+    return KCP(conv, output)
 
 
 class KCPPacketConnection:
@@ -577,7 +598,7 @@ class KCPPacketConnection:
             self._fec_dec = FECDecoder(*fec)
         else:
             self._fec_enc = self._fec_dec = None
-        self.kcp = KCP(conv, self._output)
+        self.kcp = make_core(conv, self._output)
         if fec is not None:
             # Keep FEC-wrapped datagrams inside the 1400-byte budget: the
             # wrap adds 8 bytes (6 header + 2 size), so shrink the kcp
@@ -648,7 +669,7 @@ class KCPPacketConnection:
             return
         self._wake.set()  # un-park the ticker (acks/probes/window opened)
         # ACK_NO_DELAY: flush pending acks now, not at the next tick.
-        if self.kcp.acklist and self.kcp.updated:
+        if self.kcp.has_acks and self.kcp.updated:
             self.kcp.current = _now_ms()
             self.kcp.flush()
         # Drain every ready message FIRST, then deframe the lot in ONE C

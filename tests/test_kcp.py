@@ -270,6 +270,160 @@ def test_stream_mode_coalesces_small_sends():
     assert pushes[0][7] == 20
 
 
+# --- C control block (native/kcpcore.c) parity -------------------------------
+
+
+def _cores():
+    """(name, factory) for every available control-block implementation."""
+    from goworld_tpu import native
+
+    out = [("py", KCP)]
+    if native.KCPCore is not None:
+        out.append(("c", native.KCPCore))
+    return out
+
+
+def test_c_core_built():
+    """cc is baked into the image: the C control block must be live (the
+    kcp transport silently degrading to the Python hot loop would lose
+    the fleet-scale win, same contract as test_native.test_c_module_built)."""
+    import os
+
+    from goworld_tpu import native
+
+    if os.environ.get("GWT_NO_NATIVE") == "1":
+        pytest.skip("native explicitly disabled")
+    assert native.KCPCore is not None
+
+
+def test_c_core_wire_vector_parity():
+    """The C core emits byte-identical first-flush output to the pinned
+    Python reference (same segment vector as test_push_segment_wire_vector)."""
+    for name, factory in _cores():
+        out: list[bytes] = []
+        k = factory(0x11223344, out.append)
+        k.set_nodelay(1, 10, 2, 1)
+        k.send(b"hi")
+        k.update(5)
+        expected = (struct.pack("<IBBHIII", 0x11223344, CMD_PUSH, 0, 128,
+                                5, 0, 0) + struct.pack("<I", 2) + b"hi")
+        assert out == [expected], name
+
+
+@pytest.mark.parametrize("pair", ["c-c", "c-py", "py-c"])
+def test_c_core_lossy_transfer_parity(pair):
+    """Mixed C/Python endpoint pairs interoperate over the wire through
+    20% datagram loss and deliver the exact byte stream."""
+    from goworld_tpu import native
+
+    if native.KCPCore is None:
+        pytest.skip("no C core")
+    factories = {"c": native.KCPCore, "py": KCP}
+    fa, fb = (factories[x] for x in pair.split("-"))
+    oa: list[bytes] = []
+    ob: list[bytes] = []
+    a = fa(12, oa.append)
+    b = fb(12, ob.append)
+    for k in (a, b):
+        k.set_nodelay(1, 10, 2, 1)
+        k.stream = True
+    rng = random.Random(17)
+    payload = bytes(rng.randbytes(60_000))
+    sent = 0
+    got = b""
+    t = 0
+    while len(got) < len(payload) and t < 120_000:
+        while sent < len(payload) and a.waiting_send() < 1000:
+            a.send(payload[sent:sent + 4000])
+            sent += 4000
+        a.update(t)
+        b.update(t)
+        for d in oa:
+            if rng.random() >= 0.2:
+                b.input(d)
+        oa.clear()
+        for d in ob:
+            if rng.random() >= 0.2:
+                a.input(d)
+        ob.clear()
+        while True:
+            m = b.recv()
+            if m is None:
+                break
+            got += m
+        t += 10
+    assert got == payload, f"{pair}: {len(got)}/{len(payload)}"
+
+
+def test_c_core_cycle_collected():
+    """Regression (code-review r5): the session passes a bound method as
+    output (connection -> core -> method -> connection cycle); the C type
+    must participate in cyclic GC or every closed session leaks."""
+    import gc
+    import weakref
+
+    async def run():
+        a = KCPPacketConnection(3, lambda d: None)
+        ref = weakref.ref(a)
+        a.close()
+        del a
+        # Let the loop retire the cancelled ticker task (it holds the
+        # coroutine frame, which references the session) before judging.
+        for _ in range(3):
+            await asyncio.sleep(0)
+        for _ in range(3):
+            gc.collect()
+        assert ref() is None, "closed KCP session not collected"
+
+    asyncio.run(run())
+
+
+def test_c_core_mtu_shrink_after_queue_safe():
+    """Regression (code-review r5): shrinking the mtu with larger
+    segments already queued must not overflow the C assembly buffer."""
+    for name, factory in _cores():
+        out: list[bytes] = []
+        k = factory(4, out.append)
+        k.set_nodelay(1, 10, 2, 1)
+        k.send(b"Q" * 1300)  # one segment at the default 1376 mss
+        k.set_mtu(600)       # shrink AFTER queueing
+        k.update(0)          # must emit without corruption
+        segs = segments(out)
+        assert sum(h[7] for h, _ in segs if h[1] == CMD_PUSH) == 1300, name
+        # And the stream still decodes end to end.
+        k2 = factory(4, lambda d: None)
+        for d in out:
+            assert k2.input(d) == 0, name
+        assert k2.recv() == b"Q" * 1300, name
+
+
+def test_c_core_session_attributes():
+    """The session layer's full attribute surface exists on the C core
+    (idle/check/has_acks/state/current setter/waiting_send/mss...)."""
+    from goworld_tpu import native
+
+    if native.KCPCore is None:
+        pytest.skip("no C core")
+    k = native.KCPCore(5, lambda d: None)
+    k.set_nodelay(1, 10, 2, 1)
+    k.set_wndsize(256, 256)
+    k.stream = True
+    assert k.stream is True
+    k.set_mtu(1392)
+    assert k.mss == 1392 - OVERHEAD
+    assert k.idle() is True and k.waiting_send() == 0
+    k.send(b"x")
+    assert k.idle() is False
+    k.update(0)
+    assert k.updated is True and k.state == 0
+    assert isinstance(k.check(5), int)
+    k.current = 11
+    assert k.current == 11
+    assert k.has_acks is False
+    assert k.interval == 10 and k.conv == 5
+    assert (k.snd_una, k.snd_nxt, k.rcv_nxt) == (0, 1, 0)
+
+
 # --- FEC layer (kcp-go framing + Reed-Solomon) -------------------------------
 
 
