@@ -95,7 +95,7 @@ def prebuild() -> str:
     current source hashes; returns the active IMPL ("c" or "python").
     Called by the CLI before spawning a fleet so children skip the
     compiles entirely."""
-    global IMPL, split, pack, KCPCore
+    global IMPL, split, pack, KCPCore, rs_matmul
     if os.environ.get("GWT_NO_NATIVE", "") == "1":
         return IMPL
     try:
@@ -106,6 +106,7 @@ def prebuild() -> str:
     try:
         _k = _build_and_import("_kcpcore", "kcpcore.c", libs=())
         KCPCore = _k.KCPCore
+        rs_matmul = _k.rs_matmul
     except Exception:  # pragma: no cover - environment-dependent
         pass
     return IMPL
@@ -115,4 +116,5 @@ IMPL = "python"
 split = _py.split
 pack = _py.pack
 KCPCore = None  # C KCP control block (netutil/kcp.py falls back to Python)
+rs_matmul = None  # C GF(256) row mat-mul (netutil/fec.py falls back)
 prebuild()  # also makes later explicit prebuild() calls cheap no-ops

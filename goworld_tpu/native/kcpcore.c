@@ -858,12 +858,122 @@ static PyTypeObject KCPCoreType = {
     .tp_doc = "KCP control block (C hot path; parity with kcp.py's KCP)",
 };
 
+/* --- GF(256) Reed-Solomon row mat-mul (FEC hot loop, netutil/fec.py) ----- */
+
+static unsigned char GF_MUL[256][256];
+
+static void gf_init(void) {
+    unsigned short exp[512];
+    unsigned char log[256];
+    unsigned x = 1;
+    memset(log, 0, sizeof(log));
+    for (int i = 0; i < 255; i++) {
+        exp[i] = (unsigned short)x;
+        log[x] = (unsigned char)i;
+        x <<= 1;
+        if (x & 0x100) x ^= 0x11d;
+    }
+    for (int i = 255; i < 512; i++) exp[i] = exp[i - 255];
+    for (int a = 1; a < 256; a++)
+        for (int b = 1; b < 256; b++)
+            GF_MUL[a][b] = (unsigned char)exp[log[a] + log[b]];
+}
+
+/* rs_matmul(rows, shards, length) -> list[bytes]
+ *   rows: sequence of sequences of GF coefficients (one per shard)
+ *   shards: sequence of bytes objects, each >= length (extra ignored;
+ *           shorter shards are zero-padded implicitly)
+ * Returns one length-sized bytes per row: XOR_i coeff[i] * shard[i]. */
+static PyObject *rs_matmul(PyObject *self, PyObject *args) {
+    PyObject *rows, *shards;
+    Py_ssize_t length;
+    if (!PyArg_ParseTuple(args, "OOn", &rows, &shards, &length))
+        return NULL;
+    PyObject *rows_f = PySequence_Fast(rows, "rows must be a sequence");
+    if (rows_f == NULL) return NULL;
+    PyObject *shards_f =
+        PySequence_Fast(shards, "shards must be a sequence");
+    if (shards_f == NULL) {
+        Py_DECREF(rows_f);
+        return NULL;
+    }
+    Py_ssize_t nrows = PySequence_Fast_GET_SIZE(rows_f);
+    Py_ssize_t nsh = PySequence_Fast_GET_SIZE(shards_f);
+    PyObject *out = PyList_New(nrows);
+    if (out == NULL) goto fail;
+    for (Py_ssize_t r = 0; r < nrows; r++) {
+        PyObject *row_f = PySequence_Fast(
+            PySequence_Fast_GET_ITEM(rows_f, r), "row must be a sequence");
+        if (row_f == NULL) goto fail;
+        if (PySequence_Fast_GET_SIZE(row_f) < nsh) {
+            Py_DECREF(row_f);
+            PyErr_SetString(PyExc_ValueError, "row shorter than shards");
+            goto fail;
+        }
+        PyObject *acc_obj = PyBytes_FromStringAndSize(NULL, length);
+        if (acc_obj == NULL) {
+            Py_DECREF(row_f);
+            goto fail;
+        }
+        unsigned char *acc = (unsigned char *)PyBytes_AS_STRING(acc_obj);
+        memset(acc, 0, (size_t)length);
+        for (Py_ssize_t i = 0; i < nsh; i++) {
+            long c = PyLong_AsLong(PySequence_Fast_GET_ITEM(row_f, i));
+            if (c == -1 && PyErr_Occurred()) {
+                Py_DECREF(row_f);
+                Py_DECREF(acc_obj);
+                goto fail;
+            }
+            if (c == 0) continue;
+            if (c < 0 || c > 255) {
+                Py_DECREF(row_f);
+                Py_DECREF(acc_obj);
+                PyErr_SetString(PyExc_ValueError, "coeff out of GF(256)");
+                goto fail;
+            }
+            char *sb;
+            Py_ssize_t sl;
+            if (PyBytes_AsStringAndSize(
+                    PySequence_Fast_GET_ITEM(shards_f, i), &sb, &sl) != 0) {
+                Py_DECREF(row_f);
+                Py_DECREF(acc_obj);
+                goto fail;
+            }
+            Py_ssize_t n = sl < length ? sl : length;
+            const unsigned char *tbl = GF_MUL[c];
+            const unsigned char *s = (const unsigned char *)sb;
+            if (c == 1) {
+                for (Py_ssize_t j = 0; j < n; j++) acc[j] ^= s[j];
+            } else {
+                for (Py_ssize_t j = 0; j < n; j++) acc[j] ^= tbl[s[j]];
+            }
+        }
+        Py_DECREF(row_f);
+        PyList_SET_ITEM(out, r, acc_obj);
+    }
+    Py_DECREF(rows_f);
+    Py_DECREF(shards_f);
+    return out;
+fail:
+    Py_DECREF(rows_f);
+    Py_DECREF(shards_f);
+    Py_XDECREF(out);
+    return NULL;
+}
+
+static PyMethodDef module_methods[] = {
+    {"rs_matmul", rs_matmul, METH_VARARGS,
+     "rs_matmul(rows, shards, length) -> list[bytes] (GF(256) XOR-dot)"},
+    {NULL, NULL, 0, NULL},
+};
+
 static struct PyModuleDef moduledef = {
     PyModuleDef_HEAD_INIT, "_kcpcore",
-    "C KCP control block", -1, NULL,
+    "C KCP control block", -1, module_methods,
 };
 
 PyMODINIT_FUNC PyInit__kcpcore(void) {
+    gf_init();
     if (PyType_Ready(&KCPCoreType) < 0) return NULL;
     PyObject *m = PyModule_Create(&moduledef);
     if (m == NULL) return NULL;

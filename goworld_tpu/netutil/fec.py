@@ -92,6 +92,23 @@ def _mul_shard(c: int, shard: bytes) -> int:
 
 
 def _matmul_rows(matrix_rows, shards: list[bytes], length: int):
+    import os
+
+    from goworld_tpu import native
+
+    # Both implementations require equal-length shards; enforce here so
+    # the C path (tail-pads/truncates) and the Python big-int path
+    # (front-pads/overflows) can never silently diverge on malformed
+    # input (code-review r5). Internal callers always ljust-pad.
+    for s in shards:
+        if len(s) != length:
+            raise ValueError("rs shards must all equal the given length")
+    if native.rs_matmul is not None and \
+            os.environ.get("GWT_NO_NATIVE", "") != "1":
+        # C hot loop (native/kcpcore.c rs_matmul): identical GF(256)
+        # XOR-dot; the Python path below is the pinned reference the
+        # parity test compares against.
+        return native.rs_matmul(matrix_rows, shards, length)
     out = []
     for row in matrix_rows:
         acc = 0

@@ -424,6 +424,39 @@ def test_c_core_session_attributes():
     assert (k.snd_una, k.snd_nxt, k.rcv_nxt) == (0, 1, 0)
 
 
+def test_rs_matmul_c_python_parity(monkeypatch):
+    """The C GF(256) row mat-mul (native rs_matmul, the FEC hot loop)
+    matches the SHIPPED Python fallback branch (driven via GWT_NO_NATIVE,
+    not an inline re-implementation that could drift) over random
+    matrices and shards."""
+    from goworld_tpu import native
+    from goworld_tpu.netutil import fec
+
+    if native.rs_matmul is None:
+        pytest.skip("no C rs_matmul")
+    rng = random.Random(3)
+    for trial in range(30):
+        nr = rng.randrange(1, 5)
+        ns = rng.randrange(1, 12)
+        length = rng.randrange(1, 200)
+        rows = [[rng.randrange(256) for _ in range(ns)]
+                for _ in range(nr)]
+        shards = [rng.randbytes(length) for _ in range(ns)]
+        monkeypatch.delenv("GWT_NO_NATIVE", raising=False)
+        c_out = fec._matmul_rows(rows, shards, length)
+        monkeypatch.setenv("GWT_NO_NATIVE", "1")
+        py_out = fec._matmul_rows(rows, shards, length)
+        assert c_out == py_out, trial
+    # Malformed (unequal-length) shards fail identically on both paths.
+    for env in (None, "1"):
+        if env is None:
+            monkeypatch.delenv("GWT_NO_NATIVE", raising=False)
+        else:
+            monkeypatch.setenv("GWT_NO_NATIVE", env)
+        with pytest.raises(ValueError):
+            fec._matmul_rows([[1, 1]], [b"\x01\x02", b"\x03"], 2)
+
+
 # --- FEC layer (kcp-go framing + Reed-Solomon) -------------------------------
 
 
