@@ -341,6 +341,64 @@ def test_bot_army_kcp_fec(cluster):
     assert done >= max(6, N_BOTS // 3), text
 
 
+def test_kcp_fleet_double_reload(cluster):
+    """Strict KCP+FEC+snappy fleet held through TWO live reloads — the
+    round-5 endurance shape that found the single-core harness decoding
+    ceiling (BENCH_NOTES round 5). Pinned at 24 bots (verified clean up
+    to 40 with the C control block; 60 trips strict budgets on the
+    one-core fleet process, a harness bound, not a server one)."""
+    d, gates = cluster
+    from goworld_tpu.client.bot_runner import format_report, run_fleet
+
+    n = max(6, min(24, N_BOTS // 2))
+
+    async def scenario():
+        loop = asyncio.get_running_loop()
+        fleet = asyncio.create_task(run_fleet(
+            n, gates, DURATION * 2,
+            strict=True, rudp=True, compress=True, seed=11,
+            thing_timeout=45.0,
+        ))
+        try:
+            for _ in range(2):
+                t0 = loop.time()
+                while loop.time() - t0 < DURATION * 2 / 3:
+                    if fleet.done():
+                        return await fleet  # surface the root cause NOW
+                    await asyncio.sleep(1)
+                r = await asyncio.to_thread(
+                    cli, d, "reload", "examples.test_game")
+                assert r.returncode == 0, r.stdout + r.stderr
+                assert "reload complete" in r.stdout
+            # Both reloads must have landed while the fleet was still
+            # driving load — otherwise the scenario in the name didn't run.
+            assert not fleet.done(), \
+                "fleet finished before the second reload (reloads too slow)"
+        except BaseException:
+            # Never abandon the fleet task: its StrictError is the root
+            # cause and must not be masked by a reload assert.
+            if not fleet.done():
+                fleet.cancel()
+            try:
+                await fleet
+            except (asyncio.CancelledError, Exception):
+                pass
+            raise
+        return await fleet
+
+    try:
+        report = asyncio.run(scenario())
+    except Exception as exc:
+        _dump_cluster(d, f"kcp double-reload fleet raised: {exc!r}")
+        raise
+    text = format_report(report)
+    if report["errors"]:
+        _dump_cluster(d, text)
+    assert report["errors"] == [], text
+    done = sum(a["count"] for a in report["things"].values())
+    assert done >= n, text  # the fleet must actually have done work
+
+
 def test_bot_army_batched_aoi(batched_cluster):
     """Strict bots over the batched AOI plane: AOI create/destroy streams to
     clients must stay exactly consistent under migration and entity churn
