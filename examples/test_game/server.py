@@ -22,6 +22,7 @@ from goworld_tpu.utils import gwlog
 SERVICE_NAMES = ["OnlineService", "SpaceService", "MailService", pubsub.SERVICE_NAME]
 
 PUBSUB_TEST_SUBJECTS = ["monster", "npc", "item", "avatar", "boss_*"]
+MAILBOX_CAP = 100  # newest mails kept on the avatar (see OnGetMails)
 
 MAX_AVATAR_COUNT_PER_SPACE = 100
 
@@ -280,6 +281,16 @@ class Avatar(Entity):
                 continue
             mails_attr.set(str(mail_id), mail)
             self.attrs.set("lastMailID", mail_id)
+        # Bound the mailbox: keep the newest MAILBOX_CAP. The reference
+        # never prunes (Avatar.go:217-231) — and never notices, because
+        # its CI runs with DoSendMail disabled; under a mail-enabled soak
+        # an unpruned mailbox grows without bound and rides EVERY
+        # migration (measured: 400+ KB per avatar payload, the dominant
+        # cost of a 2-game soak's memory churn — BENCH_NOTES round 5).
+        overflow = len(mails_attr) - MAILBOX_CAP
+        if overflow > 0:
+            for old_id in sorted(mails_attr.keys(), key=int)[:overflow]:
+                mails_attr.delete(old_id)
         self.call_client("OnGetMails", True)
 
     # --- pubsub (Avatar.go:247-262) ----------------------------------------

@@ -61,6 +61,11 @@ class GameService:
         self._last_packet_at = 0.0
         self._freeze_acked_at = 0.0
         self._freeze_started_at = 0.0
+        # Migrate-in volume counters (gwvar MigrateIn*): a soak whose game
+        # RSS climbs names its per-payload cost here.
+        self._migrate_in_count = 0
+        self._migrate_in_bytes = 0
+        self._migrate_in_max = 0
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -168,6 +173,28 @@ class GameService:
             # Debug HTTP server (binutil.SetupHTTPServer; game.go:107) + gwvar.
             gwvar.set_var("IsDeploymentReady", lambda: self.deployment_ready)
             gwvar.set_var("NumEntities", lambda: len(entity_manager.entities()))
+            gwvar.set_var("MigrateIn", lambda: {
+                "count": self._migrate_in_count,
+                "bytes": self._migrate_in_bytes,
+                "max_bytes": self._migrate_in_max,
+            })
+
+            def _fattest():
+                # Largest entity by serialized attr size, broken down by
+                # top-level key — names the payload that bloats migrations.
+                # One serialize per entity (per-key sizes summed), not two:
+                # /vars runs this synchronously on the game loop.
+                best = None
+                for e in entity_manager.entities().values():
+                    keys = {k: len(json.dumps(v, default=str))
+                            for k, v in e.attrs.to_dict().items()}
+                    sz = sum(keys.values())
+                    if best is None or sz > best["bytes"]:
+                        best = {"type": e.typename, "bytes": sz,
+                                "keys": keys}
+                return best
+
+            gwvar.set_var("FattestEntity", _fattest)
             # Per-type counts: the leak-hunting view (a soak that grows
             # NumEntities names its culprit here).
             def _counts():
@@ -191,6 +218,11 @@ class GameService:
             # a co-hosted /vars endpoint keeps serving it after shutdown.
             gwvar.set_var("IsDeploymentReady", False)
             gwvar.unset("NumEntities")
+            # These closures capture self + the entity graph: a stopped
+            # service must neither serve stale probes nor keep hundreds
+            # of MB of entity state alive through the gwvar registry.
+            gwvar.unset("MigrateIn")
+            gwvar.unset("FattestEntity")
             await self.cluster.stop()
             dispatchercluster.set_cluster(None)
         return self.exit_code or 0
@@ -430,7 +462,12 @@ class GameService:
         elif msgtype == MsgType.REAL_MIGRATE:
             eid = packet.read_entity_id()
             packet.read_uint16()
+            raw_len = packet.unread_len()
             data = packet.read_data()
+            self._migrate_in_count += 1
+            self._migrate_in_bytes += raw_len
+            if raw_len > self._migrate_in_max:
+                self._migrate_in_max = raw_len
             entity_manager.restore_entity(eid, data, is_migrate=True)
         elif msgtype == MsgType.CALL_NIL_SPACES:
             packet.read_uint16()

@@ -103,6 +103,46 @@ class DebugHTTPServer:
     def _route(self, path: str) -> tuple[str, str, bytes]:
         if path == "/healthz":
             return "200 OK", "text/plain", b"ok"
+        if path == "/heap/start":
+            # Live heap profiling (pprof's /heap slot, via tracemalloc):
+            # start tracing, then GET /heap for the top Python growth
+            # sites since start. ~2x alloc overhead while on; /heap/stop
+            # turns it off.
+            import tracemalloc
+
+            tracemalloc.start(12)
+            return "200 OK", "text/plain", b"tracemalloc started"
+        if path == "/heap/stop":
+            import tracemalloc
+
+            tracemalloc.stop()
+            return "200 OK", "text/plain", b"tracemalloc stopped"
+        if path == "/heap/types":
+            # GC census: live instance counts by type (top 40) — tells you
+            # WHAT is retained where tracemalloc tells you what ALLOCATED.
+            import collections as _c
+            import gc as _gc
+
+            _gc.collect()
+            counts = _c.Counter(
+                type(o).__name__ for o in _gc.get_objects())
+            body = "\n".join(f"{n:9d}  {t}" for t, n in
+                             counts.most_common(40))
+            return "200 OK", "text/plain", body.encode()
+        if path == "/heap":
+            import tracemalloc
+
+            if not tracemalloc.is_tracing():
+                return ("409 Conflict", "text/plain",
+                        b"not tracing; GET /heap/start first")
+            snap = tracemalloc.take_snapshot()
+            lines = []
+            for stat in snap.statistics("traceback")[:25]:
+                lines.append(f"{stat.size / 1e6:.2f} MB in "
+                             f"{stat.count} blocks")
+                lines.extend("    " + ln
+                             for ln in stat.traceback.format()[-6:])
+            return "200 OK", "text/plain", "\n".join(lines).encode()
         if path == "/vars":
             return ("200 OK", "application/json",
                     json.dumps(gwvar.snapshot(), default=str).encode())

@@ -374,11 +374,14 @@ def test_kcp_fleet_double_reload(cluster):
             # driving load — otherwise the scenario in the name didn't run.
             assert not fleet.done(), \
                 "fleet finished before the second reload (reloads too slow)"
-        except BaseException:
-            # Never abandon the fleet task: its StrictError is the root
-            # cause and must not be masked by a reload assert.
-            if not fleet.done():
-                fleet.cancel()
+        except BaseException as outer:
+            # Never abandon the fleet task — and when the fleet ALREADY
+            # died on its own, ITS error is the root cause: re-raise it
+            # (chained to the reload assert) instead of masking it.
+            if fleet.done() and not fleet.cancelled() and \
+                    fleet.exception() is not None:
+                raise fleet.exception() from outer
+            fleet.cancel()
             try:
                 await fleet
             except (asyncio.CancelledError, Exception):
