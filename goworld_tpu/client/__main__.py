@@ -43,6 +43,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("-compress", action="store_true",
                     help="compressed client link")
     ap.add_argument("-seed", type=int, default=None)
+    ap.add_argument("-index-base", dest="index_base", type=int, default=0,
+                    help="offset bot indices (stress_<i> identities) so "
+                         "CONCURRENT fleets against one cluster don't "
+                         "fight over the same avatars")
     ap.add_argument("-timeout", type=float, default=5.0,
                     help="per-scenario completion budget in seconds "
                          "(retries happen within it); large fleets on "
@@ -85,7 +89,7 @@ def main(argv: list[str] | None = None) -> int:
             args.N, gates, args.duration,
             strict=args.strict, ws=args.ws, rudp=args.rudp,
             rudp_protocol=args.rudp_protocol, rudp_fec=args.rudp_fec,
-            tls=args.tls,
+            tls=args.tls, index_base=args.index_base,
             compress=args.compress, seed=args.seed,
             thing_timeout=args.timeout,
         )

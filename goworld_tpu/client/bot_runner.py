@@ -367,6 +367,7 @@ async def run_fleet(
     seed: Optional[int] = None,
     spawn_interval: float = 0.02,
     thing_timeout: float = THING_TIMEOUT,
+    index_base: int = 0,
 ) -> dict:
     """Spawn ``n`` bots round-robin over ``gates``; gather a fleet report.
 
@@ -375,9 +376,13 @@ async def run_fleet(
     bots have been cancelled (the reference's fatal semantics).
     """
     rng = random.Random(seed)
+    # index_base offsets bot indices (and thus the stress_<i> usernames /
+    # avatar identities) so CONCURRENT fleets against one cluster don't
+    # fight over the same avatars (each login steals the client binding).
     bots = [
         ScenarioBot(
-            i, *gates[i % len(gates)], strict=strict, n_clients=n,
+            index_base + i, *gates[i % len(gates)], strict=strict,
+            n_clients=n,
             ws=ws, rudp=rudp, rudp_protocol=rudp_protocol,
             rudp_fec=rudp_fec, tls=tls, compress=compress,
             seed=rng.randrange(2**31), thing_timeout=thing_timeout,

@@ -108,7 +108,12 @@ class WSPacketConnection:
         self._writer_task.cancel()
         try:
             task = asyncio.get_running_loop().create_task(self._ws.close())
-            task.add_done_callback(lambda t: t.exception())
+            # Retrieve the result so the loop doesn't log "exception was
+            # never retrieved" — but a CANCELLED close (loop teardown)
+            # must be probed with cancelled() first: t.exception() raises
+            # CancelledError out of the callback and spams the log.
+            task.add_done_callback(
+                lambda t: None if t.cancelled() else t.exception())
         except RuntimeError:
             pass
 
