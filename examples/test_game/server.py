@@ -22,7 +22,6 @@ from goworld_tpu.utils import gwlog
 SERVICE_NAMES = ["OnlineService", "SpaceService", "MailService", pubsub.SERVICE_NAME]
 
 PUBSUB_TEST_SUBJECTS = ["monster", "npc", "item", "avatar", "boss_*"]
-MAILBOX_CAP = 100  # newest mails kept on the avatar (see OnGetMails)
 
 MAX_AVATAR_COUNT_PER_SPACE = 100
 
@@ -96,6 +95,14 @@ class Account(Entity):
 
 class Avatar(Entity):
     """The player entity (Avatar.go:20-322)."""
+
+    # DELIBERATE DEVIATION from the reference: Avatar.go:217-231 keeps
+    # every mail forever; under a mail-enabled soak that rides EVERY
+    # migration (measured 400+ KB/avatar, BENCH_NOTES round 5), so this
+    # server keeps only the newest MAILBOX_CAP mails (see OnGetMails).
+    # Class constant so a deploy (or parity audit) can subclass/override
+    # it — set very large to approximate keep-everything.
+    MAILBOX_CAP = 100
 
     @classmethod
     def describe_entity_type(cls, desc):
@@ -281,13 +288,10 @@ class Avatar(Entity):
                 continue
             mails_attr.set(str(mail_id), mail)
             self.attrs.set("lastMailID", mail_id)
-        # Bound the mailbox: keep the newest MAILBOX_CAP. The reference
-        # never prunes (Avatar.go:217-231) — and never notices, because
-        # its CI runs with DoSendMail disabled; under a mail-enabled soak
-        # an unpruned mailbox grows without bound and rides EVERY
-        # migration (measured: 400+ KB per avatar payload, the dominant
-        # cost of a 2-game soak's memory churn — BENCH_NOTES round 5).
-        overflow = len(mails_attr) - MAILBOX_CAP
+        # Bound the mailbox: keep the newest MAILBOX_CAP (documented
+        # deviation — see the class constant). The reference never prunes
+        # and never notices, because its CI runs with DoSendMail disabled.
+        overflow = len(mails_attr) - self.MAILBOX_CAP
         if overflow > 0:
             for old_id in sorted(mails_attr.keys(), key=int)[:overflow]:
                 mails_attr.delete(old_id)

@@ -128,10 +128,54 @@ class DispatcherService:
         self.port = self._server.sockets[0].getsockname()[1]
         self._tasks.append(asyncio.get_running_loop().create_task(self._logic_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(self._tick_loop()))
+        self._register_metrics()
         gwlog.infof("dispatcher %d listening on %s:%d", self.dispid, host, self.port)
         gwlog.infof(consts.DISPATCHER_STARTED_TAG)
 
+    def _register_metrics(self) -> None:
+        """Pull-sampled gauges on /metrics, labeled by dispid. set_function
+        costs the logic loop nothing — collection walks the tables only
+        when a scraper asks (telemetry/metrics.py). Wire-level packet/byte
+        counters live one layer down in proto/conn.py (net_*_total) so
+        every transport this dispatcher speaks is counted uniformly."""
+        from goworld_tpu import telemetry
+
+        d = str(self.dispid)
+        telemetry.gauge(
+            "dispatcher_queue_depth",
+            "Packets waiting in the dispatcher logic queue.", ("dispid",),
+        ).labels(d).set_function(self._queue.qsize)
+        telemetry.gauge(
+            "dispatcher_pending_entities",
+            "Entities currently blocked (load/migrate window) or holding "
+            "buffered packets.", ("dispid",),
+        ).labels(d).set_function(
+            lambda: sum(
+                1 for i in self.entities.values()
+                if i.pending or i.blocked(time.monotonic())
+            ))
+        telemetry.gauge(
+            "dispatcher_connections",
+            "Live peer connections (games + gates + handshaking).",
+            ("dispid",),
+        ).labels(d).set_function(lambda: len(self._conns))
+        telemetry.gauge(
+            "dispatcher_entity_table_size",
+            "Entries in the entity routing table.", ("dispid",),
+        ).labels(d).set_function(lambda: len(self.entities))
+
+    def _unregister_metrics(self) -> None:
+        from goworld_tpu import telemetry
+
+        d = str(self.dispid)
+        for name in ("dispatcher_queue_depth", "dispatcher_pending_entities",
+                     "dispatcher_connections", "dispatcher_entity_table_size"):
+            fam = telemetry.family(name)
+            if fam is not None:
+                fam.remove(d)
+
     async def stop(self) -> None:
+        self._unregister_metrics()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:

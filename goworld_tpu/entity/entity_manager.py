@@ -51,6 +51,9 @@ class Runtime:
         # service calls init_multihost before any jax use).
         self.aoi_multihost: bool = False
         self.aoi_delivery: str = "pipelined"  # [aoi] delivery: pipelined | sync
+        # [aoi] sync_wait_budget: sync-mode stall ceiling before degrading
+        # to deferred delivery (batched.py SYNC_WAIT_BUDGET rationale).
+        self.aoi_sync_wait_budget: float = 0.5
         self.storage = None  # object with .save/.load/.exists (storage module)
         self.game_service = None  # the running GameService, if any
 
@@ -76,6 +79,7 @@ class Runtime:
                 multihost=self.aoi_multihost,
             )
             self.aoi_service.delivery = self.aoi_delivery
+            self.aoi_service.sync_wait_budget = self.aoi_sync_wait_budget
         return self.aoi_service
 
     def new_aoi_manager(self, distance: float):

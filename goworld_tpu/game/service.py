@@ -17,7 +17,7 @@ import signal
 import time
 from typing import Optional
 
-from goworld_tpu import consts, dispatchercluster, kvdb, kvreg, storage
+from goworld_tpu import consts, dispatchercluster, kvdb, kvreg, storage, telemetry
 from goworld_tpu.dispatchercluster.cluster import ClusterClient
 from goworld_tpu.entity import entity_manager
 from goworld_tpu.entity.game_client import GameClient
@@ -90,6 +90,7 @@ class GameService:
         # source of truth; tests may pre-seed rt.aoi_params to override).
         rt.aoi_mesh_shards = max(1, self.cfg.aoi.mesh_shards)
         rt.aoi_delivery = self.cfg.aoi.delivery
+        rt.aoi_sync_wait_budget = self.cfg.aoi.sync_wait_budget
         if rt.aoi_backend != "xzlist" and rt.aoi_params is None:
             from goworld_tpu.entity.aoi.batched import params_from_config
 
@@ -203,6 +204,13 @@ class GameService:
                     out[e.typename] = out.get(e.typename, 0) + 1
                 return out
             gwvar.set_var("EntityCounts", _counts)
+            # Pull-sampled telemetry gauge beside the gwvar probe: /metrics
+            # scrapers get entity counts without touching /vars.
+            telemetry.gauge(
+                "game_entities", "Live entities on this game process.",
+                ("gameid",),
+            ).labels(str(self.gameid)).set_function(
+                lambda: len(entity_manager.entities()))
             debug_srv = await setup_http_server(game_cfg.http_addr if game_cfg else "")
             lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
             gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
@@ -223,6 +231,9 @@ class GameService:
             # of MB of entity state alive through the gwvar registry.
             gwvar.unset("MigrateIn")
             gwvar.unset("FattestEntity")
+            # Same closure-capture reasoning as the gwvar.unset calls.
+            telemetry.gauge("game_entities", labelnames=("gameid",)).remove(
+                str(self.gameid))
             await self.cluster.stop()
             dispatchercluster.set_cluster(None)
         return self.exit_code or 0
@@ -268,9 +279,23 @@ class GameService:
     async def _main_loop(self) -> None:
         tick = consts.GAME_SERVICE_TICK_INTERVAL
         rt = entity_manager.runtime
+        # Per-tick phase attribution (telemetry/phases.py): dispatch =
+        # packet handling, entity_logic = timers+crontab+post, aoi =
+        # poll/dispatch/deliver of the batched engine, sync_send = the
+        # batched position-sync push. begin() runs AFTER the queue wait so
+        # idle time never pollutes the dispatch phase; "total" is the
+        # busy span of each iteration. Served on /metrics as
+        # game_tick_phase_seconds{phase=...}.
+        tracer = telemetry.PhaseTracer(
+            "game_tick_phase_seconds",
+            ("dispatch", "entity_logic", "aoi", "sync_send"),
+            help="Busy wall seconds per game-loop tick, by phase "
+                 "(dispatch|entity_logic|aoi|sync_send|total).",
+        )
         while True:
             try:
                 msgtype, packet = await asyncio.wait_for(self._queue.get(), timeout=tick)
+                tracer.begin()
                 self._last_packet_at = time.monotonic()
                 self._handle_packet(msgtype, packet)
                 # Drain whatever else arrived without waiting.
@@ -281,8 +306,10 @@ class GameService:
                         break
                     self._handle_packet(msgtype, packet)
             except asyncio.TimeoutError:
-                pass
+                tracer.begin()
+            tracer.mark("dispatch")
             rt.timer_service.tick()
+            tracer.mark("entity_logic")
             # NOTE on the multi-HOST (DCN) tier: the wait=False machinery
             # below is lockstep-SAFE as is. Frame-skip only DEFERS a
             # dispatch index (tick dispatches 0,1,2,... on every process,
@@ -335,12 +362,16 @@ class GameService:
                             "delivery is stalled; RPCs keep running",
                             self.gameid, age, cadence,
                         )
+            tracer.mark("aoi")
             crontab.check()
             post.tick()
+            tracer.mark("entity_logic")
             now = time.monotonic()
             if now - self._last_sync_collect >= self.position_sync_interval:
                 self._last_sync_collect = now
                 self._send_entity_sync_infos()
+                tracer.mark("sync_send")
+            tracer.commit()
             if self.run_state == RS_TERMINATING:
                 self._do_terminate()
                 return

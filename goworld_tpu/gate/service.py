@@ -120,6 +120,7 @@ class GateService:
         from goworld_tpu.utils.debug_http import setup_http_server
 
         gwvar.set_var("NumClients", lambda: len(self.clients))
+        self._register_metrics()
         self._debug_srv = await setup_http_server(self.gate_cfg.http_addr)
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._logic_loop()))
@@ -128,7 +129,33 @@ class GateService:
                     self.gateid, self.gate_cfg.host, self.port, ssl_ctx is not None)
         gwlog.infof(consts.GATE_STARTED_TAG)
 
+    def _register_metrics(self) -> None:
+        """Queue-depth / client-count gauges on /metrics, labeled by
+        gateid (pull-sampled — zero logic-loop cost). Per-packet in/out
+        volume is counted transport-uniformly in proto/conn.py
+        (net_*_total), which covers TCP, WS, and KCP client conns alike."""
+        from goworld_tpu import telemetry
+
+        g = str(self.gateid)
+        telemetry.gauge(
+            "gate_queue_depth",
+            "Events waiting in the gate logic queue.", ("gateid",),
+        ).labels(g).set_function(self._queue.qsize)
+        telemetry.gauge(
+            "gate_clients", "Connected client proxies.", ("gateid",),
+        ).labels(g).set_function(lambda: len(self.clients))
+
+    def _unregister_metrics(self) -> None:
+        from goworld_tpu import telemetry
+
+        g = str(self.gateid)
+        for name in ("gate_queue_depth", "gate_clients"):
+            fam = telemetry.family(name)
+            if fam is not None:
+                fam.remove(g)
+
     async def stop(self) -> None:
+        self._unregister_metrics()
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:

@@ -772,16 +772,39 @@ class KCPListener(asyncio.DatagramProtocol):
     is pinned from the opening PUSH and enforced by kcp.input)."""
 
     _TOMBSTONES = 1024  # recently closed (addr, conv) keys remembered
+    # Session caps (ADVICE r5 #1): a session costs a ticker task + FEC
+    # state + a full gate accept/boot pipeline, keyed by SPOOFABLE source
+    # address — so a forged-source flood of 24-byte sn-0 PUSHes would
+    # otherwise allocate without bound. Excess opens are dropped BEFORE
+    # constructing KCPPacketConnection and counted on
+    # kcp_sessions_dropped_total{reason}. A legitimate client behind the
+    # caps retries its sn-0 PUSH (it retransmits until acked) and gets in
+    # once load subsides. The per-IP cap bounds one unspoofed abuser (or
+    # one NAT'd venue — size accordingly) well below the listener cap.
+    MAX_SESSIONS = 4096
+    MAX_SESSIONS_PER_IP = 64
 
     def __init__(
         self,
         on_accept: Callable[[KCPPacketConnection], None],
         fec: tuple[int, int] | None = (10, 3),
+        max_sessions: int | None = None,
+        max_sessions_per_ip: int | None = None,
     ) -> None:
         self._on_accept = on_accept
         self._fec = fec
         self._transport: Optional[asyncio.DatagramTransport] = None
         self._sessions: dict = {}
+        self.max_sessions = max_sessions or self.MAX_SESSIONS
+        self.max_sessions_per_ip = (
+            max_sessions_per_ip or self.MAX_SESSIONS_PER_IP)
+        self._per_ip: collections.Counter = collections.Counter()
+        from goworld_tpu import telemetry
+
+        self._m_dropped = telemetry.counter(
+            "kcp_sessions_dropped_total",
+            "sn-0 opens dropped by KCPListener session caps.",
+            ("reason",))
         # Closed conversations must not resurrect (code-review r5): an
         # evicted client still retransmitting would otherwise re-create a
         # ghost session + boot flow on its next PUSH. FIFO-bounded so an
@@ -825,6 +848,12 @@ class KCPListener(asyncio.DatagramProtocol):
                 # mid-stream sns are a dead/unknown conversation's
                 # retransmits — don't boot a ghost proxy for them.
                 return
+            if len(self._sessions) >= self.max_sessions:
+                self._m_dropped.labels("listener_cap").inc()
+                return
+            if self._per_ip[addr[0]] >= self.max_sessions_per_ip:
+                self._m_dropped.labels("ip_cap").inc()
+                return
             sess = KCPPacketConnection(
                 conv,
                 lambda d, a=addr: self._send_to(a, d),
@@ -835,6 +864,7 @@ class KCPListener(asyncio.DatagramProtocol):
             sess._peername = addr
             sess._listener_key = addr
             self._sessions[addr] = sess
+            self._per_ip[addr[0]] += 1
             self._on_accept(sess)
         sess.on_datagram(data)
 
@@ -842,7 +872,12 @@ class KCPListener(asyncio.DatagramProtocol):
         key = getattr(sess, "_listener_key", None)
         if key is None:
             return
-        self._sessions.pop(key, None)
+        if self._sessions.pop(key, None) is not None:
+            # Decrement only on a real removal: close() can race a
+            # tombstoned re-close and must not drive the count negative.
+            self._per_ip[key[0]] -= 1
+            if self._per_ip[key[0]] <= 0:
+                del self._per_ip[key[0]]
         self._tombstones[(key, sess.conv)] = True
         while len(self._tombstones) > self._TOMBSTONES:
             self._tombstones.popitem(last=False)

@@ -17,6 +17,27 @@ from goworld_tpu.proto.msgtypes import PROTO_VERSION, FilterOp, MsgType
 SYNC_RECORD_SIZE = 16 + 4 * 4  # EntityID + x,y,z,yaw (proto.go:135-139)
 _SYNC = struct.Struct("<16s4f")
 
+# Process-wide wire volume (telemetry): counted HERE because every peer
+# connection of every process — dispatcher↔game/gate streams AND gate
+# client conns over TCP/WS/KCP — goes through GoWorldConnection, so one
+# seam covers all transports. Direction-labeled totals rather than
+# per-connection series: connections churn (one label set per client
+# would grow the registry unboundedly); per-service breakdowns come from
+# the queue/client gauges beside them. Children are pre-resolved so the
+# per-packet hot path is a single Counter.inc.
+from goworld_tpu import telemetry as _telemetry
+
+_PKT = _telemetry.counter(
+    "net_packets_total",
+    "Framed packets through GoWorldConnection (all transports).",
+    ("direction",))
+_BYTES = _telemetry.counter(
+    "net_bytes_total",
+    "Framed payload bytes through GoWorldConnection (pre-compression).",
+    ("direction",))
+_PKT_IN, _PKT_OUT = _PKT.labels("in"), _PKT.labels("out")
+_BYTES_IN, _BYTES_OUT = _BYTES.labels("in"), _BYTES.labels("out")
+
 
 def pack_sync_record(eid: str, x: float, y: float, z: float, yaw: float) -> bytes:
     return _SYNC.pack(eid.encode("ascii"), x, y, z, yaw)
@@ -39,13 +60,20 @@ class GoWorldConnection:
     # --- generic -----------------------------------------------------------
 
     def send(self, msgtype: int, packet: Packet) -> None:
+        _PKT_OUT.inc()
+        _BYTES_OUT.inc(len(packet.payload))
         self.conn.send_packet(msgtype, packet)
 
     def send_packet_raw(self, msgtype: int, payload: bytes) -> None:
+        _PKT_OUT.inc()
+        _BYTES_OUT.inc(len(payload))
         self.conn.send_packet(msgtype, Packet(payload))
 
     async def recv(self):
-        return await self.conn.recv_packet()
+        msgtype, packet = await self.conn.recv_packet()
+        _PKT_IN.inc()
+        _BYTES_IN.inc(len(packet.payload))
+        return msgtype, packet
 
     def flush(self) -> None:
         self.conn.flush()

@@ -7,8 +7,18 @@ framework in this image) serving:
 
 - ``/healthz``   — 200 "ok" liveness probe
 - ``/vars``      — JSON snapshot of gwvar published variables (expvar parity)
-- ``/opmon``     — JSON dump of operation monitor stats (opmon.go:37-118)
+- ``/metrics``   — Prometheus text exposition of the telemetry registry
+  (tick-phase histograms, AOI stage timings/backlog, queue-depth gauges;
+  see goworld_tpu/telemetry)
+- ``/opmon``     — JSON dump of operation monitor stats (opmon.go:37-118;
+  now a legacy view over the telemetry op_duration_seconds family)
 - ``/stack``     — all-thread stack dump (the practical subset of pprof)
+
+SECURITY: this server is unauthenticated and serves state-changing GETs
+(``/heap/start`` toggles ~2x allocation overhead process-wide) and CPU-heavy
+probes. ``http_addr`` must stay LOOPBACK-BOUND (127.0.0.1) in production;
+reach it remotely through an ssh tunnel, never by binding a public
+interface.
 """
 
 from __future__ import annotations
@@ -57,10 +67,13 @@ class DebugHTTPServer:
                 line = await asyncio.wait_for(reader.readline(), timeout=10)
                 if line in (b"\r\n", b"\n", b""):
                     break
-            if path.split("?")[0] == "/profile":
+            route = path.split("?")[0]
+            if route == "/profile":
                 status, ctype, body = await self._profile(path)
+            elif route == "/heap/types":
+                status, ctype, body = await self._heap_types()
             else:
-                status, ctype, body = self._route(path.split("?")[0])
+                status, ctype, body = self._route(route)
             head = (
                 f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
@@ -100,6 +113,26 @@ class DebugHTTPServer:
         pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(80)
         return "200 OK", "text/plain", buf.getvalue().encode()
 
+    async def _heap_types(self) -> tuple[str, str, bytes]:
+        """GC census: live instance counts by type (top 40) — tells you
+        WHAT is retained where tracemalloc tells you what ALLOCATED. Runs
+        gc.collect() + the full gc.get_objects() walk in a THREAD EXECUTOR:
+        on a large heap the census takes long enough that running it inline
+        would stall the asyncio loop this process serves game/gate traffic
+        on (ADVICE r5 #2)."""
+        import collections as _c
+        import gc as _gc
+
+        def census() -> str:
+            _gc.collect()
+            counts = _c.Counter(
+                type(o).__name__ for o in _gc.get_objects())
+            return "\n".join(f"{n:9d}  {t}" for t, n in
+                             counts.most_common(40))
+
+        body = await asyncio.get_running_loop().run_in_executor(None, census)
+        return "200 OK", "text/plain", body.encode()
+
     def _route(self, path: str) -> tuple[str, str, bytes]:
         if path == "/healthz":
             return "200 OK", "text/plain", b"ok"
@@ -117,18 +150,11 @@ class DebugHTTPServer:
 
             tracemalloc.stop()
             return "200 OK", "text/plain", b"tracemalloc stopped"
-        if path == "/heap/types":
-            # GC census: live instance counts by type (top 40) — tells you
-            # WHAT is retained where tracemalloc tells you what ALLOCATED.
-            import collections as _c
-            import gc as _gc
+        if path == "/metrics":
+            from goworld_tpu import telemetry
 
-            _gc.collect()
-            counts = _c.Counter(
-                type(o).__name__ for o in _gc.get_objects())
-            body = "\n".join(f"{n:9d}  {t}" for t, n in
-                             counts.most_common(40))
-            return "200 OK", "text/plain", body.encode()
+            return ("200 OK", "text/plain; version=0.0.4; charset=utf-8",
+                    telemetry.render().encode())
         if path == "/heap":
             import tracemalloc
 

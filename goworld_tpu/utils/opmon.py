@@ -3,45 +3,32 @@
 Reference parity: ``engine/opmon/opmon.go:37-118`` — operations are wrapped
 with a monitor that records count/total/max duration and warns when an op
 exceeds its threshold; a periodic dump prints the table.
+
+Since the telemetry subsystem landed this module is a thin SHIM: every
+``Operation`` records into the ``op_duration_seconds{op=...}`` histogram
+family of :data:`goworld_tpu.telemetry.REGISTRY`, so existing call sites
+(gate packet handling, storage saves, aoi.dispatch/deliver/drain) feed the
+same registry ``/metrics`` renders — one instrumentation plane, two views.
+``dump()`` keeps its legacy shape ({name: {count, avg, max, p50, p99}}) for
+``/opmon`` and tests; ``telemetry.snapshot()`` is the superset.
 """
 
 from __future__ import annotations
 
-import threading
 import time
 
+from goworld_tpu import telemetry
 from goworld_tpu.utils import gwlog
 
-
-_RING = 512  # per-op sample ring for percentiles (beyond reference parity:
-# the BASELINE p99 delivery-latency axis needs live percentiles, not just
-# count/avg/max — bounded memory, O(1) record, sort only at dump time)
+_OP_METRIC = "op_duration_seconds"
 
 
-class _OpStat:
-    __slots__ = ("count", "total", "max", "ring", "ring_i")
-
-    def __init__(self) -> None:
-        self.count = 0
-        self.total = 0.0
-        self.max = 0.0
-        self.ring: list[float] = []
-        self.ring_i = 0
-
-    def record(self, took: float) -> None:
-        self.count += 1
-        self.total += took
-        if took > self.max:
-            self.max = took
-        if len(self.ring) < _RING:
-            self.ring.append(took)
-        else:
-            self.ring[self.ring_i] = took
-            self.ring_i = (self.ring_i + 1) % _RING
-
-
-_lock = threading.Lock()
-_stats: dict[str, _OpStat] = {}
+def _family():
+    return telemetry.histogram(
+        _OP_METRIC,
+        "Named operation durations (opmon shim; op = operation name).",
+        labelnames=("op",),
+    )
 
 
 class Operation:
@@ -55,34 +42,26 @@ class Operation:
 
     def finish(self, warn_threshold: float = 0.0) -> float:
         took = time.monotonic() - self.start
-        with _lock:
-            st = _stats.get(self.name)
-            if st is None:
-                st = _stats[self.name] = _OpStat()
-            st.record(took)
+        _family().labels(self.name).observe(took)
         if warn_threshold and took > warn_threshold:
             gwlog.warnf("opmon: operation %s took %.3fs > %.3fs", self.name, took, warn_threshold)
         return took
 
 
 def dump() -> dict[str, dict[str, float]]:
-    with _lock:
-        out = {}
-        for name, st in _stats.items():
-            entry = {
-                "count": st.count,
-                "avg": st.total / st.count if st.count else 0.0,
-                "max": st.max,
-            }
-            if st.ring:
-                s = sorted(st.ring)
-                # Nearest-rank percentiles: ceil(q*n)-1, NOT int(q*n) —
-                # the latter returns the max (p100) for n in 100..101 and
-                # overstates p99 generally.
-                entry["p50"] = s[max(0, -(-len(s) * 50 // 100) - 1)]
-                entry["p99"] = s[max(0, -(-len(s) * 99 // 100) - 1)]
-            out[name] = entry
-        return out
+    """Legacy opmon table: {op: {count, avg, max, p50, p99}} — percentiles
+    from the histogram's bounded sample ring (nearest-rank)."""
+    out = {}
+    for values, hist in _family().children():
+        cnt = hist.count
+        out[values[0]] = {
+            "count": cnt,
+            "avg": hist.sum / cnt if cnt else 0.0,
+            "max": hist.max,
+            "p50": hist.percentile(0.50),
+            "p99": hist.percentile(0.99),
+        }
+    return out
 
 
 def dump_log() -> None:
@@ -97,5 +76,4 @@ def dump_log() -> None:
 
 
 def reset() -> None:
-    with _lock:
-        _stats.clear()
+    _family().clear()

@@ -1,0 +1,414 @@
+"""Telemetry subsystem tests: registry semantics, Prometheus exposition,
+opmon shim compatibility, /metrics round-trip, phase tracer, KCP session
+caps, and the pinned-floor perf gate (goworld_tpu/telemetry; ISSUE 1)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import pathlib
+import threading
+import time
+
+import pytest
+
+from goworld_tpu import telemetry
+from goworld_tpu.telemetry.metrics import Registry, exponential_buckets
+
+_REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+# --- registry semantics -------------------------------------------------------
+
+
+def test_counter_get_or_create_and_monotonic():
+    reg = Registry()
+    c = reg.counter("jobs_total", "help")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    assert reg.counter("jobs_total") is c  # same child back
+    with pytest.raises(ValueError):
+        c.inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        reg.gauge("jobs_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("jobs_total", labelnames=("x",))  # schema mismatch
+    with pytest.raises(ValueError):
+        reg.counter("bad name")
+
+
+def test_label_families_distinct_children():
+    reg = Registry()
+    fam = reg.counter("rpc_total", "h", labelnames=("method", "ok"))
+    a = fam.labels("foo", "true")
+    b = fam.labels(method="foo", ok="false")
+    assert a is not b
+    assert fam.labels("foo", "true") is a  # cached
+    a.inc(3)
+    b.inc()
+    assert a.value == 3 and b.value == 1
+    with pytest.raises(ValueError):
+        fam.labels("onlyone")  # arity mismatch
+    with pytest.raises(ValueError):
+        fam.labels(method="foo", nope="x")
+    fam.remove("foo", "true")
+    assert fam.labels("foo", "true") is not a  # fresh child after remove
+
+
+def test_gauge_set_function_and_error_isolation():
+    reg = Registry()
+    g = reg.gauge("depth")
+    g.set(4)
+    g.inc()
+    g.dec(2)
+    assert g.value == 3
+    g.set_function(lambda: 42)
+    assert g.value == 42
+    g.set_function(lambda: 1 / 0)  # broken probe must not kill collection
+    assert g.value != g.value  # NaN
+    assert "NaN" in reg.render()
+
+
+def test_histogram_bucketing_and_percentiles():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=exponential_buckets(0.001, 2.0, 4))
+    # bounds: 0.001, 0.002, 0.004, 0.008 (+Inf overflow)
+    for v in (0.0005, 0.001, 0.0015, 0.003, 0.1):
+        h.observe(v)
+    buckets = dict(h.cumulative_buckets())
+    assert buckets[0.001] == 2  # le is INCLUSIVE (0.0005, 0.001)
+    assert buckets[0.002] == 3
+    assert buckets[0.004] == 4
+    assert buckets[0.008] == 4
+    assert buckets[float("inf")] == 5
+    assert h.count == 5
+    assert abs(h.sum - 0.106) < 1e-9
+    assert h.max == 0.1
+    assert 0.0 < h.percentile(0.50) <= h.percentile(0.99) <= h.max
+
+
+def test_concurrent_increments_exact():
+    reg = Registry()
+    c = reg.counter("hits_total")
+    h = reg.histogram("obs")
+    n_threads, per = 8, 5000
+
+    def work():
+        for _ in range(per):
+            c.inc()
+            h.observe(0.001)
+
+    threads = [threading.Thread(target=work) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == n_threads * per
+    assert h.count == n_threads * per
+
+
+# --- Prometheus text exposition -----------------------------------------------
+
+
+def test_prometheus_rendering():
+    reg = Registry()
+    reg.counter("a_total", "things counted").inc(7)
+    reg.gauge("b", "a gauge", ("svc",)).labels('we"ird\\').set(1.5)
+    reg.histogram("c_seconds", buckets=(0.1, 1.0)).observe(0.5)
+    text = reg.render()
+    lines = text.strip().splitlines()
+    assert "# HELP a_total things counted" in lines
+    assert "# TYPE a_total counter" in lines
+    assert "a_total 7" in lines
+    assert 'b{svc="we\\"ird\\\\"} 1.5' in lines
+    assert 'c_seconds_bucket{le="0.1"} 0' in lines
+    assert 'c_seconds_bucket{le="1"} 1' in lines
+    assert 'c_seconds_bucket{le="+Inf"} 1' in lines
+    assert "c_seconds_sum 0.5" in lines
+    assert "c_seconds_count 1" in lines
+
+
+def test_snapshot_shape():
+    reg = Registry()
+    reg.counter("x_total").inc(2)
+    reg.histogram("y").observe(1.0)
+    snap = reg.snapshot()
+    json.dumps(snap)  # must be JSON-able
+    assert snap["x_total"]["type"] == "counter"
+    assert snap["x_total"]["series"][0]["value"] == 2
+    ys = snap["y"]["series"][0]
+    assert ys["count"] == 1 and ys["avg"] == 1.0 and ys["p99"] == 1.0
+
+
+# --- opmon shim ---------------------------------------------------------------
+
+
+def test_opmon_shim_feeds_telemetry_registry():
+    from goworld_tpu.utils import opmon
+
+    opmon.reset()
+    op = opmon.Operation("shim.op")
+    time.sleep(0.001)
+    op.finish()
+    # Legacy dump shape intact...
+    d = opmon.dump()
+    assert d["shim.op"]["count"] == 1
+    assert d["shim.op"]["avg"] > 0
+    assert 0.0 < d["shim.op"]["p50"] <= d["shim.op"]["p99"] <= d["shim.op"]["max"]
+    # ...and the same samples are visible on the Prometheus surface.
+    text = telemetry.render()
+    assert 'op_duration_seconds_count{op="shim.op"} 1' in text
+    opmon.reset()
+    assert "shim.op" not in opmon.dump()
+
+
+# --- phase tracer -------------------------------------------------------------
+
+
+def test_phase_tracer_accumulates_segments():
+    reg = Registry()
+    tracer = telemetry.PhaseTracer(
+        "tick_phase_seconds", ("a", "b"), registry=reg)
+    tracer.begin()
+    time.sleep(0.002)
+    tracer.mark("a")
+    time.sleep(0.001)
+    tracer.mark("b")
+    time.sleep(0.001)
+    tracer.mark("a")  # second 'a' segment accumulates into the same tick
+    tracer.commit()
+    fam = reg.family("tick_phase_seconds")
+    children = dict(fam.children())
+    assert children[("a",)].count == 1  # ONE observation despite two marks
+    assert children[("a",)].sum >= 0.003
+    assert children[("b",)].count == 1
+    total = children[(telemetry.TOTAL_PHASE,)]
+    assert total.count == 1
+    assert total.sum >= children[("a",)].sum + children[("b",)].sum - 1e-9
+    tracer.commit()  # commit without begin: no-op
+    assert total.count == 1
+
+
+# --- /metrics endpoint round-trip ---------------------------------------------
+
+
+def test_metrics_endpoint_roundtrip():
+    import urllib.request
+
+    from goworld_tpu.utils import opmon
+    from goworld_tpu.utils.debug_http import DebugHTTPServer
+
+    telemetry.counter(
+        "endpoint_test_total", "visible on /metrics").inc(11)
+    op = opmon.Operation("endpoint.op")
+    op.finish()
+
+    async def run():
+        srv = DebugHTTPServer("127.0.0.1", 0)
+        await srv.start()
+
+        def fetch(path):
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=5
+            ) as r:
+                return r.status, r.headers.get("Content-Type", ""), r.read()
+
+        status, ctype, body = await asyncio.to_thread(fetch, "/metrics")
+        assert status == 200
+        assert ctype.startswith("text/plain")
+        text = body.decode()
+        assert "endpoint_test_total 11" in text
+        assert 'op_duration_seconds_count{op="endpoint.op"}' in text
+        # /heap/types now runs its gc census in a thread executor — the
+        # route must still answer correctly.
+        status, _, body = await asyncio.to_thread(fetch, "/heap/types")
+        assert status == 200 and b"dict" in body
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_metrics_endpoint_serves_service_gauges():
+    """Dispatcher/gate queue-depth gauges appear on /metrics while the
+    services run, and are removed at stop (no stale series)."""
+    from goworld_tpu.dispatcher.service import DispatcherService
+
+    async def run():
+        svc = DispatcherService(77, desired_games=1, desired_gates=1)
+        await svc.start()
+        try:
+            text = telemetry.render()
+            assert 'dispatcher_queue_depth{dispid="77"} 0' in text
+            assert 'dispatcher_pending_entities{dispid="77"} 0' in text
+            assert 'dispatcher_entity_table_size{dispid="77"} 0' in text
+        finally:
+            await svc.stop()
+        assert 'dispid="77"' not in telemetry.render()
+
+    asyncio.run(run())
+
+
+def test_metrics_during_running_deployment(tmp_path):
+    """Acceptance: a live dispatcher+game deployment populates the
+    tick-phase histograms and service gauges that /metrics renders."""
+    from tests.test_game_service import start_stack, stop_stack
+    from goworld_tpu.entity import entity_manager as em
+    from goworld_tpu.utils import post
+
+    em.cleanup_for_tests()
+    try:
+        async def run():
+            disp, svc, task, cg, _peer = await start_stack(tmp_path)
+            await asyncio.sleep(0.3)  # let the loop tick a few dozen times
+            text = telemetry.render()
+            await stop_stack(disp, svc, task, cg)
+            return text
+
+        text = asyncio.run(run())
+        for phase in ("dispatch", "entity_logic", "aoi", "total"):
+            assert (
+                f'game_tick_phase_seconds_count{{phase="{phase}"}}' in text
+            ), f"missing phase {phase}"
+        # total observed on (almost) every busy tick of the 0.3 s window
+        count_line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith('game_tick_phase_seconds_count{phase="total"}')
+        )
+        assert int(count_line.rsplit(" ", 1)[1]) >= 10
+        assert 'dispatcher_queue_depth{dispid="1"}' in text
+        assert 'game_entities{gameid="1"}' in text
+    finally:
+        from goworld_tpu import kvdb, storage
+
+        storage.set_backend(None)
+        kvdb.set_backend(None)
+        em.cleanup_for_tests()
+        post.clear()
+
+
+# --- AOI stage metrics --------------------------------------------------------
+
+
+def test_aoi_backlog_gauge_and_tick_metrics():
+    from goworld_tpu.entity.aoi.batched import BatchAOIService
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    class _E:
+        def __init__(self):
+            self.entered = []
+
+        def is_destroyed(self):
+            return False
+
+        def on_enter_aoi(self, other):
+            self.entered.append(other)
+
+        def on_leave_aoi(self, other):
+            pass
+
+    svc = BatchAOIService(NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=8, grid_z=8, space_slots=1,
+        cell_capacity=16, max_events=256))
+    a, b = _E(), _E()
+    sid = svc.alloc_space_id()
+    svc.alloc_slot(a, sid, 10.0, 10.0, 50.0)
+    svc.alloc_slot(b, sid, 20.0, 20.0, 50.0)
+    svc.tick()  # dispatch 1 (nothing to deliver yet)
+    svc.tick()  # delivers the first step's enter events
+    backlog = telemetry.gauge("aoi_event_backlog")
+    assert backlog.value >= 2  # a↔b enters delivered
+    assert a.entered  # events really fired
+    # The sync stall bound is config-sized and sub-second by default.
+    assert svc.sync_wait_budget == 0.5
+    text = telemetry.render()
+    assert "aoi_event_backlog" in text
+    assert "aoi_in_flight_age_seconds" in text
+
+
+def test_sync_wait_budget_config():
+    from goworld_tpu.config.read_config import AOIConfig, GoWorldConfig, _validate
+
+    cfg = GoWorldConfig()
+    cfg.aoi = AOIConfig(sync_wait_budget=0.0)
+    with pytest.raises(ValueError, match="sync_wait_budget"):
+        _validate(cfg)
+    cfg.aoi = AOIConfig(sync_wait_budget=0.25, delivery="sync")
+    _validate(cfg)  # fine
+
+
+# --- KCP listener session caps ------------------------------------------------
+
+
+def test_kcp_listener_session_caps():
+    import struct
+
+    from goworld_tpu.netutil.kcp import CMD_PUSH, KCPListener
+
+    def sn0_push(conv: int) -> bytes:
+        # 24-byte KCP segment header: conv, cmd, frg, wnd, ts, sn, una, len
+        return struct.pack("<IBBHIIII", conv, CMD_PUSH, 0, 32, 0, 0, 0, 0)
+
+    async def run():
+        accepted = []
+        lst = KCPListener(accepted.append, fec=None, max_sessions=4,
+                          max_sessions_per_ip=2)
+        drops = telemetry.counter(
+            "kcp_sessions_dropped_total", labelnames=("reason",))
+        ip_drops0 = drops.labels("ip_cap").value
+        cap_drops0 = drops.labels("listener_cap").value
+        try:
+            # Per-IP cap: third session from the same address is dropped.
+            for port in (1, 2, 3):
+                lst.datagram_received(sn0_push(port), ("10.0.0.1", port))
+            assert len(accepted) == 2
+            assert drops.labels("ip_cap").value == ip_drops0 + 1
+            # Listener cap: fill to 4 total, then any new address drops.
+            lst.datagram_received(sn0_push(9), ("10.0.0.2", 9))
+            lst.datagram_received(sn0_push(10), ("10.0.0.3", 10))
+            assert len(accepted) == 4
+            lst.datagram_received(sn0_push(11), ("10.0.0.4", 11))
+            assert len(accepted) == 4
+            assert drops.labels("listener_cap").value == cap_drops0 + 1
+            # Closing a session frees its per-IP slot.
+            accepted[0].close()
+            lst.datagram_received(sn0_push(5), ("10.0.0.1", 5))
+            assert len(accepted) == 5
+        finally:
+            for sess in accepted:
+                sess.close()
+
+    asyncio.run(run())
+
+
+# --- pinned-floor perf gate ---------------------------------------------------
+
+
+def _load_bench():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("bench", _REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pinned_floor_gate():
+    """THE regression gate (VERDICT r5 weak #1): the fixed-config CPU
+    benchmark must stay within tolerance of the committed floor. If this
+    fails, a host-side AOI hot-path change regressed throughput — fix it,
+    or (for a deliberate trade) re-measure and update BENCH_FLOOR.json in
+    the same commit with a justification."""
+    floor_spec = json.loads((_REPO / "BENCH_FLOOR.json").read_text())
+    bench = _load_bench()
+    # The committed floor must describe the committed config, or the
+    # comparison is apples-to-oranges.
+    result = bench.bench_pinned_floor()
+    assert result["config"] == bench.PINNED_FLOOR_CONFIG
+    floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
+    assert result["value"] >= floor, (
+        f"pinned-floor regression: {result['value']:.0f} upd/s < "
+        f"{floor:.0f} (floor {floor_spec['floor']} - "
+        f"{floor_spec['tolerance']:.0%} tolerance). Runs: {result['runs']}. "
+        f"See BENCH_FLOOR.json how_to_read."
+    )
