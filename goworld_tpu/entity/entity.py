@@ -467,6 +467,28 @@ class Entity:
     def on_leave_aoi(self, other: "Entity") -> None:
         self.uninterest(other)
 
+    def on_aoi_batch(self, enters: list, leaves: list) -> None:
+        """One batched AOI callback per entity per tick (the vectorized
+        delivery path, aoi/batched.py): ``leaves`` then ``enters`` are all
+        the neighbors this entity lost/gained this tick, in engine event
+        order. The default preserves the per-pair contract exactly —
+        leave-before-enter within the tick, per-pair destroyed checks at
+        fire time (a hook may destroy entities mid-batch) — so subclasses
+        overriding only the per-pair hooks behave identically whether the
+        service routes them through here or through the legacy fallback.
+        Override THIS hook to consume the whole tick's diff in one call
+        (batch client pushes, group spawn logic) without per-pair Python
+        dispatch."""
+        for other in leaves:
+            if self._destroyed:
+                return
+            self.on_leave_aoi(other)
+        for other in enters:
+            if self._destroyed:
+                return
+            if not other.is_destroyed():
+                self.on_enter_aoi(other)
+
     def interest(self, other: "Entity") -> None:
         # Idempotent by design: the batched AOI plane delivers diffs one
         # tick late (aoi/batched.py), so edge races — an entity destroyed

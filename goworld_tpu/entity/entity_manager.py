@@ -29,7 +29,7 @@ from goworld_tpu.entity.entity import (
 from goworld_tpu.entity.game_client import GameClient
 from goworld_tpu.entity.space import SPACE_KIND_NIL, Space
 from goworld_tpu.entity.vector import Vector3
-from goworld_tpu.proto.conn import pack_sync_record
+from goworld_tpu.proto.conn import pack_client_sync_blocks
 from goworld_tpu.utils import gwlog, gwutils, post as post_mod
 from goworld_tpu.utils.timer import TimerService
 
@@ -418,32 +418,41 @@ def on_game_ready() -> None:
 # --- position sync collection (Entity.go:1221-1267) --------------------------
 
 
-def collect_entity_sync_infos() -> dict[int, bytearray]:
-    """Build one buffer per gate of [clientid(16) + 32B sync record] blocks
-    for every entity whose position/yaw changed since last collection."""
-    per_gate: dict[int, bytearray] = {}
+def collect_entity_sync_infos() -> dict[int, bytes]:
+    """Build one coalesced buffer per gate of [clientid(16) + 32B sync
+    record] blocks for every entity whose position/yaw changed since last
+    collection. The scan gathers (clientid, eid, x, y, z, yaw) rows per
+    destination gate; the wire bytes are then assembled in ONE vectorized
+    structured-array pack per gate (proto.conn.pack_client_sync_blocks)
+    instead of a struct.pack + append per record — at fan-out scale
+    (every neighbor's client gets a row) the per-record packing was the
+    sync phase's dominant host cost."""
+    per_gate: dict[int, list] = {}
     for e in _entities.values():
         flag = e._sync_info_flag
         if not flag:
             continue
         e._sync_info_flag = 0
-        record = pack_sync_record(
-            e.id, e.position.x, e.position.y, e.position.z, e.yaw
-        )
+        pos = e.position
+        row = (e.id, pos.x, pos.y, pos.z, e.yaw)
         if (
             flag & SIF_SYNC_OWN_CLIENT
             and e.client is not None
             and not e._syncing_from_client
         ):
-            buf = per_gate.setdefault(e.client.gateid, bytearray())
-            buf += e.client.clientid.encode("ascii") + record
+            c = e.client
+            per_gate.setdefault(c.gateid, []).append((c.clientid,) + row)
         if flag & SIF_SYNC_NEIGHBOR_CLIENTS:
             for other in e.interested_by:
                 c = other.client
                 if c is not None:
-                    buf = per_gate.setdefault(c.gateid, bytearray())
-                    buf += c.clientid.encode("ascii") + record
-    return per_gate
+                    per_gate.setdefault(c.gateid, []).append(
+                        (c.clientid,) + row
+                    )
+    return {
+        gateid: pack_client_sync_blocks(rows)
+        for gateid, rows in per_gate.items()
+    }
 
 
 # --- migration receive side (EntityManager.go:279-339) -----------------------

@@ -420,11 +420,13 @@ class GameService:
                         return
 
     def _send_entity_sync_infos(self) -> None:
-        """Push batched position syncs, one packet per gate (§3.3)."""
+        """Push batched position syncs, one coalesced packet per gate
+        (§3.3; records are packed in one vectorized pass per gate —
+        entity_manager.collect_entity_sync_infos)."""
         per_gate = entity_manager.collect_entity_sync_infos()
         for gateid, buf in per_gate.items():
             dispatchercluster.select_by_gate_id(gateid).send_sync_position_yaw_on_clients(
-                gateid, bytes(buf)
+                gateid, buf
             )
 
     # --- packet handlers (GameService.go:92-157) ------------------------------

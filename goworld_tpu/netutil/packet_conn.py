@@ -26,6 +26,18 @@ from goworld_tpu.netutil.packet import Packet
 _COMPRESS_THRESHOLD = 256  # don't deflate tiny packets (heartbeats, syncs)
 _RECV_CHUNK = 65536
 
+# Packets that rode an existing corked batch instead of paying their own
+# transport write (gate tick-scoped coalescing; one series process-wide —
+# connections churn too fast for per-conn labels, same reasoning as
+# net_packets_total in proto/conn.py).
+from goworld_tpu import telemetry as _telemetry
+
+_COALESCED = _telemetry.counter(
+    "net_coalesced_packets_total",
+    "Packets flushed as part of a multi-packet corked batch (all but the "
+    "first of each batch): writes saved by tick-scoped write coalescing.",
+)
+
 
 def deframe(rbytes: bytearray, max_packet: int = 0):
     """One batched native.split over ``rbytes``, consuming the parsed
@@ -59,6 +71,7 @@ class PacketConnection:
         self._flush_interval = flush_interval
         self._pending: list[bytes] = []
         self._flush_task: asyncio.Task | None = None
+        self._corked = False  # tick-scoped write coalescing (cork/uncork)
         self._closed = False
         self._compress = 0  # 0 off | 1 zlib | 2 snappy (native.pack modes)
         self.dropped = 0  # packets discarded because the conn was closed
@@ -104,10 +117,29 @@ class PacketConnection:
             _COMPRESS_THRESHOLD, consts.MAX_PACKET_SIZE,
         )
         self._pending.append(buf)
+        if self._corked:
+            return  # uncork() flushes the whole tick's scatter list at once
         if self._flush_task is None or self._flush_task.done():
             self._flush_task = asyncio.get_running_loop().create_task(
                 self._flush_later()
             )
+
+    def cork(self) -> None:
+        """Suspend flushing: sends accumulate in the pending scatter list
+        with no per-send flush-task bookkeeping until :meth:`uncork`. The
+        gate's logic loop corks a connection for the span of one event
+        batch (tick) so N per-client packets leave in ONE transport write.
+        Idempotent; a connection left corked by an error path is still
+        flushed by the next uncork() or close()."""
+        self._corked = True
+
+    def uncork(self) -> None:
+        """Re-enable flushing and write the coalesced batch out now."""
+        self._corked = False
+        n = len(self._pending)
+        if n > 1:
+            _COALESCED.inc(n - 1)
+        self.flush()
 
     async def _flush_later(self) -> None:
         if self._flush_interval > 0:

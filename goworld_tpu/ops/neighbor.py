@@ -372,9 +372,19 @@ def _drain_ids(ids: jax.Array, n: int, max_events: int, start_flat: jax.Array):
     total = q * w
     flat = ids.reshape(-1)
     mask = (flat < n) & (jnp.arange(total, dtype=jnp.int32) >= start_flat)
-    (idx,) = jnp.nonzero(mask, size=max_events, fill_value=total)
-    idx = idx.astype(jnp.int32)
+    # Event k lives at the first flat index whose inclusive running count
+    # reaches k+1: one O(total) cumsum + max_events binary searches. The
+    # nonzero(size=...) formulation this replaces lowers to a total-sized
+    # scatter, which XLA:CPU executes serially — 62 ms of the 150 ms
+    # pinned-floor tick at [2048, 576]; the cumsum+searchsorted form is
+    # ~2 ms there with the identical (index-ascending, total-filled)
+    # output contract. total < 2^31 is a NeighborParams invariant, so the
+    # int32 cumsum cannot overflow.
+    csum = jnp.cumsum(mask.astype(jnp.int32))
+    ranks = jnp.arange(1, max_events + 1, dtype=jnp.int32)
+    idx = jnp.searchsorted(csum, ranks, side="left").astype(jnp.int32)
     valid = idx < total
+    idx = jnp.where(valid, idx, total)
     safe = jnp.minimum(idx, total - 1)
     ent = jnp.where(valid, safe // w, n)
     oth = jnp.where(valid, flat[safe], n)
@@ -840,13 +850,16 @@ def _jitted_step_packed(params: NeighborParams, backend: str):
         fn = functools.partial(
             _step_pallas, params, backend == "pallas_interpret"
         )
-    # Only the previous-tick POSITION array is donated. The carried grid
-    # artifacts (pallas args 4-10) must NOT be: the still-pending previous
-    # step's paging context references those exact buffers. The previous
-    # meta arrays (act/space/radius) must not be either: with
-    # ``meta_dirty=False`` the SAME device buffers are passed as both the
-    # previous and current epoch's meta.
-    return jax.jit(fn, donate_argnums=(0,))
+    # NOTHING is donated. The previous-position arg used to be, but no
+    # output of either step shares float32[N,2] layout, so XLA could never
+    # alias it — every jit just warned "Some donated buffers were not
+    # usable" (the multichip dryrun log flagged it). The carried grid
+    # artifacts (pallas args 4-10) must stay undonated regardless: the
+    # still-pending previous step's paging context references those exact
+    # buffers; likewise the previous meta arrays (act/space/radius), which
+    # with ``meta_dirty=False`` are the SAME device buffers as the current
+    # epoch's meta.
+    return jax.jit(fn)
 
 
 @functools.lru_cache(maxsize=None)

@@ -324,9 +324,12 @@ def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int)
         in_specs=(spec,) * 8,
         out_specs=(spec, spec, spec),
     )
-    # Positions only: meta_dirty=False passes the SAME buffers as previous
-    # and current meta (ShardedNeighborEngine.step_async).
-    return jax.jit(mapped, donate_argnums=(0,))
+    # No donation: no output shares the previous-position buffer's
+    # float32 layout, so XLA could never reuse it — donating only produced
+    # the "Some donated buffers were not usable" dryrun warning. (The
+    # previous meta buffers must not be donated regardless: with
+    # meta_dirty=False they are passed as both epochs' meta.)
+    return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=None)
@@ -348,7 +351,8 @@ def _jitted_sharded_step_pallas(
         # skip the vma check (outputs are explicitly per-shard here anyway).
         check_vma=False,
     )
-    return jax.jit(mapped, donate_argnums=(0,))
+    # No donation — same unusable-layout reasoning as _jitted_sharded_step.
+    return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=None)

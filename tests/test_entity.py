@@ -387,6 +387,11 @@ def test_batched_aoi_destroy_delivers_leaves():
     assert not a.is_interested_in(b)
 
 
+@pytest.mark.skipif(
+    not hasattr(__import__("jax"), "shard_map"),
+    reason="jax.shard_map not exported by this jax build "
+           "(parallel.mesh needs it)",
+)
 def test_batched_aoi_sharded_engine_wired():
     """[aoi] mesh_shards>1 must actually build the multi-device engine and
     drive the same interest semantics through the entity layer (VERDICT r2
@@ -901,3 +906,171 @@ def test_attr_tree_fuzz_roundtrip_and_migration():
         again = MapAttr()
         again.assign(rebuilt.to_dict())
         assert again.to_dict() == snapshot
+
+
+# --- batched AOI delivery: on_aoi_batch ordering parity (ISSUE 2) ------------
+
+
+def _make_delivery_service(n_slots=16):
+    """A BatchAOIService used purely as an event-delivery harness: slots
+    are populated directly (no engine traffic) and synthetic pair streams
+    are pushed through _dispatch_events."""
+    from goworld_tpu.entity.aoi.batched import BatchAOIService
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    svc = BatchAOIService(NeighborParams(
+        capacity=64, cell_size=100.0, grid_x=8, grid_z=8, space_slots=1,
+        cell_capacity=16, max_events=256))
+    return svc
+
+
+def _legacy_reference_delivery(ents, enters, leaves):
+    """The exact pre-batch per-pair delivery loop, kept here as the parity
+    oracle: ALL leaves (event order) then ALL enters (event order)."""
+    for a, b in leaves:
+        ea, eb = ents[a], ents[b]
+        if ea is not None and eb is not None and not ea.is_destroyed():
+            ea.on_leave_aoi(eb)
+    for a, b in enters:
+        ea, eb = ents[a], ents[b]
+        if (
+            ea is not None
+            and eb is not None
+            and not ea.is_destroyed()
+            and not eb.is_destroyed()
+        ):
+            ea.on_enter_aoi(eb)
+
+
+class _Recorder:
+    """Duck-typed legacy entity (no on_aoi_batch): per-pair fallback."""
+
+    def __init__(self, name):
+        self.name = name
+        self.calls = []
+
+    def is_destroyed(self):
+        return False
+
+    def on_enter_aoi(self, other):
+        self.calls.append(("enter", other.name))
+
+    def on_leave_aoi(self, other):
+        self.calls.append(("leave", other.name))
+
+    def __repr__(self):
+        return f"R<{self.name}>"
+
+
+def test_on_aoi_batch_ordering_parity_with_legacy():
+    """Satellite (ISSUE 2): on identical event streams, the batched
+    delivery must observe the same per-entity call sequence as the legacy
+    per-pair loop — leaves before enters within the tick, engine event
+    order within each kind."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    for trial in range(10):
+        n = 10
+        svc = _make_delivery_service()
+        ref = [_Recorder(i) for i in range(n)]
+        new = [_Recorder(i) for i in range(n)]
+        k_e, k_l = int(rng.integers(0, 30)), int(rng.integers(0, 30))
+
+        def pairs(k):
+            if k == 0:
+                return np.empty((0, 2), np.int64)
+            a = rng.integers(0, n, size=k)
+            b = (a + 1 + rng.integers(0, n - 1, size=k)) % n
+            return np.stack([a, b], axis=1).astype(np.int64)
+
+        enters, leaves = pairs(k_e), pairs(k_l)
+        _legacy_reference_delivery(ref, enters, leaves)
+        for i, r in enumerate(new):
+            svc._entities[i] = r
+        svc._dispatch_events(enters, leaves)
+        for i in range(n):
+            assert new[i].calls == ref[i].calls, (
+                f"trial {trial} entity {i}: batched delivery diverged from "
+                f"the per-pair reference"
+            )
+            # Per-tick contract: every leave precedes every enter.
+            kinds = [k for k, _ in new[i].calls]
+            assert kinds == sorted(kinds, key=lambda k: k == "enter")
+
+
+def test_on_aoi_batch_single_callback_and_interest_parity():
+    """An Entity subclass overriding on_aoi_batch gets ONE call per tick
+    with (enters, leaves); default Entities routed through the batch hook
+    end with interest sets identical to the legacy loop's."""
+    import numpy as np
+
+    class BatchAvatar(Entity):
+        def __init__(self):
+            super().__init__()
+            self.batches = []
+
+        def on_aoi_batch(self, enters, leaves):
+            self.batches.append((list(enters), list(leaves)))
+            super().on_aoi_batch(enters, leaves)
+
+    svc = _make_delivery_service()
+    desc = em.register_entity(BatchAvatar)  # MySpace: autouse fixture
+    desc.set_use_aoi(True)
+    a = em.create_entity_locally("BatchAvatar")
+    b = em.create_entity_locally("BatchAvatar")
+    c = em.create_entity_locally("BatchAvatar")
+    for i, e in enumerate((a, b, c)):
+        svc._entities[i] = e
+    enters = np.asarray([[0, 1], [0, 2], [1, 0], [2, 0]], np.int64)
+    svc._dispatch_events(enters, np.empty((0, 2), np.int64))
+    assert len(a.batches) == 1
+    assert a.batches[0] == ([b, c], [])
+    assert a.is_interested_in(b) and a.is_interested_in(c)
+    assert b.is_interested_in(a) and c.is_interested_in(a)
+    # Leave tick: one batch again, leaves populated, interest severed.
+    leaves = np.asarray([[0, 2], [2, 0]], np.int64)
+    svc._dispatch_events(np.empty((0, 2), np.int64), leaves)
+    assert a.batches[1] == ([], [c])
+    assert not a.is_interested_in(c)
+    assert a.is_interested_in(b)
+
+
+def test_on_aoi_batch_skips_destroyed_mid_batch():
+    """A hook that destroys an entity mid-batch must suppress that
+    entity's remaining callbacks — same contract as the legacy loop's
+    per-pair destroyed checks."""
+    import numpy as np
+
+    class Killer(_Recorder):
+        def __init__(self, name, victim_holder):
+            super().__init__(name)
+            self._victims = victim_holder
+
+        def on_enter_aoi(self, other):
+            super().on_enter_aoi(other)
+            for v in self._victims:
+                v.destroyed = True
+
+    class Mortal(_Recorder):
+        def __init__(self, name):
+            super().__init__(name)
+            self.destroyed = False
+
+        def is_destroyed(self):
+            return self.destroyed
+
+    svc = _make_delivery_service()
+    mortal = Mortal(2)
+    killer = Killer(0, [mortal])
+    other = _Recorder(1)
+    for i, e in enumerate((killer, other, mortal)):
+        svc._entities[i] = e
+    # killer's enter destroys mortal; mortal's own batch (later subject
+    # slot) must then deliver nothing, and other's enter of mortal must
+    # be suppressed by the fire-time destroyed check.
+    enters = np.asarray([[0, 1], [1, 2], [2, 1]], np.int64)
+    svc._dispatch_events(enters, np.empty((0, 2), np.int64))
+    assert killer.calls == [("enter", 1)]
+    assert other.calls == []  # enter of destroyed mortal suppressed
+    assert mortal.calls == []  # destroyed before its group fired

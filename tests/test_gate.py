@@ -8,6 +8,7 @@ filtered broadcast → disconnect detach.
 """
 
 import asyncio
+import importlib.util
 
 import pytest
 
@@ -29,7 +30,7 @@ from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.game import GameService
 from goworld_tpu.gate import GateService
 from goworld_tpu.gate.filter_tree import FilterTree
-from goworld_tpu.proto.msgtypes import FilterOp
+from goworld_tpu.proto.msgtypes import FilterOp, MsgType
 from goworld_tpu.utils import post
 
 
@@ -353,6 +354,11 @@ def test_gate_tls(clean_entities, tmp_path):
     asyncio.run(run())
 
 
+@pytest.mark.skipif(
+    importlib.util.find_spec("websockets") is None,
+    reason="websockets module not installed in this image "
+           "(gate/client WS transports import it lazily)",
+)
 def test_websocket_transport(clean_entities, tmp_path):
     """WS client next to TCP: boot flow, RPC both ways, attr streaming
     (gate.go:92-95 WS serving; transport adapter netutil/ws_conn.py)."""
@@ -421,3 +427,50 @@ def test_compressed_client_connection(clean_entities, tmp_path):
         await stop_stack(disp, game, game_task, gate, [bot])
 
     asyncio.run(run())
+
+
+# --- vectorized sync demux (ISSUE 2) -----------------------------------------
+
+
+def test_sync_on_clients_vectorized_demux():
+    """The argsort-grouped demux must deliver each client exactly the
+    records addressed to it, concatenated in packet order, one send per
+    client — and ignore a truncated trailing block."""
+    from goworld_tpu.gate.service import GateService
+    from goworld_tpu.gate.service import ClientProxy
+    from goworld_tpu.netutil.packet import Packet
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    class RecConn:
+        def __init__(self):
+            self.sent = []
+
+        def send_packet_raw(self, msgtype, payload):
+            self.sent.append((msgtype, payload))
+
+    cfg = GoWorldConfig()
+    gate = GateService(1, cfg)
+    cids = ["A" * 16, "B" * 16, "C" * 16]
+    proxies = {}
+    for cid in cids:
+        cp = ClientProxy(RecConn())
+        cp.clientid = cid
+        gate.clients[cid] = cp
+        proxies[cid] = cp
+    recs = [pack_sync_record("E%015d" % i, float(i), 0.0, 0.0, 0.0)
+            for i in range(5)]
+    blocks = (
+        cids[0].encode() + recs[0]
+        + cids[1].encode() + recs[1]
+        + cids[0].encode() + recs[2]
+        + cids[2].encode() + recs[3]
+        + cids[1].encode() + recs[4]
+    )
+    p = Packet()
+    p.append_uint16(1)
+    p.append_bytes(blocks + b"\x00" * 10)  # truncated trailing junk block
+    gate._handle_sync_on_clients(p)
+    a, b, c = (proxies[cid].conn.sent for cid in cids)
+    assert a == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[0] + recs[2])]
+    assert b == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[1] + recs[4])]
+    assert c == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[3])]

@@ -702,3 +702,29 @@ def test_many_folded_spaces_origin_clusters_no_drops():
     got = pairs_to_setlist(enters, n)
     want = brute_force_sets(pos, active, space, radius)
     assert got == want
+
+
+def test_step_jit_emits_no_donation_warning():
+    """Nothing in the step jits donates buffers anymore (no output can
+    alias the previous-position input), so lowering a FRESH config must
+    not emit jax's 'Some donated buffers were not usable' warning — the
+    noise that polluted every multichip dryrun log (ISSUE 2)."""
+    import warnings
+
+    # A capacity used nowhere else: the lru-cached jit must actually lower.
+    p = NeighborParams(
+        capacity=40, cell_size=100.0, grid_x=8, grid_z=8, space_slots=1,
+        cell_capacity=8, max_events=128,
+    )
+    eng = NeighborEngine(p, backend="jnp")
+    eng.reset()
+    rng = np.random.default_rng(0)
+    pos = rng.uniform(0, 700, (40, 2)).astype(np.float32)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        eng.step(pos, np.ones(40, bool), np.zeros(40, np.int32),
+                 np.full(40, 50.0, np.float32))
+        eng.step(pos + 1.0, np.ones(40, bool), np.zeros(40, np.int32),
+                 np.full(40, 50.0, np.float32))
+    donated = [w for w in caught if "donated" in str(w.message)]
+    assert not donated, [str(w.message) for w in donated]

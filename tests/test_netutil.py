@@ -230,3 +230,58 @@ def test_packet_codec_fuzz_roundtrip():
                 assert p.read_entity_id() == v
             else:
                 assert p.read_data() == v
+
+
+# --- tick-scoped write coalescing (ISSUE 2) ----------------------------------
+
+
+def test_cork_uncork_coalesces_writes():
+    """While corked, sends accumulate in the pending scatter list with no
+    flush task; uncork flushes the whole batch in one write and counts
+    the saved writes on net_coalesced_packets_total."""
+    from goworld_tpu import telemetry
+
+    coalesced = telemetry.counter("net_coalesced_packets_total")
+
+    async def run():
+        received = []
+        done = asyncio.Event()
+
+        async def handler(reader, writer):
+            conn = PacketConnection(reader, writer, flush_interval=0)
+            while True:
+                try:
+                    msgtype, pkt = await conn.recv_packet()
+                except ConnectionClosed:
+                    break
+                received.append((msgtype, pkt.payload))
+                if len(received) == 3:
+                    done.set()
+
+        server = await serve_tcp_forever("127.0.0.1", 0, handler)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await connect_tcp("127.0.0.1", port)
+        conn = PacketConnection(reader, writer)
+        base = coalesced.value
+        conn.cork()
+        for i in range(3):
+            conn.send_packet(10 + i, Packet(b"p%d" % i))
+        assert conn._flush_task is None  # corked: no per-send flush task
+        assert len(conn._pending) == 3
+        conn.uncork()
+        assert conn._pending == []
+        assert coalesced.value == base + 2  # 3 packets, 1 write: 2 saved
+        await asyncio.wait_for(done.wait(), timeout=5)
+        assert received == [(10, b"p0"), (11, b"p1"), (12, b"p2")]
+        # GoWorldConnection passthrough is a no-op for transports without
+        # cork (e.g. the WS adapter) and delegates when present.
+        gconn = GoWorldConnection(conn)
+        gconn.cork()
+        assert conn._corked
+        gconn.uncork()
+        assert not conn._corked
+        conn.close()
+        server.close()
+        await server.wait_closed()
+
+    asyncio.run(run())
