@@ -643,6 +643,39 @@ def bench_fanout() -> dict:
     }
 
 
+# --- chaos: fault-injection suite over a live in-process cluster -------------
+
+CHAOS_CONFIG = {"dispatchers": 2, "bots": 12}
+
+
+def bench_chaos() -> dict:
+    """``bench.py --chaos``: the full goworld_tpu.chaos scenario suite —
+    dispatcher kill+restart, severed link, stalled-past-heartbeat
+    dispatcher, storage outage — over a real dispatcher+game+gate cluster
+    with strict bots. Value = scenarios passed (every scenario asserts
+    zero bot errors / zero entity loss / in-deadline recovery, so any
+    failure surfaces as an ``error`` field instead of a number)."""
+    import tempfile
+
+    from goworld_tpu.chaos import run_chaos
+
+    c = CHAOS_CONFIG
+    with tempfile.TemporaryDirectory(prefix="bench_chaos_") as d:
+        r = run_chaos(d, n_dispatchers=c["dispatchers"], n_bots=c["bots"])
+    worst = max(
+        s.get("recovery_s", s.get("detect_s", 0.0)) for s in r["scenarios"]
+    )
+    return {
+        "metric": "chaos_scenarios_passed",
+        "value": float(r["passed"]),
+        "unit": "scenarios",
+        "worst_recovery_s": round(worst, 3),
+        "scenarios": r["scenarios"],
+        "config": dict(c),
+        "platform": "cpu",
+    }
+
+
 # Boids supercell sweep at a FIXED 100-unit interaction radius over the
 # same world span: bigger cells pack more agents per 128-lane cell
 # (12.5 avg at cell 100 = ~90% of the pair math on empty lanes).
@@ -914,6 +947,8 @@ def main() -> int:
          "pinned_floor_updates_per_sec", "entity-updates/sec"),
         ("--fanout", bench_fanout,
          "fanout_sync_records_per_sec", "sync-records/sec"),
+        ("--chaos", bench_chaos,
+         "chaos_scenarios_passed", "scenarios"),
     ):
         if flag in sys.argv[1:]:
             # Regression-gate mode: fixed config, CPU, no probe, no

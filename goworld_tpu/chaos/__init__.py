@@ -1,0 +1,24 @@
+"""Fault-injection (chaos) harness.
+
+Drives a REAL in-process cluster — N dispatchers + one game + one gate over
+localhost TCP, with strict protocol bots — while injecting the faults the
+resilience layer exists for: dispatcher crash + restart, mid-tick link
+severing (socket abort, not clean close), a process stalled past the
+heartbeat deadline, and a storage backend failing N writes. Scenarios
+assert zero bot errors, zero entity loss, and recovery within a deadline.
+
+Entry points: the scenario coroutines here (used by tests/test_chaos.py)
+and ``bench.py --chaos`` (one compact JSON headline like the other bench
+modes).
+"""
+
+from goworld_tpu.chaos.harness import (  # noqa: F401
+    ChaosCluster,
+    FlakyBackend,
+    dropped_packet_count,
+    run_chaos,
+    scenario_dispatcher_restart,
+    scenario_paused_dispatcher,
+    scenario_severed_link,
+    scenario_storage_outage,
+)

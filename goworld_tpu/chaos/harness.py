@@ -1,0 +1,502 @@
+"""In-process chaos cluster: real sockets, real services, injected faults.
+
+The cluster plumbing mirrors ``bench.py --fanout`` (dispatcher + game +
+gate over localhost TCP, protocol bots on the gate) extended to N
+dispatchers and fault injectors. Everything runs in ONE asyncio loop and
+ONE process — "killing a dispatcher" stops its service after aborting its
+sockets (RST, not FIN: peers see a crash, not a shutdown), "pausing" one
+stalls its logic/tick loops with sockets open (the half-open-link case the
+liveness heartbeats exist for), and the storage fault wraps the live
+backend in a write-failing decorator.
+
+Invariants every scenario asserts (ISSUE 3 acceptance):
+- zero bot errors (bots run strict — any protocol inconsistency records);
+- zero entity loss (every avatar still live on the game afterward);
+- recovery within the scenario deadline, proven by a full RPC round trip
+  (each bot Ping→Pong through gate → dispatcher → game and back).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Optional
+
+from goworld_tpu import telemetry
+from goworld_tpu.client import ClientBot
+from goworld_tpu.config.read_config import (
+    AOIConfig,
+    ClusterConfig,
+    DeploymentConfig,
+    DispatcherConfig,
+    GameConfig,
+    GateConfig,
+    GoWorldConfig,
+    KVDBConfig,
+    StorageConfig,
+)
+from goworld_tpu.dispatcher import DispatcherService
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.game import GameService
+from goworld_tpu.gate import GateService
+from goworld_tpu.utils import gwlog
+
+AOI_DISTANCE = 100.0
+
+
+class _Holder:
+    arena = None
+    joined = 0
+
+
+class ChaosSpace(Space):
+    def on_space_created(self):
+        if self.kind == 1:
+            self.enable_aoi(AOI_DISTANCE)
+            _Holder.arena = self
+
+
+class ChaosAvatar(Entity):
+    """Boot avatar: joins the shared arena and echoes Ping→Pong."""
+
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True, AOI_DISTANCE)
+
+    def on_client_connected(self):
+        arena = _Holder.arena
+        if arena is not None:
+            # Clustered inside one AOI radius: full mutual interest, so
+            # position syncs and creates fan out bot-to-bot (real traffic
+            # shapes, like the fanout bench).
+            x = 3.0 * _Holder.joined
+            _Holder.joined += 1
+            self.enter_space(arena.id, Vector3(x, 0.0, 10.0))
+        self.set_client_syncing(True)
+
+    def Ping_Client(self, n):
+        self.call_client("Pong", n)
+
+
+class FlakyBackend:
+    """Storage-backend decorator failing the next ``fail_writes`` writes
+    (reads stay healthy — the fault under test is a sick write path)."""
+
+    def __init__(self, inner) -> None:
+        self.inner = inner
+        self.fail_writes = 0
+        self.writes = 0
+        self.failed = 0
+
+    def write(self, typename: str, eid: str, data: dict) -> None:
+        if self.fail_writes > 0:
+            self.fail_writes -= 1
+            self.failed += 1
+            raise IOError("chaos: injected storage write failure")
+        self.inner.write(typename, eid, data)
+        self.writes += 1
+
+    def read(self, typename: str, eid: str):
+        return self.inner.read(typename, eid)
+
+    def exists(self, typename: str, eid: str) -> bool:
+        return self.inner.exists(typename, eid)
+
+    def list_entity_ids(self, typename: str):
+        return self.inner.list_entity_ids(typename)
+
+
+def dropped_packet_count() -> float:
+    """Sum of cluster_dropped_packets_total across all reasons."""
+    fam = telemetry.family("cluster_dropped_packets_total")
+    if fam is None:
+        return 0.0
+    return sum(child.value for _, child in fam.children())
+
+
+class ChaosCluster:
+    """N dispatchers + 1 game + 1 gate + strict bots, with fault hooks."""
+
+    def __init__(
+        self,
+        run_dir: str,
+        n_dispatchers: int = 2,
+        n_bots: int = 12,
+        *,
+        peer_heartbeat_timeout: float = 1.0,
+        down_buffer_bytes: int = 2 * 1024 * 1024,
+        reconnect_max_interval: float = 1.0,
+        sync_interval: float = 0.05,
+        storage_knobs: Optional[dict] = None,
+    ) -> None:
+        self.run_dir = run_dir
+        self.n_dispatchers = n_dispatchers
+        self.n_bots = n_bots
+        self.peer_heartbeat_timeout = peer_heartbeat_timeout
+        self.cluster_cfg = ClusterConfig(
+            down_buffer_bytes=down_buffer_bytes,
+            peer_heartbeat_timeout=peer_heartbeat_timeout,
+            reconnect_max_interval=reconnect_max_interval,
+        )
+        self.sync_interval = sync_interval
+        self.storage_knobs = storage_knobs or {}
+        self.dispatchers: list[Optional[DispatcherService]] = []
+        self.ports: list[int] = []
+        self.game: Optional[GameService] = None
+        self.gate: Optional[GateService] = None
+        self.bots: list[ClientBot] = []
+        self._game_task: Optional[asyncio.Task] = None
+        self._ping_seq = 0
+        self._pongs: dict[str, list] = {}
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        from goworld_tpu.entity import entity_manager as em
+
+        em.cleanup_for_tests()
+        _Holder.arena = None
+        _Holder.joined = 0
+        em.register_space(ChaosSpace)
+        em.register_entity(ChaosAvatar)
+        for i in range(self.n_dispatchers):
+            d = DispatcherService(
+                i + 1, desired_games=1, desired_gates=1,
+                peer_heartbeat_timeout=self.peer_heartbeat_timeout)
+            await d.start()
+            self.dispatchers.append(d)
+            self.ports.append(d.port)
+
+        cfg = GoWorldConfig()
+        cfg.deployment = DeploymentConfig(
+            desired_games=1, desired_gates=1,
+            desired_dispatchers=self.n_dispatchers)
+        cfg.dispatchers = {
+            i + 1: DispatcherConfig(port=p) for i, p in enumerate(self.ports)
+        }
+        cfg.games = {1: GameConfig(
+            boot_entity="ChaosAvatar", save_interval=0.0,
+            position_sync_interval=self.sync_interval)}
+        cfg.gates = {1: GateConfig(
+            port=0, position_sync_interval=self.sync_interval,
+            heartbeat_timeout=30.0)}
+        cfg.aoi = AOIConfig(backend="xzlist")  # host pipeline only, no jax
+        cfg.storage = StorageConfig(
+            type="filesystem", directory=self.run_dir + "/es",
+            **self.storage_knobs)
+        cfg.kvdb = KVDBConfig(
+            type="filesystem", directory=self.run_dir + "/kv")
+        cfg.cluster = self.cluster_cfg
+        self.cfg = cfg
+
+        self.game = GameService(1, cfg, restore=False)
+        self._game_task = asyncio.get_running_loop().create_task(
+            self.game.run_async())
+        self.gate = GateService(1, cfg)
+        await self.gate.start()
+        await self._wait(lambda: self.game.deployment_ready, 15.0,
+                         "cluster never became deployment-ready")
+        em.create_space_locally(1)
+        assert _Holder.arena is not None
+        for i in range(self.n_bots):
+            bot = ClientBot(name=f"chaosbot{i}", strict=True,
+                            heartbeat_interval=1.0)
+            self._pongs[bot.name] = []
+            bot.rpc_handlers[(None, "Pong")] = (
+                lambda entity, n, name=bot.name: self._pongs[name].append(n))
+            await bot.connect("127.0.0.1", self.gate.port)
+            await bot.wait_player(timeout=10)
+            self.bots.append(bot)
+        await self._wait(
+            lambda: sum(1 for e in em.entities().values()
+                        if e.typename == "ChaosAvatar"
+                        and e.client is not None) == self.n_bots,
+            15.0, "bots never all attached to avatars")
+
+    async def stop(self) -> None:
+        from goworld_tpu import kvdb, storage
+        from goworld_tpu.entity import entity_manager as em
+        from goworld_tpu.utils import post
+
+        for b in self.bots:
+            await b.close()
+        if self.gate is not None:
+            await self.gate.stop()
+        if self.game is not None:
+            self.game.terminate()
+            try:
+                await asyncio.wait_for(self._game_task, timeout=10)
+            except Exception:
+                pass
+        for d in self.dispatchers:
+            if d is not None:
+                await d.stop()
+        storage.set_backend(None)
+        kvdb.set_backend(None)
+        em.cleanup_for_tests()
+        post.clear()
+
+    async def _wait(self, cond, timeout: float, what: str) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            await asyncio.sleep(0.01)
+        raise AssertionError(f"chaos: {what} (after {timeout:.1f}s)")
+
+    # --- invariants ---------------------------------------------------------
+
+    def bot_errors(self) -> list[str]:
+        return [err for b in self.bots for err in b.errors]
+
+    def live_avatars(self) -> int:
+        from goworld_tpu.entity import entity_manager as em
+
+        return sum(1 for e in em.entities().values()
+                   if e.typename == "ChaosAvatar")
+
+    def links_up(self) -> bool:
+        return all(
+            m.proxy is not None
+            for svc in (self.game, self.gate)
+            for m in svc.cluster._mgrs
+        )
+
+    async def assert_rpc_roundtrip(self, deadline: float = 10.0) -> float:
+        """Every bot pings its avatar; returns seconds until every pong
+        landed. Packets buffered in replay rings count — the deadline spans
+        reconnect + replay, which is exactly the recovery being measured."""
+        self._ping_seq += 1
+        n = self._ping_seq
+        t0 = time.monotonic()
+        for b in self.bots:
+            assert b.player is not None, f"{b.name}: player mirror lost"
+            b.player.call_server("Ping_Client", n)
+        await self._wait(
+            lambda: all(n in self._pongs[b.name] for b in self.bots),
+            deadline, f"ping {n}: not every bot got its pong")
+        return time.monotonic() - t0
+
+    # --- fault injectors ----------------------------------------------------
+
+    async def kill_dispatcher(self, i: int) -> None:
+        """Crash semantics: RST every peer socket, then stop the service
+        (a clean stop would FIN-close, which a crash never does)."""
+        d = self.dispatchers[i]
+        assert d is not None
+        for proxy in list(d._conns):
+            proxy.conn.abort()
+        await d.stop()
+        self.dispatchers[i] = None
+        gwlog.infof("chaos: dispatcher %d killed (port %d)",
+                    i + 1, self.ports[i])
+
+    async def restart_dispatcher(self, i: int) -> None:
+        d = DispatcherService(
+            i + 1, desired_games=1, desired_gates=1,
+            peer_heartbeat_timeout=self.peer_heartbeat_timeout)
+        for _ in range(100):  # the old socket may linger briefly
+            try:
+                await d.start(port=self.ports[i])
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"chaos: could not rebind dispatcher port {self.ports[i]}")
+        self.dispatchers[i] = d
+        gwlog.infof("chaos: dispatcher %d restarted", i + 1)
+
+    def sever_game_link(self, i: int) -> None:
+        """Abort the game↔dispatcher-i socket mid-tick (RST, not close)."""
+        m = self.game.cluster._mgrs[i]
+        assert m.proxy is not None, "link already down"
+        m.proxy.conn.abort()
+
+    def pause_dispatcher(self, i: int) -> None:
+        self.dispatchers[i].pause()
+
+    def resume_dispatcher(self, i: int) -> None:
+        self.dispatchers[i].resume()
+
+
+# --- scenarios ---------------------------------------------------------------
+
+
+async def scenario_dispatcher_restart(
+    cluster: ChaosCluster, downtime: float = 0.3, victim: int = 0,
+    recovery_deadline: float = 10.0,
+) -> dict:
+    """Kill one dispatcher (of >= 2) under live bots, ping THROUGH the
+    outage (sends buffer in replay rings), restart it, and require every
+    pong + zero drops + zero bot errors + zero entity loss."""
+    await cluster.assert_rpc_roundtrip()
+    drops0 = dropped_packet_count()
+    await cluster.kill_dispatcher(victim)
+    # Pings issued while the dispatcher is DOWN: gate/game sends to it park
+    # in the replay rings and must be delivered after the reconnect.
+    cluster._ping_seq += 1
+    mid = cluster._ping_seq
+    for b in cluster.bots:
+        b.player.call_server("Ping_Client", mid)
+    await asyncio.sleep(downtime)
+    t0 = time.monotonic()
+    await cluster.restart_dispatcher(victim)
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never reconnected after dispatcher restart")
+    await cluster._wait(
+        lambda: all(mid in cluster._pongs[b.name] for b in cluster.bots),
+        recovery_deadline, "mid-outage pings were lost")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    recovery = time.monotonic() - t0
+    drops = dropped_packet_count() - drops0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors during dispatcher restart: {errors[:5]}"
+    assert drops == 0, f"{drops} packets dropped (ring overflow?)"
+    assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    return {"scenario": "dispatcher_restart", "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3), "dropped": drops,
+            "bot_errors": len(errors)}
+
+
+async def scenario_severed_link(
+    cluster: ChaosCluster, victim: int = 0, recovery_deadline: float = 10.0,
+) -> dict:
+    """RST the game↔dispatcher link mid-tick; the reconnect loop must
+    restore it and buffered sends must replay."""
+    await cluster.assert_rpc_roundtrip()
+    drops0 = dropped_packet_count()
+    t0 = time.monotonic()
+    cluster.sever_game_link(victim)
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "severed link never reconnected")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    recovery = time.monotonic() - t0
+    drops = dropped_packet_count() - drops0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors after severed link: {errors[:5]}"
+    assert drops == 0, f"{drops} packets dropped after severed link"
+    assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    return {"scenario": "severed_link", "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3), "dropped": drops,
+            "bot_errors": len(errors)}
+
+
+async def scenario_paused_dispatcher(
+    cluster: ChaosCluster, victim: int = 0, recovery_deadline: float = 15.0,
+) -> dict:
+    """Stall a dispatcher past the heartbeat deadline (sockets open, loops
+    frozen — the half-open case). Peers' liveness watchdogs must abort the
+    silent links (converting the stall into reconnects) instead of waiting
+    on the OS; after resume, traffic must flow again."""
+    await cluster.assert_rpc_roundtrip()
+    hb_kills0 = telemetry.counter(
+        "cluster_link_heartbeat_kills_total").value
+    cluster.pause_dispatcher(victim)
+    # Past the deadline the game/gate watchdogs must have aborted the
+    # victim's silent links at least once.
+    pause_span = cluster.peer_heartbeat_timeout * 2.0 + 1.0
+    t0 = time.monotonic()
+    await cluster._wait(
+        lambda: telemetry.counter(
+            "cluster_link_heartbeat_kills_total").value > hb_kills0,
+        pause_span + 5.0, "no liveness kill while dispatcher was stalled")
+    detected = time.monotonic() - t0
+    cluster.resume_dispatcher(victim)
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never recovered after resume")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across paused dispatcher: {errors[:5]}"
+    assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    return {"scenario": "paused_dispatcher",
+            "detect_s": round(detected, 3),
+            "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
+
+
+async def scenario_storage_outage(
+    cluster: ChaosCluster, failures: int = 25, n_saves: int = 10,
+    recovery_deadline: float = 10.0,
+) -> dict:
+    """Fail the next N storage writes: the circuit must OPEN (worker not
+    wedged — reads still served), saves defer, and once the backend heals
+    every deferred save must land within the deadline."""
+    from goworld_tpu import storage
+    from goworld_tpu.storage.circuit import CircuitBreaker
+
+    flaky = FlakyBackend(storage.get_backend())
+    storage.set_backend(flaky)
+    flaky.fail_writes = failures
+    t0 = time.monotonic()
+    for k in range(n_saves):
+        storage.save("ChaosDoc", f"doc{k:03d}", {"k": k})
+    await cluster._wait(
+        lambda: storage.circuit_state() == CircuitBreaker.OPEN,
+        recovery_deadline, "circuit never opened under write failures")
+    opened = time.monotonic() - t0
+    # Worker must still serve reads while the circuit is open.
+    got: list = []
+    storage.load("ChaosDoc", "doc000", lambda r, e: got.append((r, e)))
+    from goworld_tpu.utils import post as _post
+
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline and not got:
+        _post.tick()  # completion callbacks ride the post queue
+        await asyncio.sleep(0.02)
+    assert got and got[0][1] is None, "worker wedged: load never completed"
+    # Backend heals; periodic saves (the save_interval crontab in prod)
+    # probe the half-open circuit and flush the deferred queue.
+    flaky.fail_writes = 0
+    t1 = time.monotonic()
+    k = n_saves
+    while (storage.deferred_count()
+           or storage.circuit_state() != CircuitBreaker.CLOSED):
+        if time.monotonic() - t1 > recovery_deadline:
+            raise AssertionError(
+                f"storage never recovered: state={storage.circuit_state()} "
+                f"deferred={storage.deferred_count()}")
+        storage.save("ChaosDoc", f"doc{k:03d}", {"k": k})
+        k += 1
+        await asyncio.sleep(0.1)
+    storage.wait_clear(10.0)
+    recovery = time.monotonic() - t1
+    missing = [i for i in range(n_saves)
+               if flaky.inner.read("ChaosDoc", f"doc{i:03d}") is None]
+    assert not missing, f"saves lost across the outage: {missing}"
+    return {"scenario": "storage_outage", "open_after_s": round(opened, 3),
+            "recovery_s": round(recovery, 3),
+            "failed_writes": flaky.failed, "lost_saves": len(missing)}
+
+
+def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12) -> dict:
+    """Run the full scenario suite over one cluster (``bench.py --chaos``).
+    Returns a JSON-able summary; raises on any invariant violation."""
+
+    async def _run() -> dict:
+        cluster = ChaosCluster(
+            run_dir, n_dispatchers=n_dispatchers, n_bots=n_bots,
+            storage_knobs=dict(
+                retry_base_interval=0.05, retry_max_interval=0.2,
+                circuit_failure_threshold=3, circuit_cooldown=0.3,
+            ))
+        await cluster.start()
+        try:
+            results = [
+                await scenario_dispatcher_restart(cluster),
+                await scenario_severed_link(cluster),
+                await scenario_paused_dispatcher(cluster),
+                await scenario_storage_outage(cluster),
+            ]
+        finally:
+            await cluster.stop()
+        return {
+            "scenarios": results,
+            "passed": len(results),
+            "bot_errors": 0,
+            "dispatchers": n_dispatchers,
+            "bots": n_bots,
+        }
+
+    return asyncio.run(_run())

@@ -99,6 +99,25 @@ class GateConfig:
 
 
 @dataclasses.dataclass
+class ClusterConfig:
+    """Game/gate↔dispatcher link resilience knobs (``[cluster]``; defaults
+    mirror consts.py — no reference analog: GoWorld drops packets to down
+    dispatchers and reconnects on a fixed 1 s interval)."""
+
+    # Byte cap of the per-link replay ring buffering sends while a
+    # dispatcher link is down (0 = legacy drop-on-down).
+    down_buffer_bytes: int = 2 * 1024 * 1024
+    # Close links silent past this many seconds (HEARTBEAT msgtype sent on
+    # idle links every timeout/3 by both ends); 0 disables liveness kills.
+    peer_heartbeat_timeout: float = 10.0
+    # Default deadline of ClusterClient.wait_connected().
+    wait_connected_timeout: float = 10.0
+    # Reconnect backoff ceiling (base is consts.RECONNECT_INTERVAL;
+    # delays are full-jittered).
+    reconnect_max_interval: float = 15.0
+
+
+@dataclasses.dataclass
 class StorageConfig:
     type: str = "filesystem"
     directory: str = "_entity_storage"  # filesystem backend
@@ -107,6 +126,16 @@ class StorageConfig:
     # redis_cluster seed nodes, from ``start_nodes_N = host:port`` keys
     # (reference read_config.go:492-493).
     start_nodes: list = dataclasses.field(default_factory=list)
+    # Save-retry / circuit-breaker knobs (storage/__init__.py): retries
+    # back off retry_base_interval → retry_max_interval (doubling); after
+    # circuit_failure_threshold consecutive failures the circuit opens and
+    # saves defer into a deferred_bytes_cap-bounded queue until a
+    # half-open probe (after circuit_cooldown seconds) succeeds.
+    retry_base_interval: float = 1.0
+    retry_max_interval: float = 30.0
+    circuit_failure_threshold: int = 5
+    circuit_cooldown: float = 5.0
+    deferred_bytes_cap: int = 8 * 1024 * 1024
 
 
 @dataclasses.dataclass
@@ -177,6 +206,7 @@ class GoWorldConfig:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     kvdb: KVDBConfig = dataclasses.field(default_factory=KVDBConfig)
     aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
+    cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
 
 
@@ -305,6 +335,13 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             url=s.get("url", ""),
             db=s.get("db", "goworld"),
             start_nodes=_read_start_nodes(s),
+            retry_base_interval=float(s.get("retry_base_interval", 1.0)),
+            retry_max_interval=float(s.get("retry_max_interval", 30.0)),
+            circuit_failure_threshold=int(
+                s.get("circuit_failure_threshold", 5)),
+            circuit_cooldown=float(s.get("circuit_cooldown", 5.0)),
+            deferred_bytes_cap=int(
+                s.get("deferred_bytes_cap", 8 * 1024 * 1024)),
         )
     if cp.has_section("kvdb"):
         s = cp["kvdb"]
@@ -331,6 +368,14 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             multihost_processes=int(s.get("multihost_processes", 0)),
             delivery=s.get("delivery", "pipelined").strip().lower(),
             sync_wait_budget=float(s.get("sync_wait_budget", 0.5)),
+        )
+    if cp.has_section("cluster"):
+        s = cp["cluster"]
+        cfg.cluster = ClusterConfig(
+            down_buffer_bytes=int(s.get("down_buffer_bytes", 2 * 1024 * 1024)),
+            peer_heartbeat_timeout=float(s.get("peer_heartbeat_timeout", 10.0)),
+            wait_connected_timeout=float(s.get("wait_connected_timeout", 10.0)),
+            reconnect_max_interval=float(s.get("reconnect_max_interval", 15.0)),
         )
     if cp.has_section("debug"):
         cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
@@ -467,6 +512,30 @@ def _validate(cfg: GoWorldConfig) -> None:
                 "[aoi] multihost requires the same position_sync_interval "
                 f"on every game; got {sorted(cadences)}"
             )
+    cl = cfg.cluster
+    if cl.down_buffer_bytes < 0:
+        raise ValueError("[cluster] down_buffer_bytes must be >= 0 (0 = drop)")
+    if cl.peer_heartbeat_timeout < 0:
+        raise ValueError(
+            "[cluster] peer_heartbeat_timeout must be >= 0 (0 = disabled)")
+    if cl.wait_connected_timeout <= 0:
+        raise ValueError("[cluster] wait_connected_timeout must be > 0")
+    if cl.reconnect_max_interval <= 0:
+        raise ValueError("[cluster] reconnect_max_interval must be > 0")
+    st = cfg.storage
+    if st.retry_base_interval <= 0 or st.retry_max_interval <= 0:
+        raise ValueError("[storage] retry intervals must be > 0 seconds")
+    if st.retry_max_interval < st.retry_base_interval:
+        raise ValueError(
+            "[storage] retry_max_interval must be >= retry_base_interval")
+    if st.circuit_failure_threshold < 1:
+        # 0 would open the circuit before the first attempt — saves would
+        # never reach the backend at all.
+        raise ValueError("[storage] circuit_failure_threshold must be >= 1")
+    if st.circuit_cooldown <= 0:
+        raise ValueError("[storage] circuit_cooldown must be > 0 seconds")
+    if st.deferred_bytes_cap < 0:
+        raise ValueError("[storage] deferred_bytes_cap must be >= 0")
     for section, c in (("storage", cfg.storage), ("kvdb", cfg.kvdb)):
         if c.type == "redis_cluster" and not c.start_nodes:
             # read_config.go:555-556,617-619: fatal without seed nodes.

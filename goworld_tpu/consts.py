@@ -42,11 +42,50 @@ DISPATCHER_FREEZE_GAME_TIMEOUT = 30.0
 FREEZE_ACK_TIMEOUT = 10.0
 FREEZE_QUIESCENT_WINDOW = 0.3
 FREEZE_DRAIN_CAP = 5.0
-RECONNECT_INTERVAL = 1.0  # DispatcherConnMgr reconnect backoff
+RECONNECT_INTERVAL = 1.0  # DispatcherConnMgr reconnect backoff (base)
+# Reconnect backoff ceiling: delays grow base * 2^attempt with full jitter
+# up to this cap, so a dead dispatcher isn't hammered at 1 Hz by every
+# process in the deployment AND a thundering-herd reconnect (all games +
+# gates at once after a dispatcher restart) is spread out.
+RECONNECT_INTERVAL_MAX = 15.0
 CLIENT_HEARTBEAT_TIMEOUT = 30.0  # gate kills silent clients
+
+# --- cluster-link resilience ([cluster] ini section overrides) --------------
+# Byte cap of the per-link replay ring: sends to a down dispatcher buffer
+# here (drop-OLDEST on overflow, counted on cluster_dropped_packets_total)
+# and replay right after the reconnect handshake. 0 restores the legacy
+# drop-on-down behavior.
+CLUSTER_DOWN_BUFFER_BYTES = 2 * 1024 * 1024
+# Liveness deadline for game/gate↔dispatcher links: both ends send a
+# HEARTBEAT msgtype on idle links (every timeout/3) and close a link silent
+# past the timeout, converting a half-open TCP connection into the normal
+# reconnect path instead of an indefinite stall. 0 disables.
+CLUSTER_PEER_HEARTBEAT_TIMEOUT = 10.0
+# Default wait_connected() deadline (DispatcherClusterBase).
+CLUSTER_WAIT_CONNECTED_TIMEOUT = 10.0
+# Dispatcher-side reconnect grace: with replay-buffered links a blip is
+# steady-state, so an UNPLANNED game/gate disconnect buffers that peer's
+# packets for this window (like the freeze window) instead of instantly
+# wiping routes / broadcasting peer-death — the reconnect handshake flushes
+# the buffer; only a window that lapses becomes a real death. The same
+# window buffers packets for not-yet-routed entities (a gate's ring replay
+# racing the game's re-handshake into a restarted dispatcher).
+DISPATCHER_RECONNECT_BUFFER_WINDOW = 5.0
 
 # --- persistence ------------------------------------------------------------
 DEFAULT_SAVE_INTERVAL = 300.0  # 5 min (read_config.go:28)
+# Save-retry backoff: the reference retries forever at a fixed 1 s
+# (storage.go:197-240); here the delay doubles per consecutive failure up
+# to the cap, and after STORAGE_CIRCUIT_FAILURE_THRESHOLD consecutive
+# failures the per-backend circuit OPENS: further saves defer into a
+# byte-capped queue (keeping the single storage worker live for the other
+# entities) until a half-open probe after STORAGE_CIRCUIT_COOLDOWN
+# succeeds. All overridable via the [storage] ini section.
+STORAGE_RETRY_BASE_INTERVAL = 1.0
+STORAGE_RETRY_MAX_INTERVAL = 30.0
+STORAGE_CIRCUIT_FAILURE_THRESHOLD = 5
+STORAGE_CIRCUIT_COOLDOWN = 5.0
+STORAGE_DEFERRED_BYTES_CAP = 8 * 1024 * 1024
 
 # --- AOI / TPU compute plane ------------------------------------------------
 # Default fixed neighbor-set capacity per entity on the TPU path. The

@@ -46,6 +46,7 @@ def run(dispid: int | None = None) -> int:
             args.dispid,
             desired_games=cfg.deployment.desired_games,
             desired_gates=cfg.deployment.desired_gates,
+            peer_heartbeat_timeout=cfg.cluster.peer_heartbeat_timeout,
         )
         host, port = (disp_cfg.host, disp_cfg.port) if disp_cfg else ("127.0.0.1", 0)
         await svc.start(host, port)

@@ -95,16 +95,68 @@ class _GameInfo:
             self.proxy.send(msgtype, packet)
 
 
+class _GateInfo:
+    """Per-gate connection state with a reconnect-grace buffer.
+
+    No reference analog: GoWorld's gate EXITS on dispatcher loss, so a gate
+    never reconnects and the dispatcher can forget it instantly. Here a
+    gate link blip is expected steady-state — during the grace window
+    gate-bound packets buffer (bounded) and NOTIFY_GATE_DISCONNECTED is
+    withheld, because broadcasting it would make every game detach the
+    LIVE gate's client bindings."""
+
+    def __init__(self, gateid: int) -> None:
+        self.gateid = gateid
+        self.proxy: Optional[GoWorldConnection] = None
+        self.block_until = 0.0  # reconnect-grace window while down
+        self.pending: Deque[tuple[int, Packet]] = collections.deque()
+
+    @property
+    def connected(self) -> bool:
+        return self.proxy is not None and not self.proxy.closed
+
+    def blocked(self, now: float) -> bool:
+        return self.block_until > now
+
+    def dispatch(self, msgtype: int, packet: Packet, now: float) -> None:
+        if self.connected:
+            self.proxy.send(msgtype, packet)
+        elif self.blocked(now):
+            if len(self.pending) < consts.GAME_PENDING_PACKET_QUEUE_MAX_LEN:
+                self.pending.append((msgtype, packet))
+        # else: gate is gone for good — drop
+
+    def unblock_and_flush(self) -> None:
+        self.block_until = 0.0
+        if self.proxy is None:
+            return
+        while self.pending:
+            msgtype, packet = self.pending.popleft()
+            self.proxy.send(msgtype, packet)
+
+
 class DispatcherService:
     """One dispatcher process. Run with :meth:`start`, stop with :meth:`stop`."""
 
-    def __init__(self, dispid: int, desired_games: int = 1, desired_gates: int = 1) -> None:
+    def __init__(self, dispid: int, desired_games: int = 1, desired_gates: int = 1,
+                 peer_heartbeat_timeout: Optional[float] = None) -> None:
         self.dispid = dispid
         self.desired_games = desired_games
         self.desired_gates = desired_gates
+        # Liveness deadline for game/gate links ([cluster]
+        # peer_heartbeat_timeout; 0 disables): HEARTBEAT is sent on idle
+        # links and peers silent past the deadline are closed, converting
+        # half-open connections into the peers' reconnect path.
+        self.peer_heartbeat_timeout = (
+            consts.CLUSTER_PEER_HEARTBEAT_TIMEOUT
+            if peer_heartbeat_timeout is None else peer_heartbeat_timeout)
         self.entities: dict[str, _EntityDispatchInfo] = {}
         self.games: dict[int, _GameInfo] = {}
-        self.gates: dict[int, GoWorldConnection] = {}
+        self.gates: dict[int, _GateInfo] = {}
+        # Not-yet-routed entities holding buffered packets: eid → expiry.
+        # Gives a gate's ring replay racing the game's re-handshake into a
+        # restarted dispatcher a grace window instead of a drop.
+        self._unrouted: dict[str, float] = {}
         self.kvreg: dict[str, str] = {}
         self.deployment_ready = False
         self._boot_rr = 0
@@ -119,6 +171,17 @@ class DispatcherService:
         # the connection proxy itself)
         self._proxy_games: dict[GoWorldConnection, int] = {}
         self._proxy_gates: dict[GoWorldConnection, int] = {}
+        # Liveness bookkeeping: proxy → monotonic last-packet time (updated
+        # by the per-connection recv task), proxy → sent_packets mark at
+        # the last heartbeat tick (idle-link detection).
+        self._peer_last_seen: dict[GoWorldConnection, float] = {}
+        self._hb_sent_marks: dict[GoWorldConnection, int] = {}
+        self._last_hb_tick = 0.0
+        # Chaos/testing hook: while cleared, the logic and tick loops stop
+        # draining — models a stalled (SIGSTOP-like) process whose sockets
+        # stay open. pause()/resume().
+        self._resume_event = asyncio.Event()
+        self._resume_event.set()
         self.port: int = 0
 
     # --- lifecycle ----------------------------------------------------------
@@ -164,6 +227,32 @@ class DispatcherService:
             "Entries in the entity routing table.", ("dispid",),
         ).labels(d).set_function(lambda: len(self.entities))
 
+    def _track_peer_gauge(self, peer: str) -> None:
+        """Pull-sampled ``cluster_peer_last_seen_seconds{dispid,peer}``:
+        seconds since the named peer's last packet (NaN once gone). One
+        child per registered game/gate; removed on disconnect."""
+        from goworld_tpu import telemetry
+
+        def age() -> float:
+            table = self.games if peer.startswith("game") else self.gates
+            info = table.get(int(peer[4:]))
+            proxy = info.proxy if info is not None else None
+            last = self._peer_last_seen.get(proxy) if proxy is not None else None
+            return time.monotonic() - last if last is not None else float("nan")
+
+        telemetry.gauge(
+            "cluster_peer_last_seen_seconds",
+            "Seconds since the last packet from each registered peer.",
+            ("dispid", "peer"),
+        ).labels(str(self.dispid), peer).set_function(age)
+
+    def _untrack_peer_gauge(self, peer: str) -> None:
+        from goworld_tpu import telemetry
+
+        fam = telemetry.family("cluster_peer_last_seen_seconds")
+        if fam is not None:
+            fam.remove(str(self.dispid), peer)
+
     def _unregister_metrics(self) -> None:
         from goworld_tpu import telemetry
 
@@ -173,6 +262,12 @@ class DispatcherService:
             fam = telemetry.family(name)
             if fam is not None:
                 fam.remove(d)
+        fam = telemetry.family("cluster_peer_last_seen_seconds")
+        if fam is not None:
+            for gid in list(self.games):
+                fam.remove(d, f"game{gid}")
+            for gid in list(self.gates):
+                fam.remove(d, f"gate{gid}")
 
     async def stop(self) -> None:
         self._unregister_metrics()
@@ -195,27 +290,33 @@ class DispatcherService:
         for gi in self.games.values():
             if gi.proxy is not None:
                 gi.proxy.close()
-        for gp in self.gates.values():
-            gp.close()
+        for gt in self.gates.values():
+            if gt.proxy is not None:
+                gt.proxy.close()
 
     # --- connection handling -------------------------------------------------
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         proxy = GoWorldConnection(PacketConnection(reader, writer))
         self._conns.add(proxy)
+        self._peer_last_seen[proxy] = time.monotonic()
         try:
             while True:
                 msgtype, packet = await proxy.recv()
+                self._peer_last_seen[proxy] = time.monotonic()
                 await self._queue.put((proxy, msgtype, packet))
         except ConnectionClosed:
             await self._queue.put((proxy, -1, None))  # disconnect sentinel
         finally:
             self._conns.discard(proxy)
+            self._peer_last_seen.pop(proxy, None)
+            self._hb_sent_marks.pop(proxy, None)
             proxy.close()
 
     async def _logic_loop(self) -> None:
         while True:
             proxy, msgtype, packet = await self._queue.get()
+            await self._resume_event.wait()  # chaos pause hook (no-op live)
             try:
                 if msgtype == -1:
                     self._handle_disconnect(proxy)
@@ -227,19 +328,115 @@ class DispatcherService:
     async def _tick_loop(self) -> None:
         while True:
             await asyncio.sleep(consts.DISPATCHER_SERVICE_TICK_INTERVAL)
+            await self._resume_event.wait()  # chaos pause hook (no-op live)
             self._send_pending_syncs()
             self._sweep_dead_frozen_games()
+            self._sweep_dead_gates()
+            self._sweep_unrouted_entities()
+            self._heartbeat_tick()
+
+    # --- chaos/testing hooks -------------------------------------------------
+
+    def pause(self) -> None:
+        """Stall the process without closing sockets: the logic and tick
+        loops stop draining (recv tasks keep filling the bounded queue —
+        kernel-level ACKs continue, exactly like a SIGSTOPped process).
+        Peers' liveness watchdogs are expected to kill the silent links."""
+        self._resume_event.clear()
+
+    def resume(self) -> None:
+        self._resume_event.set()
+
+    # --- peer liveness (no reference analog; PR 3) ---------------------------
+
+    def _peer_proxies(self) -> list[tuple[str, GoWorldConnection]]:
+        peers = [
+            (f"game{gid}", gi.proxy)
+            for gid, gi in self.games.items() if gi.connected
+        ]
+        peers.extend(
+            (f"gate{gid}", gt.proxy)
+            for gid, gt in self.gates.items() if gt.connected
+        )
+        return peers
+
+    def _heartbeat_tick(self) -> None:
+        """Every timeout/3: HEARTBEAT every idle registered link, and close
+        links silent past the timeout (the peer's reconnect loop takes it
+        from there — a half-open link must not stall forever)."""
+        timeout = self.peer_heartbeat_timeout
+        if timeout <= 0:
+            return
+        now = self._now()
+        if now - self._last_hb_tick < max(0.05, timeout / 3.0):
+            return
+        self._last_hb_tick = now
+        for name, proxy in self._peer_proxies():
+            last = self._peer_last_seen.get(proxy)
+            if last is not None and now - last > timeout:
+                gwlog.warnf(
+                    "dispatcher %d: %s silent for %.1fs (> %.1fs heartbeat "
+                    "deadline); closing half-open link",
+                    self.dispid, name, now - last, timeout)
+                proxy.close()
+                continue
+            if self._hb_sent_marks.get(proxy) == proxy.conn.sent_packets:
+                try:
+                    proxy.send_cluster_heartbeat()
+                except Exception:
+                    pass  # dying link; its recv task reports the disconnect
+            self._hb_sent_marks[proxy] = proxy.conn.sent_packets
 
     def _sweep_dead_frozen_games(self) -> None:
-        """A game that disconnected while frozen and never came back: once its
-        freeze window lapses, clean it up like any dead game (the reference
-        only buffers for the freeze timeout, DispatcherService.go:82-169)."""
+        """A game that disconnected — frozen for a reload, or unplanned
+        (which now gets a reconnect-grace buffer window too) — and never
+        came back: once its window lapses, clean it up like any dead game
+        (the reference only buffers for the freeze timeout,
+        DispatcherService.go:82-169)."""
         now = self._now()
         for gameid, gi in list(self.games.items()):
             if gi.proxy is None and gi.block_until and not gi.blocked(now):
                 gi.block_until = 0.0
                 gi.pending.clear()
                 self._handle_game_down(gameid)
+
+    def _sweep_dead_gates(self) -> None:
+        """A gate whose reconnect-grace window lapsed is really dead: NOW
+        broadcast NOTIFY_GATE_DISCONNECTED (games detach its clients) and
+        forget it."""
+        now = self._now()
+        for gateid, gt in list(self.gates.items()):
+            if gt.proxy is None and gt.block_until and not gt.blocked(now):
+                self.gates.pop(gateid, None)
+                self._untrack_peer_gauge(f"gate{gateid}")
+                dropped = len(gt.pending)
+                gt.pending.clear()
+                p = Packet()
+                p.append_uint16(gateid)
+                self._broadcast_games(MsgType.NOTIFY_GATE_DISCONNECTED, p)
+                gwlog.infof(
+                    "dispatcher %d: gate %d never reconnected (%d buffered "
+                    "packets dropped); declared dead", self.dispid, gateid,
+                    dropped)
+
+    def _sweep_unrouted_entities(self) -> None:
+        """Drop buffered packets for entities no game claimed within the
+        grace window (the packets raced a re-handshake that never came, or
+        named a destroyed/bogus entity)."""
+        if not self._unrouted:
+            return
+        now = self._now()
+        for eid, expiry in list(self._unrouted.items()):
+            if now < expiry:
+                continue
+            del self._unrouted[eid]
+            info = self.entities.get(eid)
+            if info is not None and info.gameid == 0:
+                gwlog.warnf(
+                    "dispatcher %d: dropping %d buffered packets for "
+                    "never-routed entity %s", self.dispid,
+                    len(info.pending), eid)
+                del self.entities[eid]
 
     # --- dispatch helpers ----------------------------------------------------
 
@@ -251,6 +448,12 @@ class DispatcherService:
         if gi is None:
             gi = self.games[gameid] = _GameInfo(gameid)
         return gi
+
+    def _gate(self, gateid: int) -> _GateInfo:
+        gt = self.gates.get(gateid)
+        if gt is None:
+            gt = self.gates[gateid] = _GateInfo(gateid)
+        return gt
 
     def _entity(self, eid: str) -> _EntityDispatchInfo:
         info = self.entities.get(eid)
@@ -266,11 +469,25 @@ class DispatcherService:
 
     def _dispatch_to_entity(self, eid: str, msgtype: int, packet: Packet) -> None:
         """Route a packet by the entity table, honoring blocks
-        (DispatcherService.go:34-80,826-844)."""
+        (DispatcherService.go:34-80,826-844). An UNKNOWN entity gets a
+        short buffered grace window instead of an instant drop (deviation
+        from the reference): after a dispatcher restart, a gate's replay
+        ring can legitimately land packets before the owning game's
+        re-handshake installs the route — the handshake/NOTIFY_CREATE
+        flush delivers them; _sweep_unrouted_entities drops unclaimed
+        buffers when the window lapses."""
         now = self._now()
         info = self.entities.get(eid)
         if info is None or info.gameid == 0:
-            gwlog.warnf("dispatcher %d: drop %s for unknown entity %s", self.dispid, msgtype, eid)
+            if info is None:
+                info = self._entity(eid)
+            if eid not in self._unrouted:
+                self._unrouted[eid] = (
+                    now + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
+            if not info.push_pending(msgtype, packet):
+                gwlog.warnf(
+                    "dispatcher %d: unrouted-entity buffer overflow for %s "
+                    "(msgtype %s dropped)", self.dispid, eid, msgtype)
             return
         if info.blocked(now):
             if not info.push_pending(msgtype, packet):
@@ -292,27 +509,29 @@ class DispatcherService:
                 gi.dispatch(msgtype, packet, now)
 
     def _broadcast_gates(self, msgtype: int, packet: Packet) -> None:
-        for gp in self.gates.values():
-            gp.send(msgtype, packet)
+        now = self._now()
+        for gt in self.gates.values():
+            gt.dispatch(msgtype, packet, now)
 
     # --- message handling ----------------------------------------------------
 
     def _handle(self, proxy: GoWorldConnection, msgtype: int, packet: Packet) -> None:
         if is_gate_redirect(msgtype):
             # Payload starts [u16 gateid][clientid...]; route on gateid
-            # (DispatcherService.go:841-844).
+            # (DispatcherService.go:841-844). A gate in its reconnect-grace
+            # window buffers; an unknown gateid drops (as the reference).
             gateid = packet.read_uint16()
             packet.set_read_pos(0)
-            gp = self.gates.get(gateid)
-            if gp is not None:
-                gp.send(msgtype, packet)
+            gt = self.gates.get(gateid)
+            if gt is not None:
+                gt.dispatch(msgtype, packet, self._now())
             return
         if msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
             gateid = packet.read_uint16()
             packet.set_read_pos(0)
-            gp = self.gates.get(gateid)
-            if gp is not None:
-                gp.send(msgtype, packet)
+            gt = self.gates.get(gateid)
+            if gt is not None:
+                gt.dispatch(msgtype, packet, self._now())
             return
         if msgtype == MsgType.CALL_FILTERED_CLIENTS:
             self._broadcast_gates(msgtype, packet)
@@ -358,6 +577,7 @@ class DispatcherService:
         gi.is_banned_boot = is_ban_boot
         self._proxy_games[proxy] = gameid
         self._lbc.update(gameid, 0.0)
+        self._track_peer_gauge(f"game{gameid}")
 
         # Reconnect reconciliation: reject entities homed elsewhere
         # (DispatcherService.go:376-398).
@@ -398,8 +618,11 @@ class DispatcherService:
         gateid = packet.read_uint16()
         if not self._check_proto_version(proxy, packet, f"gate {gateid}"):
             return
-        self.gates[gateid] = proxy
+        gt = self._gate(gateid)
+        gt.proxy = proxy
         self._proxy_gates[proxy] = gateid
+        self._track_peer_gauge(f"gate{gateid}")
+        gt.unblock_and_flush()  # reconnect within the grace window
         self._check_deployment_ready()
         gwlog.infof("dispatcher %d: gate %d connected", self.dispid, gateid)
 
@@ -408,12 +631,13 @@ class DispatcherService:
         if self.deployment_ready:
             return
         n_games = sum(1 for g in self.games.values() if g.connected)
-        if n_games >= self.desired_games and len(self.gates) >= self.desired_gates:
+        n_gates = sum(1 for g in self.gates.values() if g.connected)
+        if n_games >= self.desired_games and n_gates >= self.desired_gates:
             self.deployment_ready = True
             p = Packet()
             self._broadcast_games(MsgType.NOTIFY_DEPLOYMENT_READY, p)
             gwlog.infof("dispatcher %d: deployment ready (%d games, %d gates)",
-                        self.dispid, n_games, len(self.gates))
+                        self.dispid, n_games, n_gates)
 
     # --- entity table ---------------------------------------------------------
 
@@ -604,6 +828,9 @@ class DispatcherService:
 
     # --- load balance / freeze ------------------------------------------------
 
+    def _handle_heartbeat(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Liveness only: the recv task already refreshed last-seen."""
+
     def _handle_game_lbc_info(self, proxy: GoWorldConnection, packet: Packet) -> None:
         cpu = packet.read_float32()
         gameid = self._gameid_of(proxy)
@@ -637,18 +864,40 @@ class DispatcherService:
             if gi.proxy is not proxy:
                 return  # stale disconnect: the game already reconnected
             gi.proxy = None
+            self._untrack_peer_gauge(f"game{gameid}")
             if gi.blocked(self._now()):
                 gwlog.infof("dispatcher %d: game %d down while frozen; buffering", self.dispid, gameid)
                 return
-            self._handle_game_down(gameid)
+            # Unplanned disconnect: a link blip, not necessarily a death.
+            # Buffer like the freeze window (shorter) instead of instantly
+            # wiping routes — the reconnect handshake flushes; the sweep
+            # declares the game dead when the window lapses. (Deviation:
+            # the reference declares game-down immediately,
+            # DispatcherService.go:592-640.)
+            gi.block_until = (
+                self._now() + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
+            gwlog.warnf(
+                "dispatcher %d: game %d link lost; buffering %.0fs for a "
+                "reconnect", self.dispid, gameid,
+                consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
             return
         gateid = self._proxy_gates.pop(proxy, 0)
-        if gateid and self.gates.get(gateid) is proxy:
-            self.gates.pop(gateid, None)
-            p = Packet()
-            p.append_uint16(gateid)
-            self._broadcast_games(MsgType.NOTIFY_GATE_DISCONNECTED, p)
-            gwlog.infof("dispatcher %d: gate %d disconnected", self.dispid, gateid)
+        if gateid:
+            gt = self.gates.get(gateid)
+            if gt is None or gt.proxy is not proxy:
+                return  # stale disconnect: the gate already reconnected
+            gt.proxy = None
+            self._untrack_peer_gauge(f"gate{gateid}")
+            # Same grace window: broadcasting NOTIFY_GATE_DISCONNECTED for
+            # a blip would make every game detach the live gate's client
+            # bindings. _sweep_dead_gates broadcasts when the window
+            # lapses without a reconnect.
+            gt.block_until = (
+                self._now() + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
+            gwlog.warnf(
+                "dispatcher %d: gate %d link lost; buffering %.0fs for a "
+                "reconnect", self.dispid, gateid,
+                consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
 
     def _handle_game_down(self, gameid: int) -> None:
         """Unplanned game death: drop its routing entries, tell the others
@@ -682,4 +931,5 @@ class DispatcherService:
         MsgType.KVREG_REGISTER: _handle_kvreg_register,
         MsgType.GAME_LBC_INFO: _handle_game_lbc_info,
         MsgType.START_FREEZE_GAME: _handle_start_freeze_game,
+        MsgType.HEARTBEAT: _handle_heartbeat,
     }

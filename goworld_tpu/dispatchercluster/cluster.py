@@ -4,24 +4,40 @@ Reference parity: ``engine/dispatchercluster/dispatcherclient/DispatcherConnMgr.
 — each game/gate process keeps one auto-reconnecting connection per
 dispatcher; on (re)connect it re-sends the handshake (SET_GAME_ID carrying
 the live entity list, or SET_GATE_ID), then pumps received packets into the
-process's logic queue via the delegate (:66-88,123-147). Reconnect backoff is
-1 s (consts RECONNECT_INTERVAL).
+process's logic queue via the delegate (:66-88,123-147).
 
-While a connection is down, sends fall back to a buffering stub that drops
-packets (the reference drops to dead dispatchers too; state re-syncs on the
-reconnect handshake).
+Resilience deviations from the reference (PR 3 — the reference drops
+packets to dead dispatchers and reconnects on a fixed 1 s interval):
+
+- While a link is down, sends land in a **byte-capped replay ring**
+  (``[cluster] down_buffer_bytes``; drop-OLDEST on overflow, counted on
+  ``cluster_dropped_packets_total{reason}``) and are replayed on the wire
+  right after the reconnect handshake — per-link FIFO order is preserved,
+  so a dispatcher restart is lossless up to the byte cap.
+- Reconnects back off exponentially with **full jitter** (base
+  ``RECONNECT_INTERVAL``, capped at ``[cluster] reconnect_max_interval``)
+  instead of hammering a dead address at 1 Hz from every process at once.
+- A **liveness watchdog** sends a HEARTBEAT msgtype on idle links (every
+  ``peer_heartbeat_timeout / 3``) and hard-aborts a link silent past
+  ``[cluster] peer_heartbeat_timeout`` — a half-open TCP connection (peer
+  paused, NAT dropped, cable pulled) converts into the reconnect path
+  instead of stalling until the OS gives up.
 """
 
 from __future__ import annotations
 
 import asyncio
-from typing import Callable, Optional, Sequence
+import collections
+import random
+import time
+from typing import Callable, Deque, Optional, Sequence
 
-from goworld_tpu import consts
-from goworld_tpu.dispatchercluster import DispatcherClusterBase, _NULL_SENDER
+from goworld_tpu import consts, telemetry
+from goworld_tpu.dispatchercluster import DispatcherClusterBase
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import GoWorldConnection
+from goworld_tpu.proto.msgtypes import MsgType
 from goworld_tpu.utils import gwlog
 
 # Delegate signature: (dispatcher_index, msgtype, packet) — must be fast/non-blocking.
@@ -34,6 +50,87 @@ PacketHandler = Callable[[int, int, Packet], None]
 # restore after it migrated (its REAL_MIGRATE only updated the owner).
 Handshaker = Callable[[int, GoWorldConnection], None]
 
+# Process-wide counters (one series per reason, not per link — links are
+# few but long-lived metrics hygiene matches net_packets_total): "overflow"
+# = ring evicted its oldest packet at the byte cap, "oversize" = a single
+# packet larger than the whole cap, "disabled" = down_buffer_bytes is 0
+# (legacy drop-on-down), "stopped" = packets still buffered when the
+# process shut the link down for good.
+_DROPPED = telemetry.counter(
+    "cluster_dropped_packets_total",
+    "Packets to a down dispatcher dropped instead of buffered/replayed.",
+    ("reason",))
+_REPLAYED = telemetry.counter(
+    "cluster_replayed_packets_total",
+    "Buffered packets replayed onto a reconnected dispatcher link.")
+_RECONNECTS = telemetry.counter(
+    "cluster_reconnects_total",
+    "Completed dispatcher-link reconnect handshakes (beyond the first).")
+_HB_KILLS = telemetry.counter(
+    "cluster_link_heartbeat_kills_total",
+    "Dispatcher links aborted for silence past peer_heartbeat_timeout.")
+
+
+class _ReplayRing:
+    """Byte-capped FIFO of (msgtype, payload) awaiting a reconnect.
+
+    Drop-OLDEST on overflow: the freshest state (position syncs, latest
+    attr changes) survives, and the ring can never stall a reconnect — a
+    flush is at most ``cap`` bytes."""
+
+    __slots__ = ("cap", "nbytes", "_buf")
+
+    def __init__(self, cap: int) -> None:
+        self.cap = cap
+        self.nbytes = 0
+        self._buf: Deque[tuple[int, bytes]] = collections.deque()
+
+    def __len__(self) -> int:
+        return len(self._buf)
+
+    def push(self, msgtype: int, payload: bytes) -> None:
+        if self.cap <= 0:
+            _DROPPED.labels("disabled").inc()
+            return
+        if len(payload) > self.cap:
+            _DROPPED.labels("oversize").inc()
+            return
+        self._buf.append((msgtype, payload))
+        self.nbytes += len(payload)
+        while self.nbytes > self.cap:
+            _, old = self._buf.popleft()
+            self.nbytes -= len(old)
+            _DROPPED.labels("overflow").inc()
+
+    def drain(self) -> Deque[tuple[int, bytes]]:
+        buf = self._buf
+        self._buf = collections.deque()
+        self.nbytes = 0
+        return buf
+
+
+class _RingConn:
+    """PacketConnection stand-in that captures typed sends into the ring.
+
+    Wrapping it in a GoWorldConnection gives the full send_* surface for
+    free, so the buffering sender stays layout-identical to a live link
+    (the wire counters in proto/conn.py count a packet exactly once, at
+    buffer time — the replay writes at the PacketConnection layer)."""
+
+    closed = False
+
+    def __init__(self, ring: _ReplayRing) -> None:
+        self._ring = ring
+
+    def send_packet(self, msgtype: int, packet: Packet) -> None:
+        self._ring.push(msgtype, packet.payload)
+
+    def flush(self) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
 
 class DispatcherConnMgr:
     """Managed connection to one dispatcher with auto-reconnect."""
@@ -45,39 +142,139 @@ class DispatcherConnMgr:
         handshake: Handshaker,
         on_packet: PacketHandler,
         on_disconnect: Optional[Callable[[int], None]] = None,
+        *,
+        down_buffer_bytes: Optional[int] = None,
+        peer_heartbeat_timeout: Optional[float] = None,
+        wait_connected_timeout: Optional[float] = None,
+        reconnect_max_interval: Optional[float] = None,
     ) -> None:
         self.index = index
         self.addr = addr
         self._handshake = handshake
         self._on_packet = on_packet
         self._on_disconnect = on_disconnect
+        self.down_buffer_bytes = (
+            consts.CLUSTER_DOWN_BUFFER_BYTES
+            if down_buffer_bytes is None else down_buffer_bytes)
+        self.peer_heartbeat_timeout = (
+            consts.CLUSTER_PEER_HEARTBEAT_TIMEOUT
+            if peer_heartbeat_timeout is None else peer_heartbeat_timeout)
+        self.wait_connected_timeout = (
+            consts.CLUSTER_WAIT_CONNECTED_TIMEOUT
+            if wait_connected_timeout is None else wait_connected_timeout)
+        self.reconnect_max_interval = (
+            consts.RECONNECT_INTERVAL_MAX
+            if reconnect_max_interval is None else reconnect_max_interval)
         self.proxy: Optional[GoWorldConnection] = None
+        self.ring = _ReplayRing(self.down_buffer_bytes)
+        self._buffer_sender = GoWorldConnection(_RingConn(self.ring))
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self._connected_event = asyncio.Event()
+        self._connect_count = 0
+        self._last_recv = 0.0
+
+    @property
+    def sender(self) -> GoWorldConnection:
+        """The live link, or the ring-backed buffering sender while down."""
+        proxy = self.proxy
+        return proxy if proxy is not None else self._buffer_sender
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
 
-    async def wait_connected(self, timeout: float = 10.0) -> None:
-        await asyncio.wait_for(self._connected_event.wait(), timeout)
+    async def wait_connected(self, timeout: Optional[float] = None) -> None:
+        t = self.wait_connected_timeout if timeout is None else timeout
+        try:
+            await asyncio.wait_for(self._connected_event.wait(), t)
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"dispatcher {self.index} at {self.addr[0]}:{self.addr[1]} "
+                f"not connected after {t:.1f}s (reconnect keeps retrying in "
+                f"the background)"
+            ) from None
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff with FULL jitter: uniform(0, min(cap,
+        base * 2^attempt)) — spreads the post-restart thundering herd of
+        every game/gate reconnecting at once."""
+        ceiling = min(
+            self.reconnect_max_interval,
+            consts.RECONNECT_INTERVAL * (2.0 ** min(attempt, 16)),
+        )
+        return random.uniform(0, ceiling)
+
+    def _flush_ring(self, proxy: GoWorldConnection) -> None:
+        """Replay buffered sends right after the reconnect handshake, in
+        FIFO order, at the PacketConnection layer (already counted on the
+        wire totals when they entered the ring)."""
+        buf = self.ring.drain()
+        if not buf:
+            return
+        n, nbytes = len(buf), 0
+        for msgtype, payload in buf:
+            nbytes += len(payload)
+            proxy.conn.send_packet(msgtype, Packet(payload))
+        _REPLAYED.inc(n)
+        gwlog.infof(
+            "dispatcher conn %d: replayed %d buffered packets (%d bytes) "
+            "after reconnect", self.index, n, nbytes)
+
+    async def _heartbeat_loop(self, proxy: GoWorldConnection) -> None:
+        """Send HEARTBEAT on an idle link; abort a link silent past the
+        deadline so the recv pump converts it into the reconnect path."""
+        timeout = self.peer_heartbeat_timeout
+        interval = max(0.05, timeout / 3.0)
+        mark = proxy.conn.sent_packets
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            if now - self._last_recv > timeout:
+                gwlog.warnf(
+                    "dispatcher conn %d: peer silent for %.1fs "
+                    "(> %.1fs heartbeat deadline); aborting half-open link",
+                    self.index, now - self._last_recv, timeout)
+                _HB_KILLS.inc()
+                proxy.conn.abort()
+                return
+            if proxy.conn.sent_packets == mark:
+                proxy.send_cluster_heartbeat()
+            mark = proxy.conn.sent_packets
 
     async def _run(self) -> None:
-        """Connect → handshake → recv pump; repeat forever with backoff
-        (DispatcherConnMgr.go:66-147)."""
+        """Connect → handshake → ring replay → recv pump; repeat forever
+        with jittered backoff (DispatcherConnMgr.go:66-147)."""
+        attempt = 0
         while not self._stopped:
             try:
                 reader, writer = await asyncio.open_connection(*self.addr)
             except OSError:
-                await asyncio.sleep(consts.RECONNECT_INTERVAL)
+                await asyncio.sleep(self._backoff_delay(attempt))
+                attempt += 1
                 continue
             proxy = GoWorldConnection(PacketConnection(reader, writer))
-            self.proxy = proxy
+            hb_task: Optional[asyncio.Task] = None
             try:
                 self._handshake(self.index, proxy)
+                # Publish the live proxy only after the handshake is queued
+                # and the ring is replayed behind it, so no concurrent send
+                # can overtake either.
+                self._flush_ring(proxy)
+                self.proxy = proxy
                 self._connected_event.set()
+                attempt = 0
+                self._connect_count += 1
+                if self._connect_count > 1:
+                    _RECONNECTS.inc()
+                self._last_recv = time.monotonic()
+                if self.peer_heartbeat_timeout > 0:
+                    hb_task = asyncio.get_running_loop().create_task(
+                        self._heartbeat_loop(proxy))
                 while True:
                     msgtype, packet = await proxy.recv()
+                    self._last_recv = time.monotonic()
+                    if msgtype == MsgType.HEARTBEAT:
+                        continue  # liveness only; never routed to logic
                     self._on_packet(self.index, msgtype, packet)
             except ConnectionClosed:
                 pass
@@ -86,12 +283,21 @@ class DispatcherConnMgr:
             finally:
                 self.proxy = None
                 self._connected_event.clear()
+                if hb_task is not None:
+                    hb_task.cancel()
+                    try:
+                        await hb_task
+                    except (asyncio.CancelledError, Exception):
+                        pass
                 proxy.close()
                 if self._on_disconnect is not None and not self._stopped:
                     self._on_disconnect(self.index)
             if not self._stopped:
-                gwlog.warnf("dispatcher conn %d lost; reconnecting", self.index)
-                await asyncio.sleep(consts.RECONNECT_INTERVAL)
+                gwlog.warnf(
+                    "dispatcher conn %d lost; reconnecting (sends buffer up "
+                    "to %d bytes)", self.index, self.down_buffer_bytes)
+                await asyncio.sleep(self._backoff_delay(attempt))
+                attempt += 1
 
     async def stop(self) -> None:
         self._stopped = True
@@ -107,12 +313,29 @@ class DispatcherConnMgr:
             except Exception:
                 pass  # peer already gone; nothing to preserve
             self.proxy.close()
+        if len(self.ring):
+            # Buffered sends die with the process — visible, not silent.
+            _DROPPED.labels("stopped").inc(len(self.ring))
+            self.ring.drain()
         if self._task is not None:
             self._task.cancel()
             try:
                 await self._task
             except (asyncio.CancelledError, Exception):
                 pass
+
+
+def cluster_knobs(cfg) -> dict:
+    """ClusterClient kwargs from a GoWorldConfig's [cluster] section."""
+    c = getattr(cfg, "cluster", None)
+    if c is None:
+        return {}
+    return dict(
+        down_buffer_bytes=c.down_buffer_bytes,
+        peer_heartbeat_timeout=c.peer_heartbeat_timeout,
+        wait_connected_timeout=c.wait_connected_timeout,
+        reconnect_max_interval=c.reconnect_max_interval,
+    )
 
 
 class ClusterClient(DispatcherClusterBase):
@@ -124,9 +347,11 @@ class ClusterClient(DispatcherClusterBase):
         handshake: Handshaker,
         on_packet: PacketHandler,
         on_disconnect: Optional[Callable[[int], None]] = None,
+        **knobs,
     ) -> None:
         self._mgrs = [
-            DispatcherConnMgr(i, addr, handshake, on_packet, on_disconnect)
+            DispatcherConnMgr(i, addr, handshake, on_packet, on_disconnect,
+                              **knobs)
             for i, addr in enumerate(addrs)
         ]
 
@@ -134,7 +359,7 @@ class ClusterClient(DispatcherClusterBase):
         for m in self._mgrs:
             m.start()
 
-    async def wait_connected(self, timeout: float = 10.0) -> None:
+    async def wait_connected(self, timeout: Optional[float] = None) -> None:
         await asyncio.gather(*(m.wait_connected(timeout) for m in self._mgrs))
 
     async def stop(self) -> None:
@@ -143,8 +368,10 @@ class ClusterClient(DispatcherClusterBase):
     # --- DispatcherClusterBase ----------------------------------------------
 
     def select(self, idx: int):
-        proxy = self._mgrs[idx].proxy
-        return proxy if proxy is not None else _NULL_SENDER
+        """The live link for dispatcher ``idx``, or its ring-buffering
+        sender while the link is down (drop-on-down is gone: sends survive
+        a dispatcher restart up to the ring's byte cap)."""
+        return self._mgrs[idx].sender
 
     def count(self) -> int:
         return len(self._mgrs)

@@ -154,8 +154,11 @@ class GameService:
             entity_manager.create_nil_space(self.gameid)
 
         addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
+        from goworld_tpu.dispatchercluster.cluster import cluster_knobs
+
         self.cluster = ClusterClient(
-            addrs, self._handshake, self._on_packet, self._on_dispatcher_disconnect
+            addrs, self._handshake, self._on_packet,
+            self._on_dispatcher_disconnect, **cluster_knobs(self.cfg)
         )
         dispatchercluster.set_cluster(self.cluster)
         self.cluster.start()
@@ -264,7 +267,11 @@ class GameService:
         self._queue.put_nowait((msgtype, packet))
 
     def _on_dispatcher_disconnect(self, index: int) -> None:
-        gwlog.warnf("game %d: dispatcher %d disconnected", self.gameid, index)
+        # Sends to the lost dispatcher buffer in its replay ring (byte-
+        # capped) and flush after the reconnect handshake — see
+        # dispatchercluster/cluster.py.
+        gwlog.warnf("game %d: dispatcher %d disconnected; buffering sends "
+                    "until reconnect", self.gameid, index)
 
     def _install_signal_handlers(self) -> None:
         loop = asyncio.get_running_loop()
@@ -584,7 +591,7 @@ class GameService:
                 gwutils.run_panicless(e.destroy)
         for s in list(entity_manager.entities().values()):
             gwutils.run_panicless(s.destroy)
-        storage.wait_clear()
+        storage.drain_for_shutdown()
         post.tick()
         self.run_state = RS_TERMINATED
         self.exit_code = 0

@@ -14,8 +14,11 @@ Reference parity: ``components/gate/GateService.go`` —
   packets are sniffed to track each proxy's owner entity (:262-293).
 - Filter-prop trees per key serve CALL_FILTERED_CLIENTS with 6 comparison
   ops (FilterTree.go:12-102).
-- Heartbeat timeouts kill client proxies (:201-211); losing a dispatcher
-  connection makes the gate exit on purpose (gate.go:138-143).
+- Heartbeat timeouts kill client proxies (:201-211), counted on
+  ``gate_clients_killed_total{reason}`` with ONE aggregated warn per sweep.
+  Deviation: the reference gate exits when a dispatcher connection dies
+  (gate.go:138-143); this gate rides out dispatcher restarts — sends
+  buffer in the per-link replay ring and flush after reconnect.
 
 Transports: TCP (+ optional TLS via asyncio ssl, mirroring the reference's
 crypto/tls wrap, gate.go:97-118), reliable UDP on the same port number
@@ -51,6 +54,20 @@ from goworld_tpu.proto.msgtypes import FilterOp, MsgType, is_gate_redirect
 from goworld_tpu.utils import gwlog, opmon
 
 _CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
+
+# Client proxies killed by the gate itself (vs. orderly client disconnects):
+# reason="heartbeat" = silent past [gateN] heartbeat_timeout (swept in
+# batches — the sweep logs ONE aggregated warn, so a mass timeout after a
+# network partition cannot flood the log), reason="error" = the recv pump
+# died on a non-clean error. Process-wide series, same churn reasoning as
+# net_packets_total.
+from goworld_tpu import telemetry as _telemetry
+
+_CLIENT_KILLS = _telemetry.counter(
+    "gate_clients_killed_total",
+    "Client proxies killed by the gate, by reason.", ("reason",))
+_KILLS_HEARTBEAT = _CLIENT_KILLS.labels("heartbeat")
+_KILLS_ERROR = _CLIENT_KILLS.labels("error")
 
 
 class ClientProxy:
@@ -126,8 +143,11 @@ class GateService:
 
     async def start(self) -> None:
         addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
+        from goworld_tpu.dispatchercluster.cluster import cluster_knobs
+
         self.cluster = ClusterClient(
-            addrs, self._handshake, self._on_dispatcher_packet, self._on_dispatcher_disconnect
+            addrs, self._handshake, self._on_dispatcher_packet,
+            self._on_dispatcher_disconnect, **cluster_knobs(self.cfg)
         )
         self.cluster.start()
 
@@ -227,11 +247,14 @@ class GateService:
         proxy.send_set_gate_id(self.gateid)
 
     def _on_dispatcher_disconnect(self, index: int) -> None:
-        # The reference gate exits when its dispatcher connection dies
-        # (gate.go:138-143); the supervisor restarts it.
-        gwlog.errorf("gate %d: dispatcher %d disconnected, quitting", self.gateid, index)
-        self.exit_code = 1
-        self._stopped.set()
+        # Deliberate deviation from the reference, which EXITS the whole
+        # gate (dropping every connected client) when one dispatcher link
+        # dies (gate.go:138-143). With the replay ring + reconnect loop
+        # (dispatchercluster/cluster.py) the gate now rides out dispatcher
+        # restarts: sends buffer up to [cluster] down_buffer_bytes and
+        # replay after the reconnect handshake, and clients never notice.
+        gwlog.warnf("gate %d: dispatcher %d disconnected; buffering sends "
+                    "until reconnect", self.gateid, index)
 
     # --- client connections (GateService.go:125-199) ------------------------
 
@@ -309,6 +332,10 @@ class GateService:
                 self._queue.put_nowait(("packet", cp, msgtype, packet))
         except ConnectionClosed:
             pass
+        except Exception:
+            _KILLS_ERROR.inc()
+            gwlog.trace_error("gate %d: client %s recv pump error; killing",
+                              self.gateid, cp.clientid)
         finally:
             conn.close()
             self._queue.put_nowait(("disconnect", cp, 0, None))
@@ -394,10 +421,20 @@ class GateService:
         timeout = self.gate_cfg.heartbeat_timeout
         if timeout <= 0:
             return
+        killed: list[str] = []
         for cp in list(self.clients.values()):
             if now - cp.heartbeat_time > timeout:
-                gwlog.warnf("gate %d: client %s heartbeat timeout", self.gateid, cp.clientid)
+                killed.append(cp.clientid)
                 cp.close()  # recv task will enqueue the disconnect
+        if killed:
+            _KILLS_HEARTBEAT.inc(len(killed))
+            # One aggregated warn per sweep: a mass timeout (network
+            # partition upstream of thousands of clients) must not emit
+            # one log line per client.
+            gwlog.warnf(
+                "gate %d: killed %d client(s) past the %.0fs heartbeat "
+                "timeout (e.g. %s)", self.gateid, len(killed), timeout,
+                ", ".join(killed[:3]))
 
     # --- client → server (GateService.go:245-248,398-425) -------------------
 

@@ -75,6 +75,10 @@ class PacketConnection:
         self._closed = False
         self._compress = 0  # 0 off | 1 zlib | 2 snappy (native.pack modes)
         self.dropped = 0  # packets discarded because the conn was closed
+        # Monotonic count of packets queued for send: the cluster-link
+        # heartbeat layer compares it across intervals to detect idle
+        # links (an int increment — no clock read on the send hot path).
+        self.sent_packets = 0
         # Batched recv: raw bytes accumulate here and whole chunks are
         # deframed in one native.split call (C when available) — one await
         # + one parse per burst instead of two awaits per packet.
@@ -117,6 +121,7 @@ class PacketConnection:
             _COMPRESS_THRESHOLD, consts.MAX_PACKET_SIZE,
         )
         self._pending.append(buf)
+        self.sent_packets += 1
         if self._corked:
             return  # uncork() flushes the whole tick's scatter list at once
         if self._flush_task is None or self._flush_task.done():
@@ -210,6 +215,21 @@ class PacketConnection:
             self._writer.close()
         except Exception:
             pass
+
+    def abort(self) -> None:
+        """Hard-kill the transport: discard buffered bytes and reset the
+        connection (no FIN handshake). Used by the liveness watchdog to
+        convert a half-open link into an immediate reconnect, and by the
+        chaos harness to model a crashed peer (clean close would let the
+        remote distinguish an orderly shutdown)."""
+        self._closed = True
+        try:
+            self._writer.transport.abort()
+        except Exception:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
 
     @property
     def closed(self) -> bool:

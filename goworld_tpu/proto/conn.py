@@ -357,6 +357,11 @@ class GoWorldConnection:
     def send_notify_deployment_ready(self) -> None:
         self.send(MsgType.NOTIFY_DEPLOYMENT_READY, Packet())
 
+    def send_cluster_heartbeat(self) -> None:
+        """Cluster-link liveness probe (game/gate↔dispatcher, both
+        directions); consumed at the recv seam, never routed."""
+        self.send(MsgType.HEARTBEAT, Packet())
+
     def send_start_freeze_game(self) -> None:
         self.send(MsgType.START_FREEZE_GAME, Packet())
 

@@ -20,7 +20,10 @@ import enum
 # change (e.g. the round-3 migrate-nonce addition) so a mixed-version
 # dispatcher/game pair — mid rolling upgrade, or a dispatcher not restarted
 # during `reload` — fails loudly at connect instead of mis-framing packets.
-PROTO_VERSION = 2
+# v3: cluster-link HEARTBEAT + liveness kills — a v2 peer would neither
+# send heartbeats nor expect them, so a v3 end would kill its (healthy)
+# idle links; fail the mixed pair at the handshake instead.
+PROTO_VERSION = 3
 
 
 class MsgType(enum.IntEnum):
@@ -52,6 +55,11 @@ class MsgType(enum.IntEnum):
     START_FREEZE_GAME_ACK = 25
     KVREG_REGISTER = 26
     GAME_LBC_INFO = 27
+    # Cluster-link liveness probe (no reference analog — GoWorld has
+    # heartbeats only on gate↔client): sent on idle game/gate↔dispatcher
+    # links by BOTH ends, swallowed at the recv seam (never queued to
+    # logic); its only effect is refreshing the peer's last-seen clock.
+    HEARTBEAT = 28
 
     # --- redirected to client via gate (proto.go:85-114) -------------------
     CREATE_ENTITY_ON_CLIENT = 1001

@@ -1,0 +1,117 @@
+"""Chaos scenarios as tier-1 gates (ISSUE 3 acceptance).
+
+Every test drives a REAL in-process cluster (N dispatchers + game + gate
+over localhost TCP, strict protocol bots) through goworld_tpu.chaos and
+asserts the scenario's own invariants: zero bot errors, zero entity loss,
+recovery within the deadline. The short scenarios run in default tier-1
+(each a few seconds); the full combined soak is marked ``slow``.
+
+Run just these with ``pytest -m chaos``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from goworld_tpu.chaos import (
+    ChaosCluster,
+    scenario_dispatcher_restart,
+    scenario_paused_dispatcher,
+    scenario_severed_link,
+    scenario_storage_outage,
+)
+
+pytestmark = pytest.mark.chaos
+
+# Fast-recovery knobs shared by the tier-1 scenarios: aggressive heartbeat
+# + reconnect so each test stays in the seconds range, and a storage
+# circuit tuned to open within ~0.2 s of a dead backend.
+FAST_STORAGE = dict(
+    retry_base_interval=0.05, retry_max_interval=0.2,
+    circuit_failure_threshold=3, circuit_cooldown=0.3,
+)
+
+
+def _run(scenario_fn, n_dispatchers=2, n_bots=12, **cluster_kw):
+    async def run():
+        cluster = ChaosCluster(
+            cluster_kw.pop("run_dir"), n_dispatchers=n_dispatchers,
+            n_bots=n_bots, storage_knobs=FAST_STORAGE, **cluster_kw)
+        await cluster.start()
+        try:
+            return await scenario_fn(cluster)
+        finally:
+            await cluster.stop()
+
+    return asyncio.run(run())
+
+
+def test_dispatcher_kill_restart_smoke(tmp_path):
+    """THE acceptance scenario: kill + restart one dispatcher (of 2) under
+    12 strict bots — zero bot errors, zero dropped-packet increments at
+    the default down_buffer_bytes, zero entity loss, pings issued DURING
+    the outage delivered after the reconnect replay."""
+    r = _run(scenario_dispatcher_restart, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["dropped"] == 0
+    assert r["recovery_s"] < 10.0
+
+
+def test_severed_link_recovers(tmp_path):
+    """A game↔dispatcher socket aborted mid-tick (RST, not clean close)
+    reconnects and replays within the deadline."""
+    r = _run(scenario_severed_link, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["dropped"] == 0
+    assert r["recovery_s"] < 10.0
+
+
+def test_paused_dispatcher_liveness_kill(tmp_path):
+    """A dispatcher stalled past the heartbeat deadline with sockets OPEN
+    (the half-open case liveness heartbeats exist for): peers must detect
+    the silence and abort the links, and traffic must recover on resume."""
+    r = _run(scenario_paused_dispatcher, run_dir=str(tmp_path),
+             peer_heartbeat_timeout=0.6)
+    assert r["bot_errors"] == 0
+    # Detection must land near the configured deadline, not the OS's
+    # multi-minute TCP timeout.
+    assert r["detect_s"] < 5.0
+
+
+def test_storage_outage_circuit(tmp_path):
+    """A storage backend failing writes opens the circuit (worker stays
+    live: reads still served), and every deferred save lands once the
+    backend heals."""
+    r = _run(scenario_storage_outage, run_dir=str(tmp_path))
+    assert r["lost_saves"] == 0
+    assert r["recovery_s"] < 10.0
+
+
+@pytest.mark.slow
+def test_full_chaos_soak(tmp_path):
+    """All scenarios back to back over ONE cluster — state carried across
+    faults (the bench --chaos shape, with more dispatchers)."""
+
+    async def run():
+        cluster = ChaosCluster(str(tmp_path), n_dispatchers=3, n_bots=16,
+                               storage_knobs=FAST_STORAGE)
+        await cluster.start()
+        try:
+            results = [
+                await scenario_dispatcher_restart(cluster, victim=1),
+                await scenario_severed_link(cluster, victim=2),
+                await scenario_paused_dispatcher(cluster, victim=0),
+                await scenario_storage_outage(cluster),
+                # A second restart of a DIFFERENT dispatcher after all the
+                # other faults: recovery must not depend on fresh state.
+                await scenario_dispatcher_restart(cluster, victim=0),
+            ]
+        finally:
+            await cluster.stop()
+        return results
+
+    results = asyncio.run(run())
+    assert len(results) == 5
+    assert all(r.get("bot_errors", 0) == 0 for r in results)

@@ -48,6 +48,11 @@ SAMPLE = textwrap.dedent(
     [storage]
     type = filesystem
     directory = /tmp/teststorage
+    circuit_failure_threshold = 4
+    circuit_cooldown = 2.5
+    retry_base_interval = 0.5
+    retry_max_interval = 20
+    deferred_bytes_cap = 1048576
 
     [kvdb]
     type = filesystem
@@ -56,6 +61,12 @@ SAMPLE = textwrap.dedent(
     [aoi]
     backend = xzlist
     max_entities = 4096
+
+    [cluster]
+    down_buffer_bytes = 4194304
+    peer_heartbeat_timeout = 6
+    wait_connected_timeout = 20
+    reconnect_max_interval = 8
     """
 )
 
@@ -149,6 +160,38 @@ def test_per_game_aoi_platform(cfg, tmp_path):
             read_config.get()
     finally:
         read_config.set_config_file(None)
+
+
+def test_cluster_and_storage_resilience_knobs(cfg):
+    """[cluster] link-resilience and [storage] circuit knobs parse (PR 3)."""
+    assert cfg.cluster.down_buffer_bytes == 4 * 1024 * 1024
+    assert cfg.cluster.peer_heartbeat_timeout == 6.0
+    assert cfg.cluster.wait_connected_timeout == 20.0
+    assert cfg.cluster.reconnect_max_interval == 8.0
+    assert cfg.storage.circuit_failure_threshold == 4
+    assert cfg.storage.circuit_cooldown == 2.5
+    assert cfg.storage.retry_base_interval == 0.5
+    assert cfg.storage.retry_max_interval == 20.0
+    assert cfg.storage.deferred_bytes_cap == 1048576
+
+
+def test_cluster_knob_validation(tmp_path):
+    """Nonsense resilience knobs fail loudly at load, not at 3 am."""
+    for old, bad in (
+        ("wait_connected_timeout = 20", "wait_connected_timeout = 0"),
+        ("down_buffer_bytes = 4194304", "down_buffer_bytes = -1"),
+        ("circuit_failure_threshold = 4", "circuit_failure_threshold = 0"),
+        ("retry_max_interval = 20", "retry_max_interval = 0.1"),
+    ):
+        assert old in SAMPLE
+        p = tmp_path / "bad.ini"
+        p.write_text(SAMPLE.replace(old, bad))
+        read_config.set_config_file(str(p))
+        try:
+            with pytest.raises(ValueError):
+                read_config.get()
+        finally:
+            read_config.set_config_file(None)
 
 
 def test_duplicate_addr_rejected(tmp_path):

@@ -6,6 +6,8 @@ multi-process behavior exercised over real sockets on localhost.
 
 import asyncio
 
+import pytest
+
 from goworld_tpu.common import gen_client_id, gen_entity_id
 from goworld_tpu.dispatcher import DispatcherService
 from goworld_tpu.dispatchercluster.cluster import ClusterClient
@@ -289,11 +291,17 @@ def test_dispatcher_restart_recovery():
     asyncio.run(run())
 
 
-def test_unplanned_game_death_cleanup():
+def test_unplanned_game_death_cleanup(monkeypatch):
     """Failure detection (SURVEY.md §5.3, DispatcherService.go:592-640): a
-    game dying WITHOUT the freeze handshake loses its routing entries, the
-    survivors get NOTIFY_GAME_DISCONNECTED, and calls to the dead game's
-    entities are dropped instead of buffered forever."""
+    game dying WITHOUT the freeze handshake gets a short reconnect-grace
+    window (PR 3 deviation — a link blip is steady-state with buffered
+    links), after which it loses its routing entries, the survivors get
+    NOTIFY_GAME_DISCONNECTED, and calls to the dead game's entities are
+    dropped (buffered briefly, never delivered) instead of buffered
+    forever."""
+    from goworld_tpu import consts
+
+    monkeypatch.setattr(consts, "DISPATCHER_RECONNECT_BUFFER_WINDOW", 0.3)
 
     async def run():
         disp = DispatcherService(1, desired_games=2, desired_gates=0)
@@ -361,6 +369,165 @@ def test_entity_pending_queue_bound_drops_overflow(monkeypatch):
             mt == MsgType.CALL_ENTITY_METHOD for mt, _ in game2.received
         ), "overflow packets beyond the bound must be dropped"
         await _teardown(disp, c1, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_sweep_dead_frozen_games(monkeypatch):
+    """A game that dies WHILE FROZEN and never comes back (the reload
+    window lapses): the sweep must clean it up like any dead game —
+    buffered packets dropped, routes erased, NOTIFY_GAME_DISCONNECTED to
+    the survivors (dispatcher/service.py _sweep_dead_frozen_games)."""
+    from goworld_tpu import consts
+
+    monkeypatch.setattr(consts, "DISPATCHER_FREEZE_GAME_TIMEOUT", 0.3)
+
+    async def run():
+        disp, (c1, game1), (c2, game2), (cg, gate1) = await _cluster()
+        eid = gen_entity_id()
+        c1.select(0).send_notify_create_entity(eid)
+        c1.select(0).send_start_freeze_game()
+        await game1.expect(MsgType.START_FREEZE_GAME_ACK)
+        await c1.stop()  # the game dies mid-reload and never restores
+        # Calls buffer while the freeze window holds...
+        c2.select(0).send_call_entity_method(eid, "WhileFrozen", ())
+        await asyncio.sleep(0.05)
+        assert disp.games[1].pending, "freeze window should buffer"
+        # ...until the window lapses: swept like an unplanned game death.
+        await game2.expect(MsgType.NOTIFY_GAME_DISCONNECTED, timeout=10)
+        assert eid not in disp.entities
+        assert not disp.games[1].pending
+        await _teardown(disp, c2, cg)
+
+    asyncio.run(run())
+
+
+def test_dispatcher_kills_silent_peer(monkeypatch):
+    """Dispatcher-side liveness: a registered peer that stops sending
+    (half-open link — here a raw socket that handshakes then goes mute,
+    with client-side heartbeats suppressed) is closed once silent past
+    peer_heartbeat_timeout, converting the stall into a normal disconnect."""
+
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=0,
+                                 peer_heartbeat_timeout=0.4)
+        await disp.start()
+        import asyncio as aio
+
+        from goworld_tpu.netutil.packet_conn import PacketConnection
+        from goworld_tpu.proto.conn import GoWorldConnection
+
+        reader, writer = await aio.open_connection("127.0.0.1", disp.port)
+        proxy = GoWorldConnection(PacketConnection(reader, writer))
+        proxy.send_set_game_id(1, False, False, False, [])
+        for _ in range(200):
+            if disp.games.get(1) is not None and disp.games[1].connected:
+                break
+            await aio.sleep(0.01)
+        assert disp.games[1].connected
+        # Mute peer: never sends again. The dispatcher must close the link
+        # within ~2 heartbeat intervals, NOT wait on the OS.
+        for _ in range(500):
+            if not disp.games[1].connected:
+                break
+            await aio.sleep(0.01)
+        assert not disp.games[1].connected, (
+            "silent peer was never killed by the heartbeat sweep")
+        proxy.close()
+        await disp.stop()
+
+    asyncio.run(run())
+
+
+def test_replay_ring_buffers_and_replays_across_restart(monkeypatch):
+    """The drop-on-down stub is gone: entity calls sent WHILE the
+    dispatcher is down buffer in the replay ring and land, in order,
+    after the reconnect handshake — and the drop counter does not move."""
+    from goworld_tpu.chaos import dropped_packet_count
+
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=0)
+        await disp.start()
+        port = disp.port
+        eid = gen_entity_id()
+        game1 = FakePeer()
+        c1 = make_game_cluster(("127.0.0.1", port), 1, game1,
+                               entity_ids=[eid])
+        c1.start()
+        await c1.wait_connected()
+        await game1.expect(MsgType.SET_GAME_ID_ACK)
+        drops0 = dropped_packet_count()
+
+        await disp.stop()
+        await asyncio.sleep(0.1)
+        # Sends while DOWN: ring-buffered, not dropped.
+        for i in range(5):
+            c1.select(0).send_call_entity_method(eid, f"Buffered{i}", ())
+        assert len(c1._mgrs[0].ring) >= 5
+
+        disp2 = DispatcherService(1, desired_games=1, desired_gates=0)
+        for _ in range(50):
+            try:
+                await disp2.start(port=port)
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        await game1.expect(MsgType.SET_GAME_ID_ACK, timeout=10)
+        names = []
+        for _ in range(5):
+            pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD, timeout=10)
+            assert pkt.read_entity_id() == eid
+            names.append(pkt.read_varstr())
+        assert names == [f"Buffered{i}" for i in range(5)]
+        assert dropped_packet_count() == drops0
+        await _teardown(disp2, c1)
+
+    asyncio.run(run())
+
+
+def test_replay_ring_overflow_drops_oldest(monkeypatch):
+    """At the byte cap the ring evicts its OLDEST packets (freshest state
+    wins) and counts them on cluster_dropped_packets_total{overflow}."""
+    from goworld_tpu import telemetry
+    from goworld_tpu.dispatchercluster.cluster import _ReplayRing
+
+    ring = _ReplayRing(cap=100)
+    c = telemetry.counter("cluster_dropped_packets_total",
+                          labelnames=("reason",)).labels("overflow")
+    base = c.value
+    for i in range(10):
+        ring.push(MsgType.CALL_ENTITY_METHOD, bytes([i]) * 30)  # 30 B each
+    assert ring.nbytes <= 100
+    assert c.value - base == 7  # 10 pushed, 3 fit under 100 B
+    kept = [payload[0] for _, payload in ring.drain()]
+    assert kept == [7, 8, 9]  # the newest survive
+    # A single packet larger than the whole cap can never be buffered.
+    over = telemetry.counter("cluster_dropped_packets_total",
+                             labelnames=("reason",)).labels("oversize")
+    b0 = over.value
+    ring.push(MsgType.CALL_ENTITY_METHOD, b"x" * 101)
+    assert over.value - b0 == 1 and len(ring) == 0
+
+
+def test_wait_connected_timeout_names_the_dispatcher():
+    """Satellite: the wait_connected timeout is configurable (not the old
+    hardcoded 10.0) and the error names the unreachable dispatcher's
+    index and address."""
+
+    async def run():
+        from goworld_tpu.dispatchercluster.cluster import ClusterClient
+
+        c = ClusterClient(
+            [("127.0.0.1", 1)], lambda i, p: None, lambda i, m, p: None,
+            wait_connected_timeout=0.2)
+        c.start()
+        try:
+            with pytest.raises(TimeoutError) as ei:
+                await c.wait_connected()
+            msg = str(ei.value)
+            assert "dispatcher 0" in msg and "127.0.0.1:1" in msg
+        finally:
+            await c.stop()
 
     asyncio.run(run())
 

@@ -307,12 +307,20 @@ def test_client_disconnect_detaches_entity(clean_entities, tmp_path):
 
 def test_heartbeat_timeout_kills_client(clean_entities, tmp_path):
     async def run():
+        from goworld_tpu import telemetry
+
+        kills = telemetry.counter(
+            "gate_clients_killed_total", labelnames=("reason",)
+        ).labels("heartbeat")
+        base = kills.value
         disp, game, game_task, gate = await start_stack(tmp_path)
         gate.gate_cfg.heartbeat_timeout = 0.3
         bot = ClientBot(name="dead", strict=False, heartbeat_interval=999.0)
         await bot.connect("127.0.0.1", gate.port)
         await bot.wait_player(timeout=10)
         assert await wait_for(lambda: len(gate.clients) == 0, timeout=5.0)
+        # The sweep counts its kills (one aggregated warn, not per-client).
+        assert kills.value - base == 1
         await stop_stack(disp, game, game_task, gate, [bot])
 
     asyncio.run(run())

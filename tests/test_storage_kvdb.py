@@ -374,3 +374,167 @@ def test_network_backend_pagination():
         b.close()
     finally:
         msrv.stop()
+
+
+# --- storage circuit breaker (PR 3: storage/circuit.py + deferred queue) -----
+
+
+def test_circuit_breaker_state_machine():
+    """CLOSED → (K consecutive failures) → OPEN → (cooldown) → HALF_OPEN
+    probe → CLOSED on success / straight back to OPEN on failure."""
+    from goworld_tpu.storage.circuit import CircuitBreaker
+
+    clock = [0.0]
+    b = CircuitBreaker(failure_threshold=3, cooldown=5.0,
+                       clock=lambda: clock[0])
+    assert b.state == CircuitBreaker.CLOSED
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED and b.allow()
+    b.record_failure()  # threshold hit
+    assert b.state == CircuitBreaker.OPEN
+    assert not b.allow()  # cooldown not elapsed
+    clock[0] = 5.0
+    assert b.allow()  # half-open probe admitted
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.record_failure()  # probe failed: reopen immediately, no threshold
+    assert b.state == CircuitBreaker.OPEN
+    clock[0] = 10.0
+    assert b.allow()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+    # A success resets the consecutive count: 2 failures stay closed.
+    b.record_failure()
+    b.record_failure()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+class _FailNWrites:
+    """In-memory backend failing the next N writes."""
+
+    def __init__(self, fail=0):
+        self.fail = fail
+        self.docs = {}
+
+    def write(self, t, e, d):
+        if self.fail > 0:
+            self.fail -= 1
+            raise IOError("injected")
+        self.docs[(t, e)] = d
+
+    def read(self, t, e):
+        return self.docs.get((t, e))
+
+    def exists(self, t, e):
+        return (t, e) in self.docs
+
+    def list_entity_ids(self, t):
+        return sorted(e for (tt, e) in self.docs if tt == t)
+
+
+def _configure_fast_circuit(backend):
+    storage.set_backend(backend)
+    storage._breaker.configure(failure_threshold=2, cooldown=0.2)
+    storage._retry_base = 0.01
+    storage._retry_max = 0.02
+
+
+def test_storage_circuit_opens_and_defers(tmp_path):
+    """A dead backend must NOT wedge the worker: after K consecutive
+    failures the circuit opens, later saves defer (no backend attempts,
+    no sleeps), and the deferred queue flushes IN ORDER once a half-open
+    probe succeeds."""
+    import time as _time
+
+    b = _FailNWrites(fail=100)
+    _configure_fast_circuit(b)
+    try:
+        cb_errs = []
+        storage.save("T", "a" * 16, {"v": 1}, lambda r, e: cb_errs.append(e))
+        assert storage.wait_clear(10)
+        from goworld_tpu.storage.circuit import CircuitBreaker
+
+        assert storage.circuit_state() == CircuitBreaker.OPEN
+        assert storage.deferred_count() == 1
+        # While open: saves defer instantly (worker live, no retry sleeps).
+        t0 = _time.monotonic()
+        for i in range(5):
+            storage.save("T", f"{i:016d}", {"v": i})
+        assert storage.wait_clear(10)
+        assert _time.monotonic() - t0 < 1.0
+        assert storage.deferred_count() == 6
+        assert b.docs == {}  # nothing reached the backend
+        # Backend heals; after the cooldown the next save probes half-open
+        # and drains the whole deferred queue, oldest first.
+        b.fail = 0
+        _time.sleep(0.25)
+        storage.save("T", "z" * 16, {"v": 99})
+        assert storage.wait_clear(10)
+        assert storage.circuit_state() == CircuitBreaker.CLOSED
+        assert storage.deferred_count() == 0
+        assert b.docs[("T", "a" * 16)] == {"v": 1}
+        assert b.docs[("T", "z" * 16)] == {"v": 99}
+        post.tick()
+        assert cb_errs == [None]  # callback fired when the write LANDED
+    finally:
+        storage.set_backend(None)
+
+
+def test_storage_deferred_overflow_drops_oldest(tmp_path):
+    """The deferred queue is byte-capped: overflow drops the OLDEST ops
+    (callbacks get the error) and counts storage_dropped_ops_total."""
+    from goworld_tpu import telemetry
+
+    b = _FailNWrites(fail=100)
+    _configure_fast_circuit(b)
+    old_cap = storage._deferred_cap
+    storage._deferred_cap = 200
+    try:
+        dropped = telemetry.counter(
+            "storage_dropped_ops_total", labelnames=("reason",)
+        ).labels("overflow")
+        base = dropped.value
+        errs = []
+        for i in range(10):  # each op ~90 B of JSON
+            storage.save("T", f"{i:016d}", {"pad": "x" * 64},
+                         lambda r, e, i=i: errs.append((i, e)))
+        assert storage.wait_clear(10)
+        assert dropped.value > base
+        assert storage.deferred_count() < 10
+        post.tick()
+        overflowed = [i for i, e in errs if e is not None]
+        assert overflowed == list(range(len(overflowed)))  # oldest dropped
+    finally:
+        storage._deferred_cap = old_cap
+        storage.set_backend(None)
+
+
+def test_storage_final_flush_on_shutdown(tmp_path):
+    """Terminate path: drain_for_shutdown gives deferred saves one last
+    probe — a healed backend gets the data, a dead one drops it (bounded,
+    counted loss) WITHOUT stalling shutdown on retry sleeps. Plain
+    wait_clear leaves deferred ops alone (they wait on the backend)."""
+    b = _FailNWrites(fail=100)
+    _configure_fast_circuit(b)
+    try:
+        for i in range(3):
+            storage.save("T", f"{i:016d}", {"v": i})
+        assert storage.wait_clear(10)
+        assert storage.deferred_count() == 3  # wait_clear never drops
+        b.fail = 0  # backend healed just before shutdown
+        assert storage.drain_for_shutdown(10)
+        assert storage.deferred_count() == 0
+        assert len(b.docs) == 3
+        # And the dead-backend shutdown: drop, but never hang.
+        b2 = _FailNWrites(fail=100)
+        _configure_fast_circuit(b2)
+        storage.save("T", "d" * 16, {"v": 1})
+        assert storage.wait_clear(10)
+        import time as _time
+
+        t0 = _time.monotonic()
+        assert storage.drain_for_shutdown(10)
+        assert _time.monotonic() - t0 < 1.0  # no retry sleeps at exit
+        assert storage.deferred_count() == 0 and b2.docs == {}
+    finally:
+        storage.set_backend(None)
