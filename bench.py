@@ -453,11 +453,14 @@ FANOUT_CONFIG = {
 }
 
 
-def bench_fanout() -> dict:
+def bench_fanout(trace_sample_rate: int | None = None) -> dict:
     """``bench.py --fanout``: delivered sync records/s at the fixed config
     above, best-of-``windows`` measurement windows over one live cluster.
     Gated against BENCH_FLOOR.json["fanout"] by tier-1
-    (tests/test_telemetry.py::test_fanout_floor_gate)."""
+    (tests/test_telemetry.py::test_fanout_floor_gate).
+    ``trace_sample_rate`` overrides [telemetry] trace_sample_rate for the
+    cluster (None keeps the default 1/1024) — the --trace-overhead mode
+    sweeps it."""
     import asyncio
     import tempfile
 
@@ -473,6 +476,7 @@ def bench_fanout() -> dict:
             GoWorldConfig,
             KVDBConfig,
             StorageConfig,
+            TelemetryConfig,
         )
         from goworld_tpu.dispatcher import DispatcherService
         from goworld_tpu.entity import entity_manager as em
@@ -554,6 +558,9 @@ def bench_fanout() -> dict:
                 type="filesystem", directory=tmp.name + "/es")
             cfg.kvdb = KVDBConfig(
                 type="filesystem", directory=tmp.name + "/kv")
+            if trace_sample_rate is not None:
+                cfg.telemetry = TelemetryConfig(
+                    trace_sample_rate=trace_sample_rate)
             game = GameService(1, cfg, restore=False)
             game_task = asyncio.get_running_loop().create_task(
                 game.run_async())
@@ -641,6 +648,54 @@ def bench_fanout() -> dict:
         "platform": "cpu",
         "floor_file": PINNED_FLOOR_FILE,
     }
+
+
+# --- tracing overhead gate (ISSUE 5) -----------------------------------------
+
+# Sampling denominators swept by --trace-overhead: off, the production
+# default, and trace-everything. "off" is the tier-1-gated point (tracing
+# must be free when off); 1/1 bounds the worst case for debugging sessions.
+TRACE_OVERHEAD_RATES = (0, 1024, 1)
+
+
+def bench_trace_overhead() -> dict:
+    """``bench.py --trace-overhead``: both committed floors measured at
+    each sampling rate. The pinned floor is the pure AOI engine loop
+    (tracing is structurally absent there — it's the control); the fanout
+    floor exercises the real packet path where the trace branch, trailer
+    attach/strip, and span recording live. Tier-1 asserts the rate=0
+    fanout run against BENCH_FLOOR.json within the existing tolerance —
+    no re-baseline permitted for tracing."""
+    from goworld_tpu.telemetry import tracing
+
+    out: dict = {
+        "metric": "trace_overhead_sync_records_per_sec",
+        "unit": "sync-records/sec",
+        "rates": {},
+        "platform": "cpu",
+        "floor_file": PINNED_FLOOR_FILE,
+    }
+    saved = tracing.sample_rate()
+    try:
+        for rate in TRACE_OVERHEAD_RATES:
+            key = "off" if rate == 0 else f"1/{rate}"
+            tracing.configure(sample_rate=rate)
+            pinned = bench_pinned_floor()
+            fan = bench_fanout(trace_sample_rate=rate)
+            out["rates"][key] = {
+                "sample_rate": rate,
+                "pinned_floor": pinned["value"],
+                "fanout": fan["value"],
+                "fanout_runs": fan["runs"],
+            }
+    finally:
+        tracing.configure(sample_rate=saved)
+    off = out["rates"].get("off", {}).get("fanout", 0.0)
+    out["value"] = off  # headline = the must-be-free point
+    full = out["rates"].get("1/1", {}).get("fanout", 0.0)
+    if off:
+        out["full_sampling_cost_pct"] = round(100.0 * (1.0 - full / off), 1)
+    return out
 
 
 # --- chaos: fault-injection suite over a live in-process cluster -------------
@@ -949,6 +1004,8 @@ def main() -> int:
          "fanout_sync_records_per_sec", "sync-records/sec"),
         ("--chaos", bench_chaos,
          "chaos_scenarios_passed", "scenarios"),
+        ("--trace-overhead", bench_trace_overhead,
+         "trace_overhead_sync_records_per_sec", "sync-records/sec"),
     ):
         if flag in sys.argv[1:]:
             # Regression-gate mode: fixed config, CPU, no probe, no

@@ -193,6 +193,33 @@ class AOIConfig:
 
 
 @dataclasses.dataclass
+class TelemetryConfig:
+    """Distributed-tracing / flight-recorder knobs (``[telemetry]``;
+    defaults mirror consts.py — telemetry/tracing.py)."""
+
+    # Head-sampling denominator: 1-in-N ingress events start a trace
+    # (0 disables tracing; 1 traces everything — test/debug only).
+    trace_sample_rate: int = 1024
+    # Finished-span ring size per process (drop-oldest).
+    trace_ring_size: int = 4096
+    # Game ticks busier than this many seconds trigger a flight-recorder
+    # dump (ONE structured WARN + GET /flight); 0 disables the dump.
+    slow_tick_budget: float = 0.1
+    # How many tick records the flight recorder keeps.
+    flight_ring_size: int = 240
+
+
+@dataclasses.dataclass
+class LogConfig:
+    """Process-wide logging knobs (``[log]``)."""
+
+    # "text" = the zap-parity line format (default); "json" = one JSON
+    # object per line with level/ts/source and automatic trace_id
+    # injection inside active trace spans (utils/gwlog.py).
+    format: str = "text"
+
+
+@dataclasses.dataclass
 class DebugConfig:
     debug: bool = False
 
@@ -207,6 +234,8 @@ class GoWorldConfig:
     kvdb: KVDBConfig = dataclasses.field(default_factory=KVDBConfig)
     aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    log: LogConfig = dataclasses.field(default_factory=LogConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
 
 
@@ -377,6 +406,18 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             wait_connected_timeout=float(s.get("wait_connected_timeout", 10.0)),
             reconnect_max_interval=float(s.get("reconnect_max_interval", 15.0)),
         )
+    if cp.has_section("telemetry"):
+        s = cp["telemetry"]
+        cfg.telemetry = TelemetryConfig(
+            trace_sample_rate=int(s.get("trace_sample_rate", 1024)),
+            trace_ring_size=int(s.get("trace_ring_size", 4096)),
+            slow_tick_budget=float(s.get("slow_tick_budget", 0.1)),
+            flight_ring_size=int(s.get("flight_ring_size", 240)),
+        )
+    if cp.has_section("log"):
+        cfg.log = LogConfig(
+            format=cp["log"].get("format", "text").strip().lower(),
+        )
     if cp.has_section("debug"):
         cfg.debug = DebugConfig(debug=cp["debug"].getboolean("debug", False))
 
@@ -522,6 +563,20 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[cluster] wait_connected_timeout must be > 0")
     if cl.reconnect_max_interval <= 0:
         raise ValueError("[cluster] reconnect_max_interval must be > 0")
+    t = cfg.telemetry
+    if t.trace_sample_rate < 0:
+        raise ValueError(
+            "[telemetry] trace_sample_rate must be >= 0 (0 = off, N = 1/N)")
+    if t.trace_ring_size < 1:
+        raise ValueError("[telemetry] trace_ring_size must be >= 1")
+    if t.slow_tick_budget < 0:
+        raise ValueError(
+            "[telemetry] slow_tick_budget must be >= 0 (0 = no slow dumps)")
+    if t.flight_ring_size < 1:
+        raise ValueError("[telemetry] flight_ring_size must be >= 1")
+    if cfg.log.format not in ("text", "json"):
+        raise ValueError(
+            f"[log] format must be text|json, got {cfg.log.format!r}")
     st = cfg.storage
     if st.retry_base_interval <= 0 or st.retry_max_interval <= 0:
         raise ValueError("[storage] retry intervals must be > 0 seconds")

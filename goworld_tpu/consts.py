@@ -72,6 +72,23 @@ CLUSTER_WAIT_CONNECTED_TIMEOUT = 10.0
 # racing the game's re-handshake into a restarted dispatcher).
 DISPATCHER_RECONNECT_BUFFER_WINDOW = 5.0
 
+# --- telemetry / tracing ([telemetry] ini section overrides) -----------------
+# Head-sampling denominator for distributed traces: 1-in-N ingress events
+# (gate client RPC, game timer tick) mint a TraceContext that rides cluster
+# packets as a 17-byte trailer. 0 disables tracing entirely; unsampled
+# traffic is wire-identical either way (telemetry/tracing.py).
+TRACE_SAMPLE_RATE = 1024
+# Finished-span ring per process (drop-oldest, trace_spans_dropped_total).
+TRACE_RING_SIZE = 4096
+# Slow-tick flight recorder: a game tick busier than this many seconds
+# dumps the last FLIGHT_RING_SIZE tick records + the tick's sampled spans
+# as ONE structured WARN (kept on GET /flight). Default 0.1 s ≈ 2x the
+# ~48 ms busy tick of the committed pinned-floor config (BENCH_FLOOR.json:
+# 2048 entities / 42k upd/s) — production 5 ms ticks only ever get near it
+# when something is genuinely wrong (jit recompile, storage stall, GC).
+SLOW_TICK_BUDGET = 0.1
+FLIGHT_RING_SIZE = 240
+
 # --- persistence ------------------------------------------------------------
 DEFAULT_SAVE_INTERVAL = 300.0  # 5 min (read_config.go:28)
 # Save-retry backoff: the reference retries forever at a fixed 1 s

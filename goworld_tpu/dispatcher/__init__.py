@@ -36,8 +36,12 @@ def run(dispid: int | None = None) -> int:
     gwlog.setup(
         level=(args.log or (disp_cfg.log_level if disp_cfg else "info")),
         logfile=(disp_cfg.log_file if disp_cfg else None) or None,
+        fmt=cfg.log.format,
     )
     gwlog.set_source(f"dispatcher{args.dispid}")
+    from goworld_tpu.telemetry import tracing
+
+    tracing.configure_from_config(cfg.telemetry)
 
     async def main() -> int:
         import signal

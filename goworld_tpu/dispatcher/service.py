@@ -32,6 +32,7 @@ from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
 from goworld_tpu.proto.msgtypes import PROTO_VERSION, MsgType, is_gate_redirect
+from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import gwlog
 
 
@@ -182,6 +183,7 @@ class DispatcherService:
         # stay open. pause()/resume().
         self._resume_event = asyncio.Event()
         self._resume_event.set()
+        self._started_at = 0.0
         self.port: int = 0
 
     # --- lifecycle ----------------------------------------------------------
@@ -189,11 +191,43 @@ class DispatcherService:
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
         self._server = await asyncio.start_server(self._on_conn, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self._started_at = time.monotonic()
         self._tasks.append(asyncio.get_running_loop().create_task(self._logic_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(self._tick_loop()))
         self._register_metrics()
+        from goworld_tpu.utils import debug_http
+
+        debug_http.set_health_provider(self._health)
         gwlog.infof("dispatcher %d listening on %s:%d", self.dispid, host, self.port)
         gwlog.infof(consts.DISPATCHER_STARTED_TAG)
+
+    def _health(self) -> dict:
+        """One JSON object for GET /healthz (chaos/ops liveness probes —
+        no /metrics text parsing needed)."""
+        now = time.monotonic()
+
+        def age(proxy) -> Optional[float]:
+            last = self._peer_last_seen.get(proxy)
+            return round(now - last, 3) if last is not None else None
+
+        return {
+            "kind": "dispatcher",
+            "id": self.dispid,
+            "uptime_s": round(now - self._started_at, 3),
+            "deployment_ready": self.deployment_ready,
+            "queue_depth": self._queue.qsize(),
+            "entities_routed": len(self.entities),
+            "games": {
+                str(gid): {"connected": gi.connected,
+                           "last_seen_age_s": age(gi.proxy)}
+                for gid, gi in self.games.items()
+            },
+            "gates": {
+                str(gid): {"connected": gt.connected,
+                           "last_seen_age_s": age(gt.proxy)}
+                for gid, gt in self.gates.items()
+            },
+        }
 
     def _register_metrics(self) -> None:
         """Pull-sampled gauges on /metrics, labeled by dispid. set_function
@@ -270,6 +304,9 @@ class DispatcherService:
                 fam.remove(d, f"gate{gid}")
 
     async def stop(self) -> None:
+        from goworld_tpu.utils import debug_http
+
+        debug_http.clear_health_provider(self._health)
         self._unregister_metrics()
         for t in self._tasks:
             t.cancel()
@@ -297,7 +334,8 @@ class DispatcherService:
     # --- connection handling -------------------------------------------------
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
-        proxy = GoWorldConnection(PacketConnection(reader, writer))
+        proxy = GoWorldConnection(
+            PacketConnection(reader, writer), trace_wire=True)
         self._conns.add(proxy)
         self._peer_last_seen[proxy] = time.monotonic()
         try:
@@ -320,6 +358,18 @@ class DispatcherService:
             try:
                 if msgtype == -1:
                     self._handle_disconnect(proxy)
+                elif packet is not None and packet.trace is not None:
+                    # Sampled packet: the handling span covers queue dwell
+                    # (recv → here, its own child span — THE number the
+                    # paper's routing path hides) + routing, and any
+                    # forward inside re-attaches the trailer downstream.
+                    scope = tracing.continue_from_packet(
+                        packet, "dispatcher.route",
+                        dwell_name="dispatcher.queue_dwell")
+                    scope.args["msgtype"] = int(msgtype)
+                    scope.args["dispid"] = self.dispid
+                    with scope:
+                        self._handle(proxy, msgtype, packet)
                 else:
                     self._handle(proxy, msgtype, packet)
             except Exception:

@@ -167,7 +167,12 @@ class DispatcherConnMgr:
             if reconnect_max_interval is None else reconnect_max_interval)
         self.proxy: Optional[GoWorldConnection] = None
         self.ring = _ReplayRing(self.down_buffer_bytes)
-        self._buffer_sender = GoWorldConnection(_RingConn(self.ring))
+        # trace_wire also on the buffering sender: a sampled packet parked
+        # in the ring keeps its trailer and replays with the SAME trace id
+        # after the reconnect — the outage shows as dispatcher queue-dwell
+        # in the merged timeline, not as a lost trace.
+        self._buffer_sender = GoWorldConnection(
+            _RingConn(self.ring), trace_wire=True)
         self._task: Optional[asyncio.Task] = None
         self._stopped = False
         self._connected_event = asyncio.Event()
@@ -179,6 +184,21 @@ class DispatcherConnMgr:
         """The live link, or the ring-backed buffering sender while down."""
         proxy = self.proxy
         return proxy if proxy is not None else self._buffer_sender
+
+    def link_state(self) -> dict:
+        """One JSON-able row for /healthz: link up?, last-seen age,
+        packets parked in the replay ring."""
+        up = self.proxy is not None
+        return {
+            "index": self.index,
+            "addr": f"{self.addr[0]}:{self.addr[1]}",
+            "connected": up,
+            "last_seen_age_s": (
+                round(time.monotonic() - self._last_recv, 3)
+                if self._last_recv else None),
+            "buffered_packets": len(self.ring),
+            "connects": self._connect_count,
+        }
 
     def start(self) -> None:
         self._task = asyncio.get_running_loop().create_task(self._run())
@@ -252,7 +272,8 @@ class DispatcherConnMgr:
                 await asyncio.sleep(self._backoff_delay(attempt))
                 attempt += 1
                 continue
-            proxy = GoWorldConnection(PacketConnection(reader, writer))
+            proxy = GoWorldConnection(
+                PacketConnection(reader, writer), trace_wire=True)
             hb_task: Optional[asyncio.Task] = None
             try:
                 self._handshake(self.index, proxy)
@@ -375,6 +396,10 @@ class ClusterClient(DispatcherClusterBase):
 
     def count(self) -> int:
         return len(self._mgrs)
+
+    def link_states(self) -> list[dict]:
+        """Per-dispatcher link health rows (GET /healthz)."""
+        return [m.link_state() for m in self._mgrs]
 
     def flush_all(self) -> None:
         for m in self._mgrs:

@@ -24,6 +24,7 @@ from goworld_tpu.entity.game_client import GameClient
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.proto.conn import unpack_sync_records
 from goworld_tpu.proto.msgtypes import MsgType
+from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import async_jobs, crontab, gwlog, gwutils, post
 
 # run states (GameService.go rsRunning/rsTerminating/rsFreezing...)
@@ -71,6 +72,18 @@ class GameService:
         self.position_sync_interval = (
             game_cfg.position_sync_interval if game_cfg else consts.POSITION_SYNC_INTERVAL
         )
+        self._started_at = 0.0
+        # Slow-tick flight recorder ([telemetry] knobs; tracing.py): every
+        # tick records its phase budget; /flight serves the ring.
+        tcfg = getattr(self.cfg, "telemetry", None)
+        self.flight = tracing.FlightRecorder(
+            capacity=tcfg.flight_ring_size if tcfg else 240,
+            slow_budget=tcfg.slow_tick_budget if tcfg else
+            consts.SLOW_TICK_BUDGET,
+        )
+        # trace_id of the first sampled packet handled in the current tick
+        # (0 = untraced tick): gates phase-span emission at commit.
+        self._tick_trace_id = 0
 
     # --- boot (game.go:66-136) ---------------------------------------------
 
@@ -80,6 +93,11 @@ class GameService:
         rt = entity_manager.runtime
         rt.gameid = self.gameid
         rt.game_service = self
+        self._started_at = time.monotonic()
+        tcfg = getattr(self.cfg, "telemetry", None)
+        if tcfg is not None:
+            tracing.configure_from_config(tcfg)
+        tracing.set_flight_recorder(self.flight)
         game_cfg = self.cfg.games.get(self.gameid)
         if game_cfg is not None:
             rt.save_interval = game_cfg.save_interval
@@ -207,6 +225,9 @@ class GameService:
                     out[e.typename] = out.get(e.typename, 0) + 1
                 return out
             gwvar.set_var("EntityCounts", _counts)
+            from goworld_tpu.utils import debug_http
+
+            debug_http.set_health_provider(self._health)
             # Pull-sampled telemetry gauge beside the gwvar probe: /metrics
             # scrapers get entity counts without touching /vars.
             telemetry.gauge(
@@ -237,6 +258,11 @@ class GameService:
             # Same closure-capture reasoning as the gwvar.unset calls.
             telemetry.gauge("game_entities", labelnames=("gameid",)).remove(
                 str(self.gameid))
+            from goworld_tpu.utils import debug_http
+
+            debug_http.clear_health_provider(self._health)
+            if tracing.flight_recorder() is self.flight:
+                tracing.set_flight_recorder(None)
             await self.cluster.stop()
             dispatchercluster.set_cluster(None)
         return self.exit_code or 0
@@ -273,6 +299,21 @@ class GameService:
         gwlog.warnf("game %d: dispatcher %d disconnected; buffering sends "
                     "until reconnect", self.gameid, index)
 
+    def _health(self) -> dict:
+        """One JSON object for GET /healthz."""
+        return {
+            "kind": "game",
+            "id": self.gameid,
+            "uptime_s": round(time.monotonic() - self._started_at, 3),
+            "deployment_ready": self.deployment_ready,
+            "run_state": self.run_state,
+            "entities": len(entity_manager.entities()),
+            "online_games": sorted(self.online_games),
+            "dispatcher_links": (
+                self.cluster.link_states() if self.cluster is not None
+                else []),
+        }
+
     def _install_signal_handlers(self) -> None:
         loop = asyncio.get_running_loop()
         try:
@@ -299,6 +340,9 @@ class GameService:
             help="Busy wall seconds per game-loop tick, by phase "
                  "(dispatch|entity_logic|aoi|sync_send|total).",
         )
+        # Events delivered by the last AOI tick (set by the batched
+        # engine; stays 0 on xzlist) — sampled into each flight record.
+        aoi_backlog = telemetry.gauge("aoi_event_backlog")
         while True:
             try:
                 msgtype, packet = await asyncio.wait_for(self._queue.get(), timeout=tick)
@@ -315,7 +359,20 @@ class GameService:
             except asyncio.TimeoutError:
                 tracer.begin()
             tracer.mark("dispatch")
-            rt.timer_service.tick()
+            # Ingress seam 2 (beside the gate's client-RPC receive): game-
+            # originated work — timers firing RPCs, crontab jobs — head-
+            # samples a fresh root so server-side request chains are
+            # traceable too. One coin flip per 5 ms tick; sends inside the
+            # scope carry the context across the cluster.
+            timer_scope = tracing.root_scope("game.timer_tick")
+            if timer_scope is None:
+                rt.timer_service.tick()
+            else:
+                timer_scope.args["gameid"] = self.gameid
+                if not self._tick_trace_id:
+                    self._tick_trace_id = timer_scope.ctx.trace_id
+                with timer_scope:
+                    rt.timer_service.tick()
             tracer.mark("entity_logic")
             # NOTE on the multi-HOST (DCN) tier: the wait=False machinery
             # below is lockstep-SAFE as is. Frame-skip only DEFERS a
@@ -378,7 +435,25 @@ class GameService:
                 self._last_sync_collect = now
                 self._send_entity_sync_infos()
                 tracer.mark("sync_send")
-            tracer.commit()
+            committed = tracer.commit()
+            if committed is not None:
+                t0, total, phases = committed
+                # Flight recorder: one compact record per tick; a tick
+                # over the slow budget dumps the ring as ONE WARN and
+                # keeps it on GET /flight.
+                self.flight.record(
+                    t0, total, phases,
+                    queue_depth=self._queue.qsize(),
+                    entities=len(entity_manager.entities()),
+                    aoi_backlog=int(aoi_backlog.value),
+                )
+                if self._tick_trace_id:
+                    # PhaseTracer boundaries as span events: the tick that
+                    # handled a sampled packet lays its phase budget on
+                    # the same timeline as that packet's spans.
+                    tracing.record_phase_spans(
+                        self._tick_trace_id, t0, phases)
+                    self._tick_trace_id = 0
             if self.run_state == RS_TERMINATING:
                 self._do_terminate()
                 return
@@ -439,8 +514,24 @@ class GameService:
     # --- packet handlers (GameService.go:92-157) ------------------------------
 
     def _handle_packet(self, msgtype: int, packet: Packet) -> None:
+        scope = None
+        if packet.trace is not None:
+            # Sampled request: the handling span (incl. local queue dwell
+            # as a child) parents onto the dispatcher's routing span; any
+            # reply RPC sent inside re-attaches the trailer toward the
+            # client's gate.
+            scope = tracing.continue_from_packet(
+                packet, "game.handle", dwell_name="game.queue_dwell")
+            scope.args["msgtype"] = int(msgtype)
+            scope.args["gameid"] = self.gameid
+            if not self._tick_trace_id:
+                self._tick_trace_id = packet.trace.trace_id
         try:
-            self._dispatch_packet(msgtype, packet)
+            if scope is None:
+                self._dispatch_packet(msgtype, packet)
+            else:
+                with scope:
+                    self._dispatch_packet(msgtype, packet)
         except Exception:
             gwlog.trace_error("game %d: error handling msgtype %s", self.gameid, msgtype)
 
@@ -679,6 +770,7 @@ def run(gameid: int | None = None, restore: bool | None = None) -> int:
     gwlog.setup(
         level=(args.log or (game_cfg.log_level if game_cfg else "info")),
         logfile=(game_cfg.log_file if game_cfg else None) or None,
+        fmt=cfg.log.format,
     )
     gwlog.set_source(f"game{args.gid}")
     svc = GameService(args.gid, cfg, restore=args.restore)

@@ -51,6 +51,7 @@ from goworld_tpu.proto.conn import (
     GoWorldConnection,
 )
 from goworld_tpu.proto.msgtypes import FilterOp, MsgType, is_gate_redirect
+from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import gwlog, opmon
 
 _CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
@@ -142,6 +143,10 @@ class GateService:
         return self.exit_code or 0
 
     async def start(self) -> None:
+        self._started_at = time.monotonic()
+        tcfg = getattr(self.cfg, "telemetry", None)
+        if tcfg is not None:
+            tracing.configure_from_config(tcfg)
         addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
         from goworld_tpu.dispatchercluster.cluster import cluster_knobs
 
@@ -163,6 +168,9 @@ class GateService:
 
         gwvar.set_var("NumClients", lambda: len(self.clients))
         self._register_metrics()
+        from goworld_tpu.utils import debug_http
+
+        debug_http.set_health_provider(self._health)
         self._debug_srv = await setup_http_server(self.gate_cfg.http_addr)
         loop = asyncio.get_running_loop()
         self._tasks.append(loop.create_task(self._logic_loop()))
@@ -196,7 +204,24 @@ class GateService:
             if fam is not None:
                 fam.remove(g)
 
+    def _health(self) -> dict:
+        """One JSON object for GET /healthz."""
+        return {
+            "kind": "gate",
+            "id": self.gateid,
+            "uptime_s": round(
+                time.monotonic() - getattr(self, "_started_at", 0.0), 3),
+            "clients": len(self.clients),
+            "queue_depth": self._queue.qsize(),
+            "dispatcher_links": (
+                self.cluster.link_states() if self.cluster is not None
+                else []),
+        }
+
     async def stop(self) -> None:
+        from goworld_tpu.utils import debug_http
+
+        debug_http.clear_health_provider(self._health)
         self._unregister_metrics()
         for t in self._tasks:
             t.cancel()
@@ -450,9 +475,22 @@ class GateService:
             return
         if msgtype == MsgType.CALL_ENTITY_METHOD_FROM_CLIENT:
             eid = packet.read_entity_id()
+            # Ingress seam 1: a client RPC entering the cluster head-
+            # samples a fresh root trace (1/[telemetry] trace_sample_rate).
+            # The method name is parsed only on the sampled path.
+            scope = tracing.root_scope("gate.client_rpc")
+            if scope is not None:
+                scope.args = {"eid": eid, "method": packet.read_varstr(),
+                              "gateid": self.gateid}
             packet.set_read_pos(0)
             packet.append_client_id(cp.clientid)
-            self._select_by_eid(eid).send(MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, packet)
+            if scope is None:
+                self._select_by_eid(eid).send(
+                    MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, packet)
+            else:
+                with scope:
+                    self._select_by_eid(eid).send(
+                        MsgType.CALL_ENTITY_METHOD_FROM_CLIENT, packet)
             return
         gwlog.warnf("gate %d: unexpected client msgtype %s", self.gateid, msgtype)
 
@@ -469,6 +507,20 @@ class GateService:
         self._queue.put_nowait(("dispatcher", None, msgtype, packet))
 
     def _handle_dispatcher_packet(self, msgtype: int, packet: Packet) -> None:
+        if packet.trace is not None:
+            # Tail of a sampled trace: the client fan-out span (queue
+            # dwell child + redirect strip + client write). Client links
+            # carry no trailer, so the trace ends here by design.
+            scope = tracing.continue_from_packet(
+                packet, "gate.client_fanout", dwell_name="gate.queue_dwell")
+            scope.args["msgtype"] = int(msgtype)
+            scope.args["gateid"] = self.gateid
+            with scope:
+                self._dispatch_dispatcher_packet(msgtype, packet)
+            return
+        self._dispatch_dispatcher_packet(msgtype, packet)
+
+    def _dispatch_dispatcher_packet(self, msgtype: int, packet: Packet) -> None:
         if is_gate_redirect(msgtype):
             self._handle_redirect(msgtype, packet)
         elif msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
@@ -595,6 +647,7 @@ def run(gateid: int | None = None) -> int:
     gwlog.setup(
         level=(args.log or (gate_cfg.log_level if gate_cfg else "info")),
         logfile=(gate_cfg.log_file if gate_cfg else None) or None,
+        fmt=cfg.log.format,
     )
     gwlog.set_source(f"gate{args.gid}")
     svc = GateService(args.gid, cfg)

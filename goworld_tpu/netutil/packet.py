@@ -30,11 +30,21 @@ _F64 = struct.Struct("<d")
 class Packet:
     """Append-only write + cursor read packet payload."""
 
-    __slots__ = ("_buf", "_rpos")
+    __slots__ = ("_buf", "_rpos", "trace")
 
     def __init__(self, payload: bytes | bytearray | None = None) -> None:
         self._buf = bytearray(payload) if payload else bytearray()
         self._rpos = 0
+        # TraceContext attached by the recv seam when the wire msgtype
+        # carried the tracing-trailer flag (telemetry/tracing.py); None
+        # for the overwhelming majority of packets.
+        self.trace = None
+
+    def pop_tail(self, n: int) -> bytes:
+        """Remove and return the last ``n`` payload bytes (trailer strip)."""
+        tail = bytes(self._buf[-n:])
+        del self._buf[-n:]
+        return tail
 
     # --- lifecycle ---------------------------------------------------------
 

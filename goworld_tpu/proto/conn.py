@@ -14,7 +14,13 @@ import numpy as np
 
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import PacketConnection
-from goworld_tpu.proto.msgtypes import PROTO_VERSION, FilterOp, MsgType
+from goworld_tpu.proto.msgtypes import (
+    MSGTYPE_TRACE_FLAG,
+    PROTO_VERSION,
+    FilterOp,
+    MsgType,
+)
+from goworld_tpu.telemetry import tracing as _tracing
 
 SYNC_RECORD_SIZE = 16 + 4 * 4  # EntityID + x,y,z,yaw (proto.go:135-139)
 _SYNC = struct.Struct("<16s4f")
@@ -99,27 +105,68 @@ def pack_client_sync_blocks(
 
 
 class GoWorldConnection:
-    """Wraps a PacketConnection with typed senders."""
+    """Wraps a PacketConnection with typed senders.
 
-    def __init__(self, conn: PacketConnection) -> None:
+    ``trace_wire=True`` (cluster links only: game/gate↔dispatcher, both
+    directions) piggybacks the active sampled TraceContext as a 17-byte
+    packet trailer flagged by MSGTYPE_TRACE_FLAG — absent for unsampled
+    packets, so the untraced fast path pays exactly one branch per send
+    and the wire stays byte-identical to v3 framing. The recv seam strips
+    the trailer on ANY connection (ignored-compatible), attaching the
+    context to ``packet.trace``. Gate↔client links keep trace_wire off:
+    the client protocol is unchanged and traces terminate at the gate's
+    fan-out span.
+    """
+
+    def __init__(self, conn: PacketConnection, *,
+                 trace_wire: bool = False) -> None:
         self.conn = conn
+        self.trace_wire = trace_wire
 
     # --- generic -----------------------------------------------------------
+
+    def _trace_ctx(self, packet_trace):
+        """The context to piggyback: the active span's, else the one the
+        packet itself arrived with (dispatcher buffered/replayed forwards
+        outside any handling scope must not lose the trace)."""
+        ctx = _tracing.current()
+        return ctx if ctx is not None else packet_trace
 
     def send(self, msgtype: int, packet: Packet) -> None:
         _PKT_OUT.inc()
         _BYTES_OUT.inc(len(packet.payload))
+        if self.trace_wire:
+            ctx = self._trace_ctx(packet.trace)
+            if ctx is not None:
+                # Copy-on-trace: broadcasts reuse one Packet across links,
+                # so the original payload must stay trailer-free.
+                self.conn.send_packet(
+                    msgtype | MSGTYPE_TRACE_FLAG,
+                    Packet(packet.payload + _tracing.encode_trailer(ctx)))
+                return
         self.conn.send_packet(msgtype, packet)
 
     def send_packet_raw(self, msgtype: int, payload: bytes) -> None:
         _PKT_OUT.inc()
         _BYTES_OUT.inc(len(payload))
+        if self.trace_wire:
+            ctx = self._trace_ctx(None)
+            if ctx is not None:
+                self.conn.send_packet(
+                    msgtype | MSGTYPE_TRACE_FLAG,
+                    Packet(payload + _tracing.encode_trailer(ctx)))
+                return
         self.conn.send_packet(msgtype, Packet(payload))
 
     async def recv(self):
         msgtype, packet = await self.conn.recv_packet()
         _PKT_IN.inc()
         _BYTES_IN.inc(len(packet.payload))
+        if msgtype & MSGTYPE_TRACE_FLAG:
+            msgtype &= ~MSGTYPE_TRACE_FLAG
+            if packet.payload_len() >= _tracing.TRAILER_SIZE:
+                packet.trace = _tracing.decode_trailer(
+                    packet.pop_tail(_tracing.TRAILER_SIZE))
         return msgtype, packet
 
     def flush(self) -> None:

@@ -23,7 +23,18 @@ import enum
 # v3: cluster-link HEARTBEAT + liveness kills — a v2 peer would neither
 # send heartbeats nor expect them, so a v3 end would kill its (healthy)
 # idle links; fail the mixed pair at the handshake instead.
-PROTO_VERSION = 3
+# v4: optional distributed-tracing trailer on cluster packets — a SAMPLED
+# packet sets MSGTYPE_TRACE_FLAG (bit 15 of the u16 msgtype, far above
+# every type id) and appends a 17-byte TraceContext after the payload,
+# stripped at the recv seam (telemetry/tracing.py). Unsampled packets and
+# HEARTBEAT are byte-identical to v3, but a v3 peer would route a flagged
+# msgtype to "unhandled" and mis-read the trailer as payload bytes — fail
+# the mixed pair at the handshake instead.
+PROTO_VERSION = 4
+
+# High bit of the wire msgtype: a tracing trailer follows the payload.
+# Never a routing class — masked off before any msgtype comparison.
+MSGTYPE_TRACE_FLAG = 0x8000
 
 
 class MsgType(enum.IntEnum):

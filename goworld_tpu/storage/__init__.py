@@ -49,7 +49,7 @@ _deferred_cap = consts.STORAGE_DEFERRED_BYTES_CAP
 
 
 class _SaveOp:
-    __slots__ = ("typename", "eid", "data", "callback", "nbytes")
+    __slots__ = ("typename", "eid", "data", "callback", "nbytes", "trace")
 
     def __init__(self, typename: str, eid: str, data: dict,
                  callback: Optional[Callable]) -> None:
@@ -57,6 +57,11 @@ class _SaveOp:
         self.eid = eid
         self.data = data
         self.callback = callback
+        # Sampled TraceContext active when the save was QUEUED (e.g. a
+        # traced RPC calling entity.save()): the backend write records a
+        # storage.save span under it, even though the write lands later
+        # on the worker thread (tracing's ring is thread-safe).
+        self.trace = telemetry.tracing.current()
         try:
             self.nbytes = len(json.dumps(data, default=str))
         except Exception:
@@ -196,8 +201,16 @@ def _write_with_retries(op: _SaveOp) -> bool:
     while True:
         try:
             mon = opmon.Operation("storage.save")
+            t0 = time.monotonic()
             _backend.write(op.typename, op.eid, op.data)
             mon.finish(warn_threshold=1.0)  # storage.go:194,234
+            if op.trace is not None:
+                tr = telemetry.tracing
+                tr.record_span(
+                    "storage.save", t0, time.monotonic() - t0,
+                    op.trace.trace_id, tr.new_span_id(), op.trace.span_id,
+                    {"typename": op.typename, "eid": op.eid,
+                     "bytes": op.nbytes})
             _breaker.record_success()
             _complete(op, None)
             return True

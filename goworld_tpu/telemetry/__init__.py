@@ -31,6 +31,7 @@ from goworld_tpu.telemetry.metrics import (  # noqa: F401
     exponential_buckets,
 )
 from goworld_tpu.telemetry.phases import PhaseTracer, TOTAL_PHASE  # noqa: F401
+from goworld_tpu.telemetry import tracing  # noqa: F401
 
 
 def counter(name: str, help: str = "", labelnames: Sequence[str] = ()):

@@ -72,15 +72,24 @@ class PhaseTracer:
         self._acc[phase] = self._acc.get(phase, 0.0) + (now - self._last)
         self._last = now
 
-    def commit(self) -> None:
-        """Observe every accumulated phase plus the whole-tick total."""
+    def commit(self):
+        """Observe every accumulated phase plus the whole-tick total.
+
+        Returns ``(t0_monotonic, total_seconds, phases_dict)`` so the
+        caller can feed the same attribution to the flight recorder /
+        trace ring without re-timing anything (None when no begin()
+        preceded). The returned dict is a copy — safe to keep."""
         if not self._t0:
-            return  # commit without begin: nothing to attribute
-        for phase, took in self._acc.items():
+            return None  # commit without begin: nothing to attribute
+        phases = dict(self._acc)
+        for phase, took in phases.items():
             child = self._children.get(phase)
             if child is None:  # late-declared phase: resolve once, keep
                 child = self._children[phase] = self._family.labels(phase)
             child.observe(took)
-        self._children[TOTAL_PHASE].observe(self._last - self._t0)
+        total = self._last - self._t0
+        self._children[TOTAL_PHASE].observe(total)
+        t0 = self._t0
         self._t0 = 0.0
         self._acc.clear()
+        return t0, total, phases

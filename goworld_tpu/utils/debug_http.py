@@ -5,14 +5,25 @@ an always-on HTTP server (pprof + expvar) on the config ``http_addr``.
 Python-native design: a minimal asyncio HTTP/1.1 responder (no external web
 framework in this image) serving:
 
-- ``/healthz``   — 200 "ok" liveness probe
+- ``/healthz``   — one JSON object: process kind/id, uptime, PROTO_VERSION,
+  dispatcher link states + last-seen ages, entity/client counts (the
+  service registers a provider via :func:`set_health_provider`); ops
+  probes and the chaos harness read THIS, not /metrics text
 - ``/vars``      — JSON snapshot of gwvar published variables (expvar parity)
 - ``/metrics``   — Prometheus text exposition of the telemetry registry
   (tick-phase histograms, AOI stage timings/backlog, queue-depth gauges;
   see goworld_tpu/telemetry)
+- ``/trace``     — this process's finished-span ring as Chrome trace-event
+  JSON (Perfetto-loadable); ``?raw=1`` returns the raw span list that
+  tools/tracecat.py merges across all processes of a deployment
+- ``/flight``    — the game loop's slow-tick flight recorder (last N tick
+  records + the most recent over-budget dump; telemetry/tracing.py)
 - ``/opmon``     — JSON dump of operation monitor stats (opmon.go:37-118;
   now a legacy view over the telemetry op_duration_seconds family)
 - ``/stack``     — all-thread stack dump (the practical subset of pprof)
+- ``/profile``   — cProfile the main thread for ?seconds=S; ``&mode=jax``
+  instead wraps the window in jax.profiler.trace (the step jits of the
+  AOI engine included) and returns the trace directory path
 
 SECURITY: this server is unauthenticated and serves state-changing GETs
 (``/heap/start`` toggles ~2x allocation overhead process-wide) and CPU-heavy
@@ -25,11 +36,35 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
 import sys
+import time
 import traceback
-from typing import Optional
+from typing import Callable, Optional
 
 from goworld_tpu.utils import gwlog, gwvar
+
+# /healthz detail provider: the process's service registers a zero-arg
+# callable returning a JSON-able dict (kind, id, uptime, link states,
+# counts). Module-level because each production process runs exactly one
+# service; in-process test clusters get whichever service registered last.
+_health_provider: Optional[Callable[[], dict]] = None
+_module_t0 = time.monotonic()
+
+
+def set_health_provider(fn: Callable[[], dict]) -> None:
+    global _health_provider
+    _health_provider = fn
+
+
+def clear_health_provider(fn: Callable[[], dict]) -> None:
+    """Unregister ``fn`` iff it is still the active provider (a service
+    stopping must not wipe a newer service's registration)."""
+    global _health_provider
+    # == not `is`: bound methods are fresh objects per attribute access,
+    # but compare equal for the same function + instance.
+    if _health_provider == fn:
+        _health_provider = None
 
 
 def _dump_stacks() -> str:
@@ -73,7 +108,7 @@ class DebugHTTPServer:
             elif route == "/heap/types":
                 status, ctype, body = await self._heap_types()
             else:
-                status, ctype, body = self._route(route)
+                status, ctype, body = self._route(route, self._query(path))
             head = (
                 f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
                 f"Content-Length: {len(body)}\r\nConnection: close\r\n\r\n"
@@ -88,23 +123,35 @@ class DebugHTTPServer:
             except Exception:
                 pass
 
+    @staticmethod
+    def _query(path: str) -> dict[str, str]:
+        out: dict[str, str] = {}
+        if "?" in path:
+            for kv in path.split("?", 1)[1].split("&"):
+                k, _, v = kv.partition("=")
+                out[k] = v
+        return out
+
     async def _profile(self, path: str) -> tuple[str, str, bytes]:
         """CPU-profile the process for ?seconds=N (pprof's /profile slot):
         cProfile runs on the main thread, so everything the game/gate/
-        dispatcher loop does in the window is captured."""
+        dispatcher loop does in the window is captured. ``&mode=jax``
+        instead wraps the window in ``jax.profiler.trace`` — every step
+        jit the AOI engine dispatches during it lands in the on-disk
+        trace — and returns the trace directory path (open it with
+        TensorBoard's profile plugin or xprof)."""
+        q = self._query(path)
+        seconds = 5.0
+        try:
+            seconds = min(60.0, max(0.1, float(q.get("seconds", "5"))))
+        except ValueError:
+            pass
+        if q.get("mode") == "jax":
+            return await self._profile_jax(seconds)
         import cProfile
         import io
         import pstats
 
-        seconds = 5.0
-        if "?" in path:
-            for kv in path.split("?", 1)[1].split("&"):
-                k, _, v = kv.partition("=")
-                if k == "seconds":
-                    try:
-                        seconds = min(60.0, max(0.1, float(v)))
-                    except ValueError:
-                        pass
         pr = cProfile.Profile()
         pr.enable()
         await asyncio.sleep(seconds)
@@ -112,6 +159,35 @@ class DebugHTTPServer:
         buf = io.StringIO()
         pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(80)
         return "200 OK", "text/plain", buf.getvalue().encode()
+
+    async def _profile_jax(self, seconds: float) -> tuple[str, str, bytes]:
+        """On-demand device profiling: jax.profiler.trace around an
+        S-second window. Gives the TPU side the same ask-the-running-
+        process story the span ring gives the host side."""
+        import tempfile
+
+        try:
+            import jax
+        except Exception as exc:  # pragma: no cover - jax always in image
+            return ("500 Internal Server Error", "application/json",
+                    json.dumps({"error": f"jax unavailable: {exc}"}).encode())
+        trace_dir = tempfile.mkdtemp(prefix="goworld_jax_trace_")
+        try:
+            jax.profiler.start_trace(trace_dir)
+            await asyncio.sleep(seconds)
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:
+                return ("500 Internal Server Error", "application/json",
+                        json.dumps({"error": str(exc),
+                                    "trace_dir": trace_dir}).encode())
+        return ("200 OK", "application/json", json.dumps({
+            "trace_dir": trace_dir,
+            "seconds": seconds,
+            "hint": "tensorboard --logdir <trace_dir> (profile plugin), "
+                    "or xprof",
+        }).encode())
 
     async def _heap_types(self) -> tuple[str, str, bytes]:
         """GC census: live instance counts by type (top 40) — tells you
@@ -133,9 +209,47 @@ class DebugHTTPServer:
         body = await asyncio.get_running_loop().run_in_executor(None, census)
         return "200 OK", "text/plain", body.encode()
 
-    def _route(self, path: str) -> tuple[str, str, bytes]:
+    def _route(self, path: str, query: Optional[dict] = None) -> tuple[str, str, bytes]:
         if path == "/healthz":
-            return "200 OK", "text/plain", b"ok"
+            from goworld_tpu.proto.msgtypes import PROTO_VERSION
+
+            health = {
+                "status": "ok",
+                "pid": os.getpid(),
+                "proto_version": PROTO_VERSION,
+                "uptime_s": round(time.monotonic() - _module_t0, 3),
+            }
+            if _health_provider is not None:
+                try:
+                    health.update(_health_provider())
+                except Exception as exc:
+                    health["status"] = "degraded"
+                    health["health_provider_error"] = str(exc)
+            return ("200 OK", "application/json",
+                    json.dumps(health, default=str).encode())
+        if path == "/trace":
+            from goworld_tpu.telemetry import tracing
+
+            if (query or {}).get("raw"):
+                body = json.dumps({
+                    "process": gwlog.get_source(),
+                    "pid": os.getpid(),
+                    "spans": tracing.snapshot(),
+                })
+            else:
+                body = json.dumps(
+                    tracing.export_chrome(gwlog.get_source()))
+            return "200 OK", "application/json", body.encode()
+        if path == "/flight":
+            from goworld_tpu.telemetry import tracing
+
+            rec = tracing.flight_recorder()
+            body = json.dumps(
+                rec.snapshot() if rec is not None else
+                {"recent": [], "last_slow": None,
+                 "note": "no tick loop in this process"},
+                default=str)
+            return "200 OK", "application/json", body.encode()
         if path == "/heap/start":
             # Live heap profiling (pprof's /heap slot, via tracemalloc):
             # start tracing, then GET /heap for the top Python growth

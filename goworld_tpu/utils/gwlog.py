@@ -4,10 +4,18 @@ Reference parity: ``engine/gwlog/gwlog.go:16-169`` — zap-based sugar logger
 with a per-component ``source`` field, level parsing, ``TraceError`` (error +
 stack dump) and Fatal/Panic helpers. Here we build on the stdlib ``logging``
 module with the same surface.
+
+``[log] format = json`` switches every handler to one JSON object per line
+(level/ts/source/msg) with automatic ``trace_id`` injection when the line
+is emitted inside an active distributed-trace span (telemetry/tracing.py) —
+so grepping a trace id across the per-process logs of a cluster yields the
+exact log lines of one sampled request. The zap-parity text format stays
+the default.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import sys
 import traceback
@@ -27,15 +35,49 @@ class _SourceFilter(logging.Filter):
         return True
 
 
+class _JsonFormatter(logging.Formatter):
+    """One JSON object per line; trace_id injected inside active spans."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        obj = {
+            "ts": round(record.created, 6),
+            "level": record.levelname.lower(),
+            "source": getattr(record, "source", _source),
+            "msg": record.getMessage(),
+        }
+        # Lazy import: gwlog must stay importable before telemetry (and
+        # tracing itself logs through gwlog).
+        try:
+            from goworld_tpu.telemetry import tracing
+
+            ctx = tracing.current()
+            if ctx is not None:
+                obj["trace_id"] = f"{ctx.trace_id:016x}"
+        except Exception:
+            pass
+        if record.exc_info:
+            obj["exc"] = self.formatException(record.exc_info)
+        return json.dumps(obj, separators=(",", ":"), default=str)
+
+
 def set_source(source: str) -> None:
     """Set the component tag (e.g. ``game1`` / ``gate2`` / ``dispatcher1``)."""
     global _source
     _source = source
 
 
-def setup(level: str = "info", logfile: str | None = None, stderr: bool = True) -> None:
-    """Initialise handlers. Mirrors binutil.SetupGWLog (binutil.go:50-82)."""
+def get_source() -> str:
+    """The component tag (process identity for /trace exports)."""
+    return _source
+
+
+def setup(level: str = "info", logfile: str | None = None,
+          stderr: bool = True, fmt: str = "text") -> None:
+    """Initialise handlers. Mirrors binutil.SetupGWLog (binutil.go:50-82).
+    ``fmt``: "text" (zap-parity lines, default) or "json" ([log] format)."""
     global _configured
+    if fmt not in ("text", "json"):
+        raise ValueError(f"log format must be text|json, got {fmt!r}")
     for h in _logger.handlers:
         h.close()
     _logger.handlers.clear()
@@ -46,8 +88,10 @@ def setup(level: str = "info", logfile: str | None = None, stderr: bool = True) 
         handlers.append(logging.FileHandler(logfile))
     if stderr or not handlers:
         handlers.append(logging.StreamHandler(sys.stderr))
+    formatter = (_JsonFormatter() if fmt == "json"
+                 else logging.Formatter(_FORMAT, _DATEFMT))
     for h in handlers:
-        h.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        h.setFormatter(formatter)
         h.addFilter(_SourceFilter())
         _logger.addHandler(h)
     _configured = True

@@ -168,7 +168,10 @@ def test_debug_http_server_endpoints():
                 return r.status, r.read()
 
         status, body = await asyncio.to_thread(fetch, "/healthz")
-        assert (status, body) == (200, b"ok")
+        assert status == 200
+        health = json.loads(body)  # ISSUE 5: one JSON object, not "ok"
+        assert health["status"] == "ok"
+        assert health["proto_version"] >= 4 and "uptime_s" in health
         status, body = await asyncio.to_thread(fetch, "/vars")
         data = json.loads(body)
         assert data["IsDeploymentReady"] is True
