@@ -130,15 +130,24 @@ class ChaosCluster:
         reconnect_max_interval: float = 1.0,
         sync_interval: float = 0.05,
         storage_knobs: Optional[dict] = None,
+        transport: str = "tcp",
     ) -> None:
         self.run_dir = run_dir
         self.n_dispatchers = n_dispatchers
         self.n_bots = n_bots
         self.peer_heartbeat_timeout = peer_heartbeat_timeout
+        # "uds": the game/gate↔dispatcher links ride Unix-domain sockets
+        # (socket files under run_dir) — crash/replay/liveness semantics
+        # must be transport-identical, and every scenario asserts exactly
+        # that when run on both transports (bench.py --chaos).
+        self.transport = transport
+        self.uds_dir = run_dir if transport == "uds" else None
         self.cluster_cfg = ClusterConfig(
             down_buffer_bytes=down_buffer_bytes,
             peer_heartbeat_timeout=peer_heartbeat_timeout,
             reconnect_max_interval=reconnect_max_interval,
+            transport=transport,
+            uds_dir=run_dir if transport == "uds" else "",
         )
         self.sync_interval = sync_interval
         self.storage_knobs = storage_knobs or {}
@@ -165,7 +174,7 @@ class ChaosCluster:
             d = DispatcherService(
                 i + 1, desired_games=1, desired_gates=1,
                 peer_heartbeat_timeout=self.peer_heartbeat_timeout)
-            await d.start()
+            await d.start(uds_dir=self.uds_dir)
             self.dispatchers.append(d)
             self.ports.append(d.port)
 
@@ -299,7 +308,7 @@ class ChaosCluster:
             peer_heartbeat_timeout=self.peer_heartbeat_timeout)
         for _ in range(100):  # the old socket may linger briefly
             try:
-                await d.start(port=self.ports[i])
+                await d.start(port=self.ports[i], uds_dir=self.uds_dir)
                 break
             except OSError:
                 await asyncio.sleep(0.05)
@@ -470,13 +479,17 @@ async def scenario_storage_outage(
             "failed_writes": flaky.failed, "lost_saves": len(missing)}
 
 
-def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12) -> dict:
-    """Run the full scenario suite over one cluster (``bench.py --chaos``).
-    Returns a JSON-able summary; raises on any invariant violation."""
+def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
+              transport: str = "tcp") -> dict:
+    """Run the full scenario suite over one cluster (``bench.py --chaos``;
+    ``transport`` = "tcp" or "uds" — the fault semantics must be
+    transport-identical and every scenario asserts its own invariants
+    either way). Returns a JSON-able summary; raises on any violation."""
 
     async def _run() -> dict:
         cluster = ChaosCluster(
             run_dir, n_dispatchers=n_dispatchers, n_bots=n_bots,
+            transport=transport,
             storage_knobs=dict(
                 retry_base_interval=0.05, retry_max_interval=0.2,
                 circuit_failure_threshold=3, circuit_cooldown=0.3,
@@ -497,6 +510,7 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12) -> dict:
             "bot_errors": 0,
             "dispatchers": n_dispatchers,
             "bots": n_bots,
+            "transport": transport,
         }
 
     return asyncio.run(_run())

@@ -115,6 +115,20 @@ class ClusterConfig:
     # Reconnect backoff ceiling (base is consts.RECONNECT_INTERVAL;
     # delays are full-jittered).
     reconnect_max_interval: float = 15.0
+    # Cluster-link transport: "tcp" (default) or "uds" — Unix-domain
+    # game↔dispatcher↔gate sockets for co-located single-host deploys
+    # (same framing/heartbeats/replay rings; dispatchers serve BOTH
+    # listeners, games/gates dial the socket path derived from each
+    # dispatcher's configured port — dispatchercluster.cluster.uds_path_for).
+    transport: str = "tcp"
+    # Directory holding the uds socket files ("" = system temp dir; keep
+    # it short — sun_path caps at ~108 bytes).
+    uds_dir: str = ""
+    # Size trigger for position-sync aggregation buffers (dispatcher
+    # per-game, gate per-dispatcher): flush immediately once a buffer
+    # reaches this many bytes instead of sitting out the tick/sync
+    # interval. 0 disables the trigger (tick-interval flush only).
+    sync_flush_bytes: int = 32 * 1024
 
 
 @dataclasses.dataclass
@@ -405,6 +419,9 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             peer_heartbeat_timeout=float(s.get("peer_heartbeat_timeout", 10.0)),
             wait_connected_timeout=float(s.get("wait_connected_timeout", 10.0)),
             reconnect_max_interval=float(s.get("reconnect_max_interval", 15.0)),
+            transport=s.get("transport", "tcp").strip().lower(),
+            uds_dir=s.get("uds_dir", "").strip(),
+            sync_flush_bytes=int(s.get("sync_flush_bytes", 32 * 1024)),
         )
     if cp.has_section("telemetry"):
         s = cp["telemetry"]
@@ -563,6 +580,14 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[cluster] wait_connected_timeout must be > 0")
     if cl.reconnect_max_interval <= 0:
         raise ValueError("[cluster] reconnect_max_interval must be > 0")
+    if cl.transport not in ("tcp", "uds"):
+        # A typo here would leave games dialing TCP while the operator
+        # believes the cluster rides unix sockets — fail loudly.
+        raise ValueError(
+            f"[cluster] transport must be tcp|uds, got {cl.transport!r}")
+    if cl.sync_flush_bytes < 0:
+        raise ValueError(
+            "[cluster] sync_flush_bytes must be >= 0 (0 = tick-only flush)")
     t = cfg.telemetry
     if t.trace_sample_rate < 0:
         raise ValueError(

@@ -71,6 +71,12 @@ CLUSTER_WAIT_CONNECTED_TIMEOUT = 10.0
 # window buffers packets for not-yet-routed entities (a gate's ring replay
 # racing the game's re-handshake into a restarted dispatcher).
 DISPATCHER_RECONNECT_BUFFER_WINDOW = 5.0
+# Size trigger for position-sync aggregation buffers (dispatcher per-game
+# and gate per-dispatcher): a buffer reaching this many bytes flushes
+# immediately instead of waiting out the tick/sync interval, so a burst
+# pays latency proportional to its size, not the flush cadence.
+# 0 disables the trigger ([cluster] sync_flush_bytes overrides).
+DISPATCHER_SYNC_FLUSH_BYTES = 32 * 1024
 
 # --- telemetry / tracing ([telemetry] ini section overrides) -----------------
 # Head-sampling denominator for distributed traces: 1-in-N ingress events

@@ -51,9 +51,14 @@ def run(dispid: int | None = None) -> int:
             desired_games=cfg.deployment.desired_games,
             desired_gates=cfg.deployment.desired_gates,
             peer_heartbeat_timeout=cfg.cluster.peer_heartbeat_timeout,
+            sync_flush_bytes=cfg.cluster.sync_flush_bytes,
         )
         host, port = (disp_cfg.host, disp_cfg.port) if disp_cfg else ("127.0.0.1", 0)
-        await svc.start(host, port)
+        # [cluster] transport = uds: serve a Unix-domain listener beside
+        # TCP; co-located games/gates dial the path derived from the port.
+        await svc.start(host, port,
+                        uds_dir=(cfg.cluster.uds_dir
+                                 if cfg.cluster.transport == "uds" else None))
         from goworld_tpu.utils.debug_http import setup_http_server
 
         debug_srv = await setup_http_server(disp_cfg.http_addr if disp_cfg else "")

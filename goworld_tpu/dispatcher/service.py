@@ -23,17 +23,41 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
 import time
 from typing import Deque, Optional
 
-from goworld_tpu import consts
+import numpy as np
+
+from goworld_tpu import consts, telemetry
 from goworld_tpu.dispatcher.lbc import LBCHeap
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
-from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection
+from goworld_tpu.proto.conn import SYNC_DTYPE, SYNC_RECORD_SIZE, GoWorldConnection
 from goworld_tpu.proto.msgtypes import PROTO_VERSION, MsgType, is_gate_redirect
 from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import gwlog
+
+_CLIENT_SYNC_BLOCK = 16 + SYNC_RECORD_SIZE  # [clientid + record] (downstream)
+
+# Records-per-packet amortization made visible (ISSUE 6): the whole point
+# of batch routing is that one packet carries MANY records — these count
+# records at the dispatcher seam so /metrics shows the ratio directly
+# (dir="up" = client→game position syncs, dir="down" = game→gate fan-out
+# blocks). Families are process-wide; children resolve per instance.
+_SYNC_RECORDS = telemetry.counter(
+    "dispatcher_sync_records_total",
+    "Position-sync records routed through the dispatcher, by direction.",
+    ("dispid", "dir"))
+# Wall seconds spent in each hop of the sync fan-out pipeline (game pack →
+# dispatcher route → gate demux → client write); bench.py --fanout turns
+# deltas of these into the per-hop shares in its headline JSON.
+_HOP_SECONDS = telemetry.counter(
+    "fanout_hop_seconds_total",
+    "Busy wall seconds per sync fan-out hop "
+    "(game_pack|dispatcher_route|gate_demux|client_write).",
+    ("hop",))
+_HOP_ROUTE = _HOP_SECONDS.labels("dispatcher_route")
 
 
 class _EntityDispatchInfo:
@@ -140,10 +164,18 @@ class DispatcherService:
     """One dispatcher process. Run with :meth:`start`, stop with :meth:`stop`."""
 
     def __init__(self, dispid: int, desired_games: int = 1, desired_gates: int = 1,
-                 peer_heartbeat_timeout: Optional[float] = None) -> None:
+                 peer_heartbeat_timeout: Optional[float] = None,
+                 sync_flush_bytes: Optional[int] = None) -> None:
         self.dispid = dispid
         self.desired_games = desired_games
         self.desired_gates = desired_gates
+        # Size trigger for the position-sync aggregation buffers
+        # ([cluster] sync_flush_bytes; 0 disables): a burst larger than
+        # this flushes to its game IMMEDIATELY instead of sitting out the
+        # rest of the 5 ms tick interval.
+        self.sync_flush_bytes = (
+            consts.DISPATCHER_SYNC_FLUSH_BYTES
+            if sync_flush_bytes is None else sync_flush_bytes)
         # Liveness deadline for game/gate links ([cluster]
         # peer_heartbeat_timeout; 0 disables): HEARTBEAT is sent on idle
         # links and peers silent past the deadline are closed, converting
@@ -185,12 +217,36 @@ class DispatcherService:
         self._resume_event.set()
         self._started_at = 0.0
         self.port: int = 0
+        self._uds_server: Optional[asyncio.base_events.Server] = None
+        self.uds_path: Optional[str] = None
+        d = str(dispid)
+        self._sync_records_up = _SYNC_RECORDS.labels(d, "up")
+        self._sync_records_down = _SYNC_RECORDS.labels(d, "down")
 
     # --- lifecycle ----------------------------------------------------------
 
-    async def start(self, host: str = "127.0.0.1", port: int = 0) -> None:
+    async def start(self, host: str = "127.0.0.1", port: int = 0,
+                    uds_dir: Optional[str] = None) -> None:
+        """Bind the TCP listener (always — port discovery and remote
+        peers) and, when ``uds_dir`` is not None ([cluster] transport =
+        uds), ALSO a Unix-domain listener whose path derives from the
+        bound TCP port (uds_path_for) so co-located games/gates can dial
+        it without extra configuration. Both listeners feed the same
+        connection handler: framing, handshakes, heartbeats, and replay
+        semantics are transport-identical."""
         self._server = await asyncio.start_server(self._on_conn, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        if uds_dir is not None:
+            from goworld_tpu.dispatchercluster.cluster import uds_path_for
+
+            path = uds_path_for(self.port, uds_dir)
+            try:
+                os.unlink(path)  # stale socket from a dead predecessor
+            except OSError:
+                pass
+            self._uds_server = await asyncio.start_unix_server(
+                self._on_conn, path)
+            self.uds_path = path
         self._started_at = time.monotonic()
         self._tasks.append(asyncio.get_running_loop().create_task(self._logic_loop()))
         self._tasks.append(asyncio.get_running_loop().create_task(self._tick_loop()))
@@ -198,7 +254,9 @@ class DispatcherService:
         from goworld_tpu.utils import debug_http
 
         debug_http.set_health_provider(self._health)
-        gwlog.infof("dispatcher %d listening on %s:%d", self.dispid, host, self.port)
+        gwlog.infof("dispatcher %d listening on %s:%d%s", self.dispid, host,
+                    self.port,
+                    f" + uds {self.uds_path}" if self.uds_path else "")
         gwlog.infof(consts.DISPATCHER_STARTED_TAG)
 
     def _health(self) -> dict:
@@ -296,6 +354,10 @@ class DispatcherService:
             fam = telemetry.family(name)
             if fam is not None:
                 fam.remove(d)
+        fam = telemetry.family("dispatcher_sync_records_total")
+        if fam is not None:
+            for direction in ("up", "down"):
+                fam.remove(d, direction)
         fam = telemetry.family("cluster_peer_last_seen_seconds")
         if fam is not None:
             for gid in list(self.games):
@@ -316,6 +378,8 @@ class DispatcherService:
             except (asyncio.CancelledError, Exception):
                 pass
         self._tasks.clear()
+        if self._uds_server is not None:
+            self._uds_server.close()
         if self._server is not None:
             self._server.close()
             # Close live connections BEFORE wait_closed(): since 3.12.1
@@ -324,6 +388,14 @@ class DispatcherService:
             for proxy in list(self._conns):
                 proxy.close()
             await self._server.wait_closed()
+        if self._uds_server is not None:
+            await self._uds_server.wait_closed()
+            self._uds_server = None
+            if self.uds_path is not None:
+                try:
+                    os.unlink(self.uds_path)
+                except OSError:
+                    pass
         for gi in self.games.values():
             if gi.proxy is not None:
                 gi.proxy.close()
@@ -352,28 +424,75 @@ class DispatcherService:
             proxy.close()
 
     async def _logic_loop(self) -> None:
+        queue = self._queue
         while True:
-            proxy, msgtype, packet = await self._queue.get()
+            # Drain the whole burst without yielding (the gate and game
+            # loops batch the same way): routing cost then scales with
+            # PACKETS handled back to back, and peer links are corked for
+            # the span of the burst so N forwards to one game/gate leave
+            # in ONE transport write at batch end — skipping the
+            # FLUSH_INTERVAL timer the tracecat soak measured as the worst
+            # per-hop latency. No awaits between cork and uncork, so the
+            # tick loop's heartbeats can never interleave into a corked
+            # span.
+            batch = [await queue.get()]
+            while True:
+                try:
+                    batch.append(queue.get_nowait())
+                except asyncio.QueueEmpty:
+                    break
             await self._resume_event.wait()  # chaos pause hook (no-op live)
+            corked: list[GoWorldConnection] = []
+            if len(batch) > 1:
+                corked = [gi.proxy for gi in self.games.values()
+                          if gi.connected]
+                corked += [gt.proxy for gt in self.gates.values()
+                           if gt.connected]
+                for p in corked:
+                    p.cork()
             try:
-                if msgtype == -1:
-                    self._handle_disconnect(proxy)
-                elif packet is not None and packet.trace is not None:
-                    # Sampled packet: the handling span covers queue dwell
-                    # (recv → here, its own child span — THE number the
-                    # paper's routing path hides) + routing, and any
-                    # forward inside re-attaches the trailer downstream.
-                    scope = tracing.continue_from_packet(
-                        packet, "dispatcher.route",
-                        dwell_name="dispatcher.queue_dwell")
-                    scope.args["msgtype"] = int(msgtype)
-                    scope.args["dispid"] = self.dispid
-                    with scope:
-                        self._handle(proxy, msgtype, packet)
-                else:
-                    self._handle(proxy, msgtype, packet)
-            except Exception:
-                gwlog.trace_error("dispatcher %d: error handling msgtype %s", self.dispid, msgtype)
+                for proxy, msgtype, packet in batch:
+                    try:
+                        if msgtype == -1:
+                            self._handle_disconnect(proxy)
+                        elif packet is not None and packet.trace is not None:
+                            # Sampled packet: the handling span covers queue
+                            # dwell (recv → here, its own child span — THE
+                            # number the paper's routing path hides) +
+                            # routing, and any forward inside re-attaches
+                            # the trailer downstream.
+                            scope = tracing.continue_from_packet(
+                                packet, "dispatcher.route",
+                                dwell_name="dispatcher.queue_dwell")
+                            scope.args["msgtype"] = int(msgtype)
+                            scope.args["dispid"] = self.dispid
+                            records = self._record_count(msgtype, packet)
+                            if records is not None:
+                                scope.args["records"] = records
+                            with scope:
+                                self._handle(proxy, msgtype, packet)
+                        else:
+                            self._handle(proxy, msgtype, packet)
+                    except Exception:
+                        gwlog.trace_error(
+                            "dispatcher %d: error handling msgtype %s",
+                            self.dispid, msgtype)
+            finally:
+                for p in corked:
+                    try:
+                        p.uncork()
+                    except Exception:
+                        pass  # a dead link must not strand the others
+
+    @staticmethod
+    def _record_count(msgtype: int, packet: Packet) -> Optional[int]:
+        """Sync records carried by this packet (None for non-sync types) —
+        the ``records`` attribute on dispatcher.route spans."""
+        if msgtype == MsgType.SYNC_POSITION_YAW_FROM_CLIENT:
+            return packet.payload_len() // SYNC_RECORD_SIZE
+        if msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
+            return (packet.payload_len() - 2) // _CLIENT_SYNC_BLOCK
+        return None
 
     async def _tick_loop(self) -> None:
         while True:
@@ -568,20 +687,15 @@ class DispatcherService:
     def _handle(self, proxy: GoWorldConnection, msgtype: int, packet: Packet) -> None:
         if is_gate_redirect(msgtype):
             # Payload starts [u16 gateid][clientid...]; route on gateid
-            # (DispatcherService.go:841-844). A gate in its reconnect-grace
-            # window buffers; an unknown gateid drops (as the reference).
-            gateid = packet.read_uint16()
-            packet.set_read_pos(0)
-            gt = self.gates.get(gateid)
-            if gt is not None:
-                gt.dispatch(msgtype, packet, self._now())
+            # (DispatcherService.go:841-844).
+            self._route_to_gate(msgtype, packet)
             return
         if msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
-            gateid = packet.read_uint16()
-            packet.set_read_pos(0)
-            gt = self.gates.get(gateid)
-            if gt is not None:
-                gt.dispatch(msgtype, packet, self._now())
+            t0 = time.perf_counter()
+            self._sync_records_down.inc(
+                (packet.payload_len() - 2) // _CLIENT_SYNC_BLOCK)
+            self._route_to_gate(msgtype, packet)
+            _HOP_ROUTE.inc(time.perf_counter() - t0)
             return
         if msgtype == MsgType.CALL_FILTERED_CLIENTS:
             self._broadcast_gates(msgtype, packet)
@@ -592,6 +706,17 @@ class DispatcherService:
             gwlog.warnf("dispatcher %d: unhandled msgtype %s", self.dispid, msgtype)
             return
         handler(self, proxy, packet)
+
+    def _route_to_gate(self, msgtype: int, packet: Packet) -> None:
+        """Route a [u16 gateid]-prefixed packet, parsing the header ONCE:
+        forwarding serializes the whole payload regardless of the read
+        cursor, so the old read → set_read_pos(0) → re-parse dance was
+        two parses per redirect packet for nothing. A gate in its
+        reconnect-grace window buffers; an unknown gateid drops (as the
+        reference)."""
+        gt = self.gates.get(packet.read_uint16())
+        if gt is not None:
+            gt.dispatch(msgtype, packet, self._now())
 
     # --- handshakes ----------------------------------------------------------
 
@@ -845,14 +970,57 @@ class DispatcherService:
     # --- position sync aggregation (DispatcherService.go:786-824) -------------
 
     def _handle_sync_position_yaw_from_client(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Demux one packet of concatenated 32 B records per destination
+        game in ONE vectorized pass: a structured-array view over the
+        payload, routing-table lookups per UNIQUE entity (not per record),
+        and one boolean-mask ``tobytes`` per destination — the dispatcher's
+        cost scales with packets and distinct entities, not records.
+        Unknown / not-yet-routed entities drop, exactly like the legacy
+        per-record loop (the parity oracle in tests/test_dispatcher.py
+        pins batched == legacy on randomized streams); a trailing partial
+        record is ignored. Per-game aggregation buffers flush on the 5 ms
+        tick OR as soon as they exceed sync_flush_bytes, so a burst never
+        sits out a full tick."""
+        t0 = time.perf_counter()
         data = packet.payload
-        for off in range(0, len(data), SYNC_RECORD_SIZE):
-            record = data[off : off + SYNC_RECORD_SIZE]
-            eid = record[:16].decode("ascii")
-            info = self.entities.get(eid)
-            if info is None or info.gameid == 0:
-                continue
-            self._pending_syncs.setdefault(info.gameid, bytearray()).extend(record)
+        k = len(data) // SYNC_RECORD_SIZE
+        if not k:
+            return
+        self._sync_records_up.inc(k)
+        entities = self.entities
+        pending = self._pending_syncs
+        if k == 1:
+            info = entities.get(data[:16].decode("ascii"))
+            if info is not None and info.gameid:
+                buf = pending.setdefault(info.gameid, bytearray())
+                buf += data[:SYNC_RECORD_SIZE]
+                if self.sync_flush_bytes and len(buf) >= self.sync_flush_bytes:
+                    self._flush_pending_sync(info.gameid)
+            _HOP_ROUTE.inc(time.perf_counter() - t0)
+            return
+        arr = np.frombuffer(data, SYNC_DTYPE, count=k)
+        uniq, inv = np.unique(arr["eid"], return_inverse=True)
+        lut = np.empty(len(uniq), np.int32)
+        for j, eb in enumerate(uniq.tolist()):
+            info = entities.get(eb.decode("ascii"))
+            lut[j] = info.gameid if info is not None else 0
+        gameids = lut[inv]
+        for gid in np.unique(lut).tolist():
+            if gid == 0:
+                continue  # unknown/unrouted entities drop (legacy semantics)
+            buf = pending.setdefault(gid, bytearray())
+            buf += arr[gameids == gid].tobytes()
+            if self.sync_flush_bytes and len(buf) >= self.sync_flush_bytes:
+                self._flush_pending_sync(gid)
+        _HOP_ROUTE.inc(time.perf_counter() - t0)
+
+    def _flush_pending_sync(self, gameid: int) -> None:
+        """Size-triggered early flush of one game's aggregation buffer."""
+        buf = self._pending_syncs.pop(gameid, None)
+        if buf:
+            self._game(gameid).dispatch(
+                MsgType.SYNC_POSITION_YAW_FROM_CLIENT, Packet(bytes(buf)),
+                self._now())
 
     def _send_pending_syncs(self) -> None:
         if not self._pending_syncs:

@@ -28,9 +28,11 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import os
 import random
+import tempfile
 import time
-from typing import Callable, Deque, Optional, Sequence
+from typing import Callable, Deque, Optional, Sequence, Union
 
 from goworld_tpu import consts, telemetry
 from goworld_tpu.dispatchercluster import DispatcherClusterBase
@@ -39,6 +41,34 @@ from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import GoWorldConnection
 from goworld_tpu.proto.msgtypes import MsgType
 from goworld_tpu.utils import gwlog
+
+# A dispatcher endpoint: (host, port) for TCP, or a Unix-domain socket
+# path for the co-located uds transport ([cluster] transport = uds) —
+# same framing, handshakes, heartbeats, and replay rings either way.
+DispatcherAddr = Union[tuple, str]
+
+
+def uds_path_for(port: int, uds_dir: str = "") -> str:
+    """The Unix-socket path a dispatcher with TCP port ``port`` serves
+    beside its TCP listener when the uds transport is on. Derived from the
+    port (unique per dispatcher by config validation) so games/gates need
+    no extra per-dispatcher path configuration; ``uds_dir`` defaults to
+    the system temp dir (keep it SHORT — sun_path caps at ~108 bytes)."""
+    return os.path.join(
+        uds_dir or tempfile.gettempdir(), f"gwt-disp-{port}.sock")
+
+
+def dispatcher_addrs(cfg) -> list[DispatcherAddr]:
+    """The dispatcher endpoints a game/gate should dial, honoring
+    [cluster] transport: (host, port) tuples for tcp, socket paths for
+    uds (single-host deploys where every process is co-located — the
+    topology every bench and the chaos harness actually run)."""
+    addrs = [cfg.dispatchers[i].addr for i in sorted(cfg.dispatchers)]
+    c = getattr(cfg, "cluster", None)
+    if c is not None and getattr(c, "transport", "tcp") == "uds":
+        return [uds_path_for(port, c.uds_dir) for _, port in addrs]
+    return addrs
+
 
 # Delegate signature: (dispatcher_index, msgtype, packet) — must be fast/non-blocking.
 PacketHandler = Callable[[int, int, Packet], None]
@@ -138,7 +168,7 @@ class DispatcherConnMgr:
     def __init__(
         self,
         index: int,
-        addr: tuple[str, int],
+        addr: DispatcherAddr,
         handshake: Handshaker,
         on_packet: PacketHandler,
         on_disconnect: Optional[Callable[[int], None]] = None,
@@ -185,13 +215,25 @@ class DispatcherConnMgr:
         proxy = self.proxy
         return proxy if proxy is not None else self._buffer_sender
 
+    def _addr_str(self) -> str:
+        addr = self.addr
+        return addr if isinstance(addr, str) else f"{addr[0]}:{addr[1]}"
+
+    async def _open(self):
+        """Dial the dispatcher over whichever transport the address names
+        (uds paths and tcp tuples yield the same stream pair — everything
+        above this call is transport-blind)."""
+        if isinstance(self.addr, str):
+            return await asyncio.open_unix_connection(self.addr)
+        return await asyncio.open_connection(*self.addr)
+
     def link_state(self) -> dict:
         """One JSON-able row for /healthz: link up?, last-seen age,
         packets parked in the replay ring."""
         up = self.proxy is not None
         return {
             "index": self.index,
-            "addr": f"{self.addr[0]}:{self.addr[1]}",
+            "addr": self._addr_str(),
             "connected": up,
             "last_seen_age_s": (
                 round(time.monotonic() - self._last_recv, 3)
@@ -209,7 +251,7 @@ class DispatcherConnMgr:
             await asyncio.wait_for(self._connected_event.wait(), t)
         except asyncio.TimeoutError:
             raise TimeoutError(
-                f"dispatcher {self.index} at {self.addr[0]}:{self.addr[1]} "
+                f"dispatcher {self.index} at {self._addr_str()} "
                 f"not connected after {t:.1f}s (reconnect keeps retrying in "
                 f"the background)"
             ) from None
@@ -267,7 +309,7 @@ class DispatcherConnMgr:
         attempt = 0
         while not self._stopped:
             try:
-                reader, writer = await asyncio.open_connection(*self.addr)
+                reader, writer = await self._open()
             except OSError:
                 await asyncio.sleep(self._backoff_delay(attempt))
                 attempt += 1
@@ -364,7 +406,7 @@ class ClusterClient(DispatcherClusterBase):
 
     def __init__(
         self,
-        addrs: Sequence[tuple[str, int]],
+        addrs: Sequence[DispatcherAddr],
         handshake: Handshaker,
         on_packet: PacketHandler,
         on_disconnect: Optional[Callable[[int], None]] = None,

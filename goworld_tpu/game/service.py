@@ -27,6 +27,15 @@ from goworld_tpu.proto.msgtypes import MsgType
 from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import async_jobs, crontab, gwlog, gwutils, post
 
+# Sync fan-out per-hop attribution (shared family with the dispatcher's
+# dispatcher_route and the gate's gate_demux/client_write hops; bench.py
+# --fanout reads the deltas into per-hop shares).
+_HOP_GAME_PACK = telemetry.counter(
+    "fanout_hop_seconds_total",
+    "Busy wall seconds per sync fan-out hop "
+    "(game_pack|dispatcher_route|gate_demux|client_write).",
+    ("hop",)).labels("game_pack")
+
 # run states (GameService.go rsRunning/rsTerminating/rsFreezing...)
 RS_RUNNING = 0
 RS_TERMINATING = 1
@@ -171,11 +180,13 @@ class GameService:
         elif entity_manager.get_nil_space() is None:
             entity_manager.create_nil_space(self.gameid)
 
-        addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
-        from goworld_tpu.dispatchercluster.cluster import cluster_knobs
+        from goworld_tpu.dispatchercluster.cluster import (
+            cluster_knobs,
+            dispatcher_addrs,
+        )
 
         self.cluster = ClusterClient(
-            addrs, self._handshake, self._on_packet,
+            dispatcher_addrs(self.cfg), self._handshake, self._on_packet,
             self._on_dispatcher_disconnect, **cluster_knobs(self.cfg)
         )
         dispatchercluster.set_cluster(self.cluster)
@@ -504,12 +515,16 @@ class GameService:
     def _send_entity_sync_infos(self) -> None:
         """Push batched position syncs, one coalesced packet per gate
         (§3.3; records are packed in one vectorized pass per gate —
-        entity_manager.collect_entity_sync_infos)."""
+        entity_manager.collect_entity_sync_infos). Wall time lands on
+        fanout_hop_seconds_total{hop="game_pack"} — the first hop of the
+        per-hop breakdown bench.py --fanout reports."""
+        t0 = time.perf_counter()
         per_gate = entity_manager.collect_entity_sync_infos()
         for gateid, buf in per_gate.items():
             dispatchercluster.select_by_gate_id(gateid).send_sync_position_yaw_on_clients(
                 gateid, buf
             )
+        _HOP_GAME_PACK.inc(time.perf_counter() - t0)
 
     # --- packet handlers (GameService.go:92-157) ------------------------------
 

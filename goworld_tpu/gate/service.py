@@ -70,6 +70,18 @@ _CLIENT_KILLS = _telemetry.counter(
 _KILLS_HEARTBEAT = _CLIENT_KILLS.labels("heartbeat")
 _KILLS_ERROR = _CLIENT_KILLS.labels("error")
 
+# Sync fan-out per-hop attribution (shared family with game_pack and
+# dispatcher_route; bench.py --fanout reads the deltas into shares):
+# gate_demux = the argsort demux of one sync packet, client_write = the
+# end-of-batch uncork sweep that actually writes the corked client conns.
+_HOP_SECONDS = _telemetry.counter(
+    "fanout_hop_seconds_total",
+    "Busy wall seconds per sync fan-out hop "
+    "(game_pack|dispatcher_route|gate_demux|client_write).",
+    ("hop",))
+_HOP_GATE_DEMUX = _HOP_SECONDS.labels("gate_demux")
+_HOP_CLIENT_WRITE = _HOP_SECONDS.labels("client_write")
+
 
 class ClientProxy:
     """Server-side handle of one connected client (ClientProxy.go:39-52)."""
@@ -121,8 +133,14 @@ class GateService:
         self._queue: asyncio.Queue = asyncio.Queue()
         self._tasks: list[asyncio.Task] = []
         self._stopped = asyncio.Event()
-        # client→server sync coalescing: dispatcher index → 32 B records
+        # client→server sync coalescing: dispatcher index → 32 B records;
+        # a buffer reaching [cluster] sync_flush_bytes flushes immediately
+        # instead of waiting out position_sync_interval (0 = tick only).
         self._pending_syncs: dict[int, bytearray] = {}
+        ccfg = getattr(self.cfg, "cluster", None)
+        self._sync_flush_bytes = (
+            ccfg.sync_flush_bytes if ccfg is not None
+            else consts.DISPATCHER_SYNC_FLUSH_BYTES)
         # server→client write coalescing (tick-scoped): True while the
         # logic loop is inside one event batch; conns corked this batch.
         self._batch_active = False
@@ -147,11 +165,14 @@ class GateService:
         tcfg = getattr(self.cfg, "telemetry", None)
         if tcfg is not None:
             tracing.configure_from_config(tcfg)
-        addrs = [self.cfg.dispatchers[i].addr for i in sorted(self.cfg.dispatchers)]
-        from goworld_tpu.dispatchercluster.cluster import cluster_knobs
+        from goworld_tpu.dispatchercluster.cluster import (
+            cluster_knobs,
+            dispatcher_addrs,
+        )
 
         self.cluster = ClusterClient(
-            addrs, self._handshake, self._on_dispatcher_packet,
+            dispatcher_addrs(self.cfg), self._handshake,
+            self._on_dispatcher_packet,
             self._on_dispatcher_disconnect, **cluster_knobs(self.cfg)
         )
         self.cluster.start()
@@ -400,11 +421,14 @@ class GateService:
                                           self.gateid, kind, msgtype)
             finally:
                 self._batch_active = False
+                t0 = time.perf_counter()
                 for conn in self._corked_conns:
                     try:
                         conn.uncork()
                     except Exception:  # a dead conn must not strand others
                         pass
+                if self._corked_conns:
+                    _HOP_CLIENT_WRITE.inc(time.perf_counter() - t0)
                 self._corked_conns.clear()
 
     async def _tick_loop(self) -> None:
@@ -471,7 +495,16 @@ class GateService:
             record = packet.payload[:SYNC_RECORD_SIZE]
             eid = record[:16].decode("ascii")
             idx = hash_entity_id(eid) % max(1, self.cluster.count() if self.cluster else 1)
-            self._pending_syncs.setdefault(idx, bytearray()).extend(record)
+            buf = self._pending_syncs.setdefault(idx, bytearray())
+            buf += record
+            if (self._sync_flush_bytes
+                    and len(buf) >= self._sync_flush_bytes
+                    and self.cluster is not None):
+                # Size-triggered early flush: a burst never sits out the
+                # rest of position_sync_interval.
+                del self._pending_syncs[idx]
+                self.cluster.select(idx).send_sync_position_yaw_from_client(
+                    bytes(buf))
             return
         if msgtype == MsgType.CALL_ENTITY_METHOD_FROM_CLIENT:
             eid = packet.read_entity_id()
@@ -558,7 +591,11 @@ class GateService:
         (GateService.go:346-371) — vectorized: one structured-array view +
         one stable argsort groups the whole packet's blocks by clientid,
         then each client's record run leaves as a single contiguous
-        ``tobytes()`` instead of a per-block decode/append loop."""
+        ``tobytes()`` instead of a per-block decode/append loop. Wall time
+        lands on fanout_hop_seconds_total{hop="gate_demux"} (the corked
+        client writes themselves are costed under client_write at the
+        end-of-batch uncork sweep)."""
+        t0 = time.perf_counter()
         packet.read_uint16()  # gateid
         data = packet.read_rest()  # raw [clientid + record] blocks
         k = len(data) // _CLIENT_BLOCK_SIZE
@@ -570,6 +607,7 @@ class GateService:
             if cp is not None:
                 cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
                         arr["rec"].tobytes())
+            _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
             return
         order = np.argsort(arr["cid"], kind="stable")
         cid_s = arr["cid"][order]
@@ -583,6 +621,7 @@ class GateService:
             if cp is not None:
                 cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
                         rec_s[lo:hi].tobytes())
+        _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
 
     # --- filter props (FilterTree.go, GateService.go:300-344) ----------------
 

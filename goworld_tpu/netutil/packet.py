@@ -28,29 +28,51 @@ _F64 = struct.Struct("<d")
 
 
 class Packet:
-    """Append-only write + cursor read packet payload."""
+    """Append-only write + cursor read packet payload.
+
+    A packet constructed from ``bytes`` keeps that object as its buffer
+    without copying: the dominant packet population — received frames that
+    are only read and/or forwarded verbatim (the dispatcher's entire
+    routing plane) — then pays ZERO payload copies end to end, because
+    :attr:`payload` hands the same immutable object back out. The first
+    append (or trailer strip) transparently converts to a private
+    bytearray, so writers keep the exact legacy semantics."""
 
     __slots__ = ("_buf", "_rpos", "trace")
 
     def __init__(self, payload: bytes | bytearray | None = None) -> None:
-        self._buf = bytearray(payload) if payload else bytearray()
+        if payload is None or not len(payload):
+            self._buf: bytes | bytearray = bytearray()
+        elif type(payload) is bytes:
+            self._buf = payload  # zero-copy read/forward fast path
+        else:
+            self._buf = bytearray(payload)
         self._rpos = 0
         # TraceContext attached by the recv seam when the wire msgtype
         # carried the tracing-trailer flag (telemetry/tracing.py); None
         # for the overwhelming majority of packets.
         self.trace = None
 
+    def _wbuf(self) -> bytearray:
+        """The mutable buffer, converting a shared read-only one on the
+        first write (copy-on-write seam for the zero-copy constructor)."""
+        if type(self._buf) is not bytearray:
+            self._buf = bytearray(self._buf)
+        return self._buf
+
     def pop_tail(self, n: int) -> bytes:
         """Remove and return the last ``n`` payload bytes (trailer strip)."""
-        tail = bytes(self._buf[-n:])
-        del self._buf[-n:]
+        buf = self._wbuf()
+        tail = bytes(buf[-n:])
+        del buf[-n:]
         return tail
 
     # --- lifecycle ---------------------------------------------------------
 
     @property
     def payload(self) -> bytes:
-        return bytes(self._buf)
+        buf = self._buf
+        return buf if type(buf) is bytes else bytes(buf)
 
     def payload_len(self) -> int:
         return len(self._buf)
@@ -64,39 +86,39 @@ class Packet:
     # --- append ------------------------------------------------------------
 
     def append_byte(self, v: int) -> "Packet":
-        self._buf.append(v & 0xFF)
+        self._wbuf().append(v & 0xFF)
         return self
 
     def append_bool(self, v: bool) -> "Packet":
         return self.append_byte(1 if v else 0)
 
     def append_uint16(self, v: int) -> "Packet":
-        self._buf += _U16.pack(v)
+        self._wbuf().extend(_U16.pack(v))
         return self
 
     def append_uint32(self, v: int) -> "Packet":
-        self._buf += _U32.pack(v)
+        self._wbuf().extend(_U32.pack(v))
         return self
 
     def append_uint64(self, v: int) -> "Packet":
-        self._buf += _U64.pack(v)
+        self._wbuf().extend(_U64.pack(v))
         return self
 
     def append_float32(self, v: float) -> "Packet":
-        self._buf += _F32.pack(v)
+        self._wbuf().extend(_F32.pack(v))
         return self
 
     def append_float64(self, v: float) -> "Packet":
-        self._buf += _F64.pack(v)
+        self._wbuf().extend(_F64.pack(v))
         return self
 
     def append_bytes(self, v: bytes) -> "Packet":
-        self._buf += v
+        self._wbuf().extend(v)
         return self
 
     def append_varbytes(self, v: bytes) -> "Packet":
         self.append_uint32(len(v))
-        self._buf += v
+        self._wbuf().extend(v)
         return self
 
     def append_varstr(self, v: str) -> "Packet":
@@ -106,7 +128,7 @@ class Packet:
         b = eid.encode("ascii")
         if len(b) != ENTITYID_LENGTH:
             raise ValueError(f"bad entity id {eid!r}")
-        self._buf += b
+        self._wbuf().extend(b)
         return self
 
     def append_client_id(self, cid: str) -> "Packet":

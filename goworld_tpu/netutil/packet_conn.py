@@ -19,12 +19,17 @@ from __future__ import annotations
 
 import asyncio
 import collections
+import struct
 
 from goworld_tpu import consts, native
 from goworld_tpu.netutil.packet import Packet
 
 _COMPRESS_THRESHOLD = 256  # don't deflate tiny packets (heartbeats, syncs)
 _RECV_CHUNK = 65536
+# Frame header for the uncompressed scatter path: [u32 body_len][u16
+# msgtype]. Must stay byte-identical to native.pack's framing (body_len
+# counts the msgtype's 2 bytes; no compression flag bits set).
+_FRAME_HDR = struct.Struct("<IH")
 
 # Packets that rode an existing corked batch instead of paying their own
 # transport write (gate tick-scoped coalescing; one series process-wide —
@@ -36,6 +41,11 @@ _COALESCED = _telemetry.counter(
     "net_coalesced_packets_total",
     "Packets flushed as part of a multi-packet corked batch (all but the "
     "first of each batch): writes saved by tick-scoped write coalescing.",
+)
+_WRITEV = _telemetry.counter(
+    "net_writev_batches_total",
+    "Multi-buffer flushes handed to the transport as a scatter list "
+    "(writelines) instead of being joined into one copy first.",
 )
 
 
@@ -69,7 +79,12 @@ class PacketConnection:
         self._reader = reader
         self._writer = writer
         self._flush_interval = flush_interval
+        # Scatter list of wire buffers awaiting flush. The uncompressed
+        # send path appends TWO entries per packet — a 6-byte frame header
+        # and the payload object itself (zero-copy) — so _pending_count
+        # tracks packets separately from buffers.
         self._pending: list[bytes] = []
+        self._pending_count = 0
         self._flush_task: asyncio.Task | None = None
         self._corked = False  # tick-scoped write coalescing (cork/uncork)
         self._closed = False
@@ -116,11 +131,27 @@ class PacketConnection:
         if self._closed:
             self.dropped += 1
             return
-        buf = native.pack(
-            msgtype, packet.payload, self._compress,
-            _COMPRESS_THRESHOLD, consts.MAX_PACKET_SIZE,
-        )
-        self._pending.append(buf)
+        payload = packet.payload
+        body_len = len(payload) + 2
+        if self._compress and body_len >= _COMPRESS_THRESHOLD:
+            # Compression candidates take the codec path (one packed buf).
+            self._pending.append(native.pack(
+                msgtype, payload, self._compress,
+                _COMPRESS_THRESHOLD, consts.MAX_PACKET_SIZE,
+            ))
+        else:
+            # Scatter framing: header + payload as separate buffers — the
+            # payload (already an immutable bytes on the forward path) is
+            # never copied into a framed buffer; flush() hands the whole
+            # scatter list to the transport.
+            if body_len > consts.MAX_PACKET_SIZE:
+                raise ValueError(f"packet too large: {body_len}")
+            if not 0 <= msgtype <= 0xFFFF:
+                raise ValueError(f"msgtype {msgtype} out of u16 range")
+            self._pending.append(_FRAME_HDR.pack(body_len, msgtype))
+            if payload:
+                self._pending.append(payload)
+        self._pending_count += 1
         self.sent_packets += 1
         if self._corked:
             return  # uncork() flushes the whole tick's scatter list at once
@@ -141,7 +172,7 @@ class PacketConnection:
     def uncork(self) -> None:
         """Re-enable flushing and write the coalesced batch out now."""
         self._corked = False
-        n = len(self._pending)
+        n = self._pending_count
         if n > 1:
             _COALESCED.inc(n - 1)
         self.flush()
@@ -154,10 +185,20 @@ class PacketConnection:
     def flush(self) -> None:
         if self._closed or not self._pending:
             return
-        data = b"".join(self._pending)
-        self._pending.clear()
+        pending = self._pending
+        self._pending = []
+        self._pending_count = 0
         try:
-            self._writer.write(data)
+            if len(pending) == 1:
+                self._writer.write(pending[0])
+            else:
+                # Scatter-gather: the transport takes the buffer list as is
+                # (writev-style; on interpreters whose transport implements
+                # writelines via sendmsg this is zero-copy end to end, and
+                # even the fallback join happens ONCE at the lowest layer
+                # instead of once here and once there).
+                _WRITEV.inc()
+                self._writer.writelines(pending)
         except Exception:
             self._closed = True
 

@@ -134,7 +134,7 @@ class GoWorldConnection:
 
     def send(self, msgtype: int, packet: Packet) -> None:
         _PKT_OUT.inc()
-        _BYTES_OUT.inc(len(packet.payload))
+        _BYTES_OUT.inc(packet.payload_len())
         if self.trace_wire:
             ctx = self._trace_ctx(packet.trace)
             if ctx is not None:
@@ -161,7 +161,7 @@ class GoWorldConnection:
     async def recv(self):
         msgtype, packet = await self.conn.recv_packet()
         _PKT_IN.inc()
-        _BYTES_IN.inc(len(packet.payload))
+        _BYTES_IN.inc(packet.payload_len())
         if msgtype & MSGTYPE_TRACE_FLAG:
             msgtype &= ~MSGTYPE_TRACE_FLAG
             if packet.payload_len() >= _tracing.TRAILER_SIZE:

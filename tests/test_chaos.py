@@ -59,6 +59,18 @@ def test_dispatcher_kill_restart_smoke(tmp_path):
     assert r["recovery_s"] < 10.0
 
 
+def test_dispatcher_kill_restart_smoke_uds(tmp_path):
+    """ISSUE 6 tier-1 UDS smoke: the SAME kill+restart scenario over the
+    uds cluster transport — crash, ring replay over the re-dialed unix
+    socket, recovery — must behave identically to TCP (zero bot errors,
+    zero drops, mid-outage pings delivered)."""
+    r = _run(scenario_dispatcher_restart, run_dir=str(tmp_path),
+             transport="uds")
+    assert r["bot_errors"] == 0
+    assert r["dropped"] == 0
+    assert r["recovery_s"] < 10.0
+
+
 def test_severed_link_recovers(tmp_path):
     """A game↔dispatcher socket aborted mid-tick (RST, not clean close)
     reconnects and replays within the deadline."""

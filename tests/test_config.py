@@ -67,6 +67,9 @@ SAMPLE = textwrap.dedent(
     peer_heartbeat_timeout = 6
     wait_connected_timeout = 20
     reconnect_max_interval = 8
+    transport = uds
+    uds_dir = /tmp/gwt-test-uds
+    sync_flush_bytes = 65536
     """
 )
 
@@ -175,6 +178,29 @@ def test_cluster_and_storage_resilience_knobs(cfg):
     assert cfg.storage.deferred_bytes_cap == 1048576
 
 
+def test_cluster_transport_and_flush_knobs(cfg):
+    """[cluster] transport/uds_dir/sync_flush_bytes parse (ISSUE 6), and
+    dispatcher_addrs resolves socket paths from the configured ports."""
+    from goworld_tpu.dispatchercluster.cluster import (
+        dispatcher_addrs,
+        uds_path_for,
+    )
+
+    assert cfg.cluster.transport == "uds"
+    assert cfg.cluster.uds_dir == "/tmp/gwt-test-uds"
+    assert cfg.cluster.sync_flush_bytes == 65536
+    addrs = dispatcher_addrs(cfg)
+    assert addrs == [
+        uds_path_for(d.port, "/tmp/gwt-test-uds")
+        for _, d in sorted(cfg.dispatchers.items())
+    ]
+    assert all(isinstance(a, str) and a.endswith(".sock") for a in addrs)
+    # tcp (the default) keeps plain (host, port) tuples.
+    cfg.cluster.transport = "tcp"
+    assert dispatcher_addrs(cfg) == [
+        d.addr for _, d in sorted(cfg.dispatchers.items())]
+
+
 def test_cluster_knob_validation(tmp_path):
     """Nonsense resilience knobs fail loudly at load, not at 3 am."""
     for old, bad in (
@@ -182,6 +208,8 @@ def test_cluster_knob_validation(tmp_path):
         ("down_buffer_bytes = 4194304", "down_buffer_bytes = -1"),
         ("circuit_failure_threshold = 4", "circuit_failure_threshold = 0"),
         ("retry_max_interval = 20", "retry_max_interval = 0.1"),
+        ("transport = uds", "transport = shm"),
+        ("sync_flush_bytes = 65536", "sync_flush_bytes = -1"),
     ):
         assert old in SAMPLE
         p = tmp_path / "bad.ini"

@@ -573,3 +573,256 @@ def test_game_pending_queue_bound_while_frozen(monkeypatch):
         await _teardown(disp, c1b, c2, cg)
 
     asyncio.run(run())
+
+
+# --- batch-routed fan-out path (ISSUE 6) -------------------------------------
+
+
+def _legacy_sync_demux(entities, data: bytes) -> dict[int, bytes]:
+    """The pre-ISSUE-6 per-record routing loop, verbatim (the oracle the
+    vectorized demux must match): slice 32 B at a time, look up each
+    record's entity, skip unknown/unrouted, append per target game."""
+    from goworld_tpu.proto.conn import SYNC_RECORD_SIZE
+
+    pending: dict[int, bytearray] = {}
+    for off in range(0, (len(data) // SYNC_RECORD_SIZE) * SYNC_RECORD_SIZE,
+                     SYNC_RECORD_SIZE):
+        record = data[off:off + SYNC_RECORD_SIZE]
+        eid = record[:16].decode("ascii")
+        info = entities.get(eid)
+        if info is None or info.gameid == 0:
+            continue
+        pending.setdefault(info.gameid, bytearray()).extend(record)
+    return {gid: bytes(buf) for gid, buf in pending.items()}
+
+
+def test_sync_demux_parity_oracle():
+    """Parity oracle (ISSUE 6 satellite): the vectorized structured-array
+    demux in _handle_sync_position_yaw_from_client must produce exactly
+    the legacy per-record loop's per-game buffers — same bytes, same
+    order, same unknown/unrouted drops — on randomized record streams
+    (duplicate eids, interleaved destinations, unknown entities)."""
+    import random
+
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    rng = random.Random(0xF0)
+
+    async def run():
+        svc = DispatcherService(71, sync_flush_bytes=0)  # tick-only flush
+        routed = [gen_entity_id() for _ in range(40)]
+        for i, eid in enumerate(routed):
+            svc._entity(eid).gameid = (1, 2, 3, 7)[i % 4]
+        unrouted = [gen_entity_id() for _ in range(6)]
+        for eid in unrouted:
+            svc._entity(eid).gameid = 0  # known but not yet routed
+        unknown = [gen_entity_id() for _ in range(6)]
+        pool = routed + unrouted + unknown
+        for _trial in range(25):
+            k = rng.randrange(1, 120)
+            stream = b"".join(
+                pack_sync_record(rng.choice(pool), rng.random(),
+                                 rng.random(), rng.random(), rng.random())
+                for _ in range(k))
+            expected = _legacy_sync_demux(svc.entities, stream)
+            svc._pending_syncs.clear()
+            svc._handle_sync_position_yaw_from_client(None, Packet(stream))
+            got = {gid: bytes(buf)
+                   for gid, buf in svc._pending_syncs.items()}
+            assert got == expected, f"demux diverged at k={k}"
+
+    asyncio.run(run())
+
+
+def test_sync_demux_partial_tail_ignored():
+    """A trailing partial record (malformed sender) is dropped whole —
+    never forwarded as a truncated record."""
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    async def run():
+        svc = DispatcherService(72, sync_flush_bytes=0)
+        eid = gen_entity_id()
+        svc._entity(eid).gameid = 1
+        stream = pack_sync_record(eid, 1, 2, 3, 4) + b"\x00" * 7
+        svc._handle_sync_position_yaw_from_client(None, Packet(stream))
+        assert bytes(svc._pending_syncs[1]) == stream[:32]
+
+    asyncio.run(run())
+
+
+class _RecordingProxy:
+    """Minimal connected GoWorldConnection stand-in for routing tests."""
+
+    closed = False
+
+    def __init__(self):
+        self.sent = []
+        self.corks = 0
+        self.uncorks = 0
+
+    def send(self, msgtype, packet):
+        self.sent.append((int(msgtype), packet.payload))
+
+    def cork(self):
+        self.corks += 1
+
+    def uncork(self):
+        self.uncorks += 1
+
+    def close(self):
+        self.closed = True
+
+
+def test_sync_demux_size_triggered_flush():
+    """A burst that fills a game's aggregation buffer past
+    sync_flush_bytes flushes to that game IMMEDIATELY instead of waiting
+    out the 5 ms tick (ISSUE 6: a burst never sits a full tick)."""
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    async def run():
+        svc = DispatcherService(73, sync_flush_bytes=128)  # 4 records
+        proxy = _RecordingProxy()
+        svc._game(1).proxy = proxy
+        eid = gen_entity_id()
+        svc._entity(eid).gameid = 1
+        stream = b"".join(
+            pack_sync_record(eid, i, 0, 0, 0) for i in range(5))
+        svc._handle_sync_position_yaw_from_client(None, Packet(stream))
+        # 5 records (160 B) >= 128 B trigger: flushed NOW, buffer cleared.
+        assert [mt for mt, _ in proxy.sent] == [
+            int(MsgType.SYNC_POSITION_YAW_FROM_CLIENT)]
+        assert proxy.sent[0][1] == stream
+        assert 1 not in svc._pending_syncs
+        # Below the trigger: aggregates for the tick flush, nothing sent.
+        small = pack_sync_record(eid, 9, 0, 0, 0)
+        svc._handle_sync_position_yaw_from_client(None, Packet(small))
+        assert len(proxy.sent) == 1
+        assert bytes(svc._pending_syncs[1]) == small
+
+    asyncio.run(run())
+
+
+def test_redirect_routing_drop_and_grace_buffer_mid_batch():
+    """Gate-redirect routing through the REAL batched logic loop: the
+    gateid header is parsed once (no re-parse round trip), an unknown
+    gateid drops, and a gate whose link dies MID-BATCH buffers the rest
+    of the batch in its reconnect-grace window — with the batch's cork/
+    uncork sweep surviving the dead link."""
+
+    async def run():
+        svc = DispatcherService(74)
+        proxy = _RecordingProxy()
+        gt = svc._gate(3)
+        gt.proxy = proxy
+        svc._proxy_gates[proxy] = 3
+
+        def redirect(gateid, label):
+            p = Packet()
+            p.append_uint16(gateid)
+            p.append_client_id(gen_client_id())
+            p.append_bytes(label)
+            return p
+
+        task = asyncio.get_running_loop().create_task(svc._logic_loop())
+        # One batch: deliver, unknown-drop, link death, then two more
+        # packets that must land in the reconnect-grace buffer.
+        svc._queue.put_nowait((None, MsgType.CALL_ENTITY_METHOD_ON_CLIENT,
+                               redirect(3, b"live")))
+        svc._queue.put_nowait((None, MsgType.CALL_ENTITY_METHOD_ON_CLIENT,
+                               redirect(9, b"unknown-gate")))
+        svc._queue.put_nowait((proxy, -1, None))  # disconnect sentinel
+        svc._queue.put_nowait((None, MsgType.CALL_ENTITY_METHOD_ON_CLIENT,
+                               redirect(3, b"graced-1")))
+        svc._queue.put_nowait((None, MsgType.CALL_ENTITY_METHOD_ON_CLIENT,
+                               redirect(3, b"graced-2")))
+        for _ in range(100):
+            if len(gt.pending) == 2:
+                break
+            await asyncio.sleep(0.01)
+        task.cancel()
+        assert [payload[18:] for _, payload in proxy.sent] == [b"live"]
+        assert [p.payload[18:] for _, p in gt.pending] == [
+            b"graced-1", b"graced-2"]
+        import time
+
+        assert gt.blocked(time.monotonic())
+        # The batch corked the then-connected gate link and uncorked it
+        # even though the link died mid-batch.
+        assert proxy.corks == 1 and proxy.uncorks == 1
+
+    asyncio.run(run())
+
+
+# --- uds cluster transport (ISSUE 6) -----------------------------------------
+
+
+def test_uds_transport_end_to_end_and_reconnect_replay(tmp_path):
+    """[cluster] transport = uds smoke: the dispatcher serves a Unix-
+    domain listener beside TCP, a gate/game cluster dials the socket path,
+    the handshake + entity routing work unchanged, and a dispatcher
+    restart REPLAYS ring-buffered sends over the re-dialed socket exactly
+    like TCP (same framing, same replay rings)."""
+    from goworld_tpu.chaos import dropped_packet_count
+    from goworld_tpu.dispatchercluster.cluster import uds_path_for
+
+    uds_dir = str(tmp_path)
+
+    async def run():
+        disp = DispatcherService(1, desired_games=1, desired_gates=0)
+        await disp.start(uds_dir=uds_dir)
+        assert disp.uds_path == uds_path_for(disp.port, uds_dir)
+        port = disp.port
+        eid = gen_entity_id()
+        game1 = FakePeer()
+        c1 = make_game_cluster(disp.uds_path, 1, game1, entity_ids=[eid])
+        c1.start()
+        await c1.wait_connected()
+        ack = await game1.expect(MsgType.SET_GAME_ID_ACK)
+        assert ack.read_data()["online_games"] == [1]
+        # Route an RPC over the unix socket.
+        c1.select(0).send_call_entity_method(eid, "OverUds", ())
+        pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD)
+        assert pkt.read_entity_id() == eid
+        drops0 = dropped_packet_count()
+
+        import os
+
+        await disp.stop()
+        assert not os.path.exists(disp.uds_path)
+        await asyncio.sleep(0.1)
+        for i in range(3):
+            c1.select(0).send_call_entity_method(eid, f"Buffered{i}", ())
+        assert len(c1._mgrs[0].ring) >= 3
+
+        disp2 = DispatcherService(1, desired_games=1, desired_gates=0)
+        for _ in range(50):
+            try:
+                await disp2.start(port=port, uds_dir=uds_dir)
+                break
+            except OSError:
+                await asyncio.sleep(0.1)
+        await game1.expect(MsgType.SET_GAME_ID_ACK, timeout=10)
+        names = []
+        for _ in range(3):
+            pkt = await game1.expect(MsgType.CALL_ENTITY_METHOD, timeout=10)
+            assert pkt.read_entity_id() == eid
+            names.append(pkt.read_varstr())
+        assert names == [f"Buffered{i}" for i in range(3)]
+        assert dropped_packet_count() == drops0
+        await _teardown(disp2, c1)
+
+    asyncio.run(run())
+
+
+def test_route_span_record_count():
+    """dispatcher.route spans carry a ``records`` attribute for sync
+    packets (records-per-packet amortization on /trace); non-sync types
+    carry none."""
+    up = Packet(b"x" * (2 * 32))  # two 32 B client->server records
+    assert DispatcherService._record_count(
+        MsgType.SYNC_POSITION_YAW_FROM_CLIENT, up) == 2
+    down = Packet(b"\x01\x00" + b"y" * (3 * 48))  # gateid + three blocks
+    assert DispatcherService._record_count(
+        MsgType.SYNC_POSITION_YAW_ON_CLIENTS, down) == 3
+    assert DispatcherService._record_count(
+        MsgType.CALL_ENTITY_METHOD, up) is None

@@ -267,7 +267,7 @@ def test_cork_uncork_coalesces_writes():
         for i in range(3):
             conn.send_packet(10 + i, Packet(b"p%d" % i))
         assert conn._flush_task is None  # corked: no per-send flush task
-        assert len(conn._pending) == 3
+        assert conn._pending_count == 3  # scatter list: header+payload each
         conn.uncork()
         assert conn._pending == []
         assert coalesced.value == base + 2  # 3 packets, 1 write: 2 saved
@@ -285,3 +285,93 @@ def test_cork_uncork_coalesces_writes():
         await server.wait_closed()
 
     asyncio.run(run())
+
+
+# --- scatter-gather framing + zero-copy Packet (ISSUE 6) ---------------------
+
+
+def test_packet_zero_copy_and_copy_on_write():
+    """A Packet built from bytes keeps the object (payload hands the SAME
+    object back — the dispatcher forward path pays zero payload copies);
+    the first append converts to a private bytearray without corrupting
+    the shared source."""
+    src = b"\x01\x02payload-bytes"
+    p = Packet(src)
+    assert p.payload is src  # zero-copy in AND out
+    assert p.read_uint16() == 0x0201  # reads never convert
+    assert p.payload is src
+    p.append_byte(0xFF)  # first write: copy-on-write conversion
+    assert src == b"\x01\x02payload-bytes"  # source untouched
+    assert p.payload == src + b"\xff"
+    # pop_tail (trace-trailer strip) also converts safely.
+    q = Packet(src)
+    assert q.pop_tail(5) == b"bytes"
+    assert src == b"\x01\x02payload-bytes"
+    assert q.payload == src[:-5]
+
+
+def test_scatter_framing_wire_identical_to_native_pack():
+    """The uncompressed send path frames as [hdr][payload] scatter pieces;
+    the bytes on the wire must be identical to native.pack's single
+    buffer (the recv seam and every older peer depend on it)."""
+    from goworld_tpu import consts, native
+
+    class _W:
+        def __init__(self):
+            self.chunks = []
+
+        def write(self, data):
+            self.chunks.append(bytes(data))
+
+        def writelines(self, bufs):
+            self.chunks.extend(bytes(b) for b in bufs)
+
+    for payload in (b"", b"x", b"hello world" * 10):
+        conn = PacketConnection.__new__(PacketConnection)
+        conn.__init__(None, _W())
+        conn.cork()  # no event loop here: skip the flush-task path
+        conn.send_packet(42, Packet(payload))
+        conn.uncork()
+        wire = b"".join(conn._writer.chunks)
+        assert wire == native.pack(
+            42, payload, 0, 256, consts.MAX_PACKET_SIZE)
+    # Oversize and msgtype-range rejection match native.pack's contract.
+    conn = PacketConnection.__new__(PacketConnection)
+    conn.__init__(None, _W())
+    conn.cork()
+    with pytest.raises(ValueError):
+        conn.send_packet(1, Packet(b"x" * (26 * 1024 * 1024)))
+    with pytest.raises(ValueError):
+        conn.send_packet(0x10000, Packet(b"x"))
+
+
+def test_flush_hands_scatter_list_to_transport():
+    """A multi-packet flush passes the buffer list to the transport in
+    ONE writelines call (no join at this layer) and counts the batch on
+    net_writev_batches_total; a single-buffer flush stays a plain write."""
+    from goworld_tpu import telemetry
+
+    writev = telemetry.counter("net_writev_batches_total")
+
+    class _W:
+        def __init__(self):
+            self.writes = 0
+            self.writelines_calls = []
+
+        def write(self, data):
+            self.writes += 1
+
+        def writelines(self, bufs):
+            self.writelines_calls.append(list(bufs))
+
+    conn = PacketConnection.__new__(PacketConnection)
+    conn.__init__(None, _W())
+    base = writev.value
+    conn.cork()
+    for i in range(3):
+        conn.send_packet(i + 1, Packet(b"p%d" % i))
+    conn.uncork()
+    assert conn._writer.writes == 0
+    assert len(conn._writer.writelines_calls) == 1
+    assert len(conn._writer.writelines_calls[0]) == 6  # hdr+payload x3
+    assert writev.value - base == 1
