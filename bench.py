@@ -468,8 +468,12 @@ FANOUT_MULTI_CONFIG = {
 }
 
 # The fan-out pipeline's per-hop attribution counters (created by the
-# game/dispatcher/gate services; see fanout_hop_seconds_total).
-FANOUT_HOPS = ("game_pack", "dispatcher_route", "gate_demux", "client_write")
+# game/dispatcher/gate services; see fanout_hop_seconds_total). The game
+# side is split into collect (slab flag scan + interest-edge gather) and
+# pack (per-gate structured-array build + wire bytes) so the columnar-ECS
+# win — and any residual Python cost — is attributable per sub-stage.
+FANOUT_HOPS = ("game_collect", "game_pack", "game_send",
+               "dispatcher_route", "gate_demux", "client_write")
 
 
 def _hop_seconds() -> dict[str, float]:
@@ -537,6 +541,14 @@ def bench_fanout(trace_sample_rate: int | None = None,
                     holder["arena"] = self
 
         class FanAvatar(Entity):
+            # Movement is driven by the columnar per-class tick hook: ONE
+            # on_tick_batch call per game tick jitters EVERY avatar's x in
+            # a single vectorized write (replacing the per-entity
+            # set_position loop the bench used to run as a side task), so
+            # the measured fan-out includes the slab-backed behavior path.
+            _accum = 0.0
+            _phase = 0
+
             @classmethod
             def describe_entity_type(cls, desc):
                 desc.set_use_aoi(True, c["aoi_distance"])
@@ -549,6 +561,23 @@ def bench_fanout(trace_sample_rate: int | None = None,
                     x = 3.0 * holder["joined"]
                     holder["joined"] += 1
                     self.enter_space(arena.id, Vector3(x, 0.0, 10.0))
+
+            @classmethod
+            def on_tick_batch(cls, view):
+                cls._accum += view.dt
+                if cls._accum < c["sync_interval"]:
+                    return
+                # Carry the residual (capped) so a loop iteration landing
+                # late doesn't stretch the average movement cadence.
+                cls._accum = min(cls._accum - c["sync_interval"],
+                                 c["sync_interval"])
+                cls._phase ^= 1
+                # Avatars sit at x = 3*i (+0.5 on odd phases): jitter in
+                # place without leaving the shared AOI neighborhood.
+                import numpy as _np
+
+                x = _np.floor(view.x) + (0.5 if cls._phase else 0.0)
+                view.set_position_yaw(x=x)
 
         class Bot:
             def __init__(self) -> None:
@@ -630,35 +659,20 @@ def bench_fanout(trace_sample_rate: int | None = None,
                     break
                 await asyncio.sleep(0.01)
             assert satur(), "bots never reached full mutual AOI interest"
-            avatars = [e for e in em.entities().values()
-                       if e.typename == "FanAvatar"]
-
-            async def mover() -> None:
-                # Jitter every avatar each sync interval WITHOUT leaving
-                # the shared AOI neighborhood: every record fans N wide.
-                tick = 0
-                while True:
-                    for i, a in enumerate(avatars):
-                        a.set_position(Vector3(
-                            3.0 * i + (0.5 if tick & 1 else 0.0), 0.0, 10.0))
-                    tick += 1
-                    await asyncio.sleep(c["sync_interval"])
-
-            mv = asyncio.get_running_loop().create_task(mover())
+            # Movement runs inside the game loop via FanAvatar.on_tick_batch
+            # (the slab-backed per-class tick hook) — no side task needed.
+            slab_entities = em.runtime.slabs.used
             rates = []
-            try:
-                await asyncio.sleep(0.5)  # settle: first packets in flight
-                hops0 = _hop_seconds()
-                for _ in range(c["windows"]):
-                    base = sum(b.records for b in bots)
-                    t0 = time.perf_counter()
-                    await asyncio.sleep(c["measure_s"])
-                    dt = time.perf_counter() - t0
-                    rates.append(
-                        (sum(b.records for b in bots) - base) / dt)
-                hops1 = _hop_seconds()
-            finally:
-                mv.cancel()
+            await asyncio.sleep(0.5)  # settle: first packets in flight
+            hops0 = _hop_seconds()
+            for _ in range(c["windows"]):
+                base = sum(b.records for b in bots)
+                t0 = time.perf_counter()
+                await asyncio.sleep(c["measure_s"])
+                dt = time.perf_counter() - t0
+                rates.append(
+                    (sum(b.records for b in bots) - base) / dt)
+            hops1 = _hop_seconds()
             hop_ms = {h: round((hops1[h] - hops0[h]) * 1000.0, 2)
                       for h in FANOUT_HOPS}
             total = sum(hop_ms.values()) or 1.0
@@ -666,6 +680,11 @@ def bench_fanout(trace_sample_rate: int | None = None,
                 "hop_busy_ms": hop_ms,
                 "hop_shares": {h: round(v / total, 3)
                                for h, v in hop_ms.items()},
+                # Which sync path was measured (floor re-baselines record
+                # this): slab = the columnar collect over this many live
+                # slab slots.
+                "sync_path": "slab",
+                "slab_entities": int(slab_entities),
             }
             return rates, hops
         finally:
@@ -1082,11 +1101,21 @@ def update_floor(allow_lower: bool = False) -> int:
         for _ in range(2):
             r = fn()
             vals.append(r["value"])
-            print(json.dumps({"floor": key, "measured": r["value"],
-                              "runs": r["runs"]}, separators=(",", ":")))
+            line = {"floor": key, "measured": r["value"],
+                    "runs": r["runs"]}
+            if "sync_path" in r:
+                # Record WHICH entity path produced the number (slab vs
+                # legacy) and how many slab slots were live — a floor
+                # re-baseline must be attributable to its code path.
+                line["sync_path"] = r["sync_path"]
+                line["slab_entities"] = r["slab_entities"]
+            print(json.dumps(line, separators=(",", ":")))
         measured = min(vals)
         entry = spec.setdefault(key, {
             "metric": r["metric"], "tolerance": 0.25, "unit": r["unit"]})
+        if "sync_path" in r:
+            entry["sync_path"] = r["sync_path"]
+            entry["slab_entities"] = r["slab_entities"]
         old = entry.get("floor")
         if old is not None and measured < old and not allow_lower:
             kept[key] = old

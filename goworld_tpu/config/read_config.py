@@ -207,6 +207,18 @@ class AOIConfig:
 
 
 @dataclasses.dataclass
+class EntityConfig:
+    """Columnar entity-slab knobs (``[entity]``; entity/slabs.py)."""
+
+    # Initial slot capacity of the per-process entity slab store. The
+    # store doubles on demand, so this is purely a pre-sizing knob: set it
+    # near the expected steady-state entity count to avoid growth
+    # reallocation (and, with the batched AOI backend, early engine tier
+    # jumps) during login storms.
+    slab_initial: int = 256
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Distributed-tracing / flight-recorder knobs (``[telemetry]``;
     defaults mirror consts.py — telemetry/tracing.py)."""
@@ -247,6 +259,7 @@ class GoWorldConfig:
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     kvdb: KVDBConfig = dataclasses.field(default_factory=KVDBConfig)
     aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
+    entity: EntityConfig = dataclasses.field(default_factory=EntityConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
@@ -422,6 +435,10 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             transport=s.get("transport", "tcp").strip().lower(),
             uds_dir=s.get("uds_dir", "").strip(),
             sync_flush_bytes=int(s.get("sync_flush_bytes", 32 * 1024)),
+        )
+    if cp.has_section("entity"):
+        cfg.entity = EntityConfig(
+            slab_initial=int(cp["entity"].get("slab_initial", 256)),
         )
     if cp.has_section("telemetry"):
         s = cp["telemetry"]

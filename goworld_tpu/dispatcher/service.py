@@ -54,8 +54,8 @@ _SYNC_RECORDS = telemetry.counter(
 # deltas of these into the per-hop shares in its headline JSON.
 _HOP_SECONDS = telemetry.counter(
     "fanout_hop_seconds_total",
-    "Busy wall seconds per sync fan-out hop "
-    "(game_pack|dispatcher_route|gate_demux|client_write).",
+    "Busy wall seconds per sync fan-out hop (game_collect|game_pack|"
+    "game_send|dispatcher_route|gate_demux|client_write).",
     ("hop",))
 _HOP_ROUTE = _HOP_SECONDS.labels("dispatcher_route")
 

@@ -6,6 +6,11 @@ Reference parity: ``engine/entity`` (SURVEY.md §2.1, §2.6).
 
 from goworld_tpu.entity.attrs import MapAttr, ListAttr
 from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.slabs import (
+    EntitySlabs,
+    SlabTickView,
+    vmapped_position_tick,
+)
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.entity.entity_manager import (
@@ -38,6 +43,9 @@ __all__ = [
     "MapAttr",
     "ListAttr",
     "Entity",
+    "EntitySlabs",
+    "SlabTickView",
+    "vmapped_position_tick",
     "Space",
     "Vector3",
     "register_entity",

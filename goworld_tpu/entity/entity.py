@@ -25,13 +25,15 @@ from goworld_tpu.entity.attrs import (
     MapAttr,
 )
 from goworld_tpu.entity.game_client import GameClient
+# sync-info flags (Entity.go sifSyncOwnClient / sifSyncNeighborClients) —
+# defined beside the columnar flag slab they index, re-exported here.
+from goworld_tpu.entity.slabs import (
+    SIF_SYNC_NEIGHBOR_CLIENTS,
+    SIF_SYNC_OWN_CLIENT,
+)
 from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.proto import FilterOp
 from goworld_tpu.utils import gwlog, gwutils
-
-# sync-info flags (Entity.go sifSyncOwnClient / sifSyncNeighborClients)
-SIF_SYNC_OWN_CLIENT = 1
-SIF_SYNC_NEIGHBOR_CLIENTS = 2
 
 
 class EntityTypeDesc:
@@ -80,22 +82,134 @@ class Entity:
     def __init__(self) -> None:
         # Filled by entity_manager.create; kept minimal here so subclasses
         # never need to call super().__init__ with args.
-        self.id: str = ""
+        # Hot state (position/yaw, sync flags, client binding) lives in the
+        # process slab store (entity/slabs.py); this object holds the slot
+        # and the descriptors below view the columns.
+        from goworld_tpu.entity import entity_manager
+
+        slabs = entity_manager.runtime.slabs
+        self._slabs = slabs
+        self._slot = slabs.alloc(self)
+        self._id: str = ""
         self.attrs: MapAttr = None  # type: ignore[assignment]
         self.space = None  # Optional[Space]
-        self.position = Vector3()
-        self.yaw = 0.0
-        self.client: Optional[GameClient] = None
+        self._client: Optional[GameClient] = None
         self.interested_in: set[Entity] = set()
         self.interested_by: set[Entity] = set()
         self._destroyed = False
+        # Snapshot of (x, y, z, yaw) taken when the slot is released, so
+        # post-destroy reads stay valid after the slot is recycled.
+        self._final_pos_yaw = (0.0, 0.0, 0.0, 0.0)
         self._timers: dict[int, tuple] = {}  # tid → (handle, interval, method, args)
         self._timer_seq = 0
-        self._sync_info_flag = 0
-        self._syncing_from_client = False
         self._save_timer = None
         self._enter_space_request: tuple | None = None  # (spaceid, pos, time, nonce)
         self._enter_space_nonce = 0  # per-entity request sequence
+
+    # --- slab-backed hot state (entity/slabs.py) ---------------------------
+
+    @property
+    def id(self) -> str:
+        return self._id
+
+    @id.setter
+    def id(self, value: str) -> None:
+        self._id = value
+        if self._slot >= 0:
+            self._slabs.eid[self._slot] = value.encode("ascii", "replace")
+            # The eid column is baked into cached sync selections.
+            self._slabs.touch_sync_topology()
+
+    @property
+    def position(self) -> Vector3:
+        i = self._slot
+        if i < 0:
+            x, y, z, _ = self._final_pos_yaw
+            return Vector3(x, y, z)
+        s = self._slabs
+        return Vector3(float(s.xz[i, 0]), float(s.y[i]), float(s.xz[i, 1]))
+
+    @position.setter
+    def position(self, pos: Vector3) -> None:
+        i = self._slot
+        if i < 0:
+            self._final_pos_yaw = (
+                pos.x, pos.y, pos.z, self._final_pos_yaw[3])
+            return
+        s = self._slabs
+        s.xz[i] = (pos.x, pos.z)
+        s.y[i] = pos.y
+
+    @property
+    def yaw(self) -> float:
+        i = self._slot
+        if i < 0:
+            return self._final_pos_yaw[3]
+        return float(self._slabs.yaw[i])
+
+    @yaw.setter
+    def yaw(self, value: float) -> None:
+        i = self._slot
+        if i < 0:
+            x, y, z, _ = self._final_pos_yaw
+            self._final_pos_yaw = (x, y, z, value)
+            return
+        self._slabs.yaw[i] = value
+
+    @property
+    def client(self) -> Optional[GameClient]:
+        return self._client
+
+    @client.setter
+    def client(self, c: Optional[GameClient]) -> None:
+        # Mirrors the binding into the cid/gateid columns so the vectorized
+        # sync collect routes (or drops) rows without touching the object.
+        self._client = c
+        i = self._slot
+        if i < 0:
+            return
+        s = self._slabs
+        if c is None:
+            s.cid[i] = b""
+            s.has_client[i] = False
+            s.gateid[i] = 0
+        else:
+            s.cid[i] = c.clientid.encode("ascii", "replace")
+            s.has_client[i] = True
+            s.gateid[i] = c.gateid
+        s.touch_sync_topology()
+
+    @property
+    def _sync_info_flag(self) -> int:
+        i = self._slot
+        return int(self._slabs.flags[i]) if i >= 0 else 0
+
+    @_sync_info_flag.setter
+    def _sync_info_flag(self, value: int) -> None:
+        if self._slot >= 0:
+            self._slabs.flags[self._slot] = value
+
+    @property
+    def _syncing_from_client(self) -> bool:
+        i = self._slot
+        return bool(self._slabs.syncing[i]) if i >= 0 else False
+
+    @_syncing_from_client.setter
+    def _syncing_from_client(self, value: bool) -> None:
+        if self._slot >= 0:
+            self._slabs.syncing[self._slot] = 1 if value else 0
+            self._slabs.touch_sync_topology()
+
+    def _release_slab_slot(self) -> None:
+        i = self._slot
+        if i < 0:
+            return
+        s = self._slabs
+        self._final_pos_yaw = (
+            float(s.xz[i, 0]), float(s.y[i]), float(s.xz[i, 1]),
+            float(s.yaw[i]))
+        self._slot = -1
+        s.release(i, self)
 
     # --- identity ----------------------------------------------------------
 
@@ -189,6 +303,10 @@ class Entity:
         from goworld_tpu.entity import entity_manager
 
         entity_manager.on_entity_destroyed(self, is_migrate)
+        # Last: release the slab slot (clears flag/client columns so the
+        # vectorized sync collect cannot emit for this entity; quarantined
+        # while a batched AOI step may still deliver its leave events).
+        self._release_slab_slot()
 
     # --- attrs -------------------------------------------------------------
 
@@ -502,6 +620,7 @@ class Entity:
             return
         self.interested_in.add(other)
         other.interested_by.add(self)
+        self._edge_update(other, add=True)
         if self.client is not None:
             gwlog.debugf("%s interest %s -> create on client %s",
                          self, other, self.client)
@@ -512,6 +631,7 @@ class Entity:
             return  # see interest(): leave may arrive without its enter
         self.interested_in.discard(other)
         other.interested_by.discard(self)
+        self._edge_update(other, add=False)
         if self.client is not None:
             gwlog.debugf("%s uninterest %s -> destroy on client %s",
                          self, other, self.client)
@@ -519,6 +639,22 @@ class Entity:
 
     def is_interested_in(self, other: "Entity") -> bool:
         return other in self.interested_in
+
+    def _edge_update(self, other: "Entity", add: bool) -> None:
+        """Mirror the interest relation into the slot-indexed edge table
+        the vectorized sync collect reads (subject=other, watcher=self).
+        Skipped for cross-store pairs (test harnesses mixing runtimes)."""
+        oslot = getattr(other, "_slot", -1)
+        if (
+            self._slot < 0
+            or oslot < 0
+            or getattr(other, "_slabs", None) is not self._slabs
+        ):
+            return
+        if add:
+            self._slabs.edge_add(oslot, self._slot)
+        else:
+            self._slabs.edge_remove(oslot, self._slot)
 
     # --- position / movement (Entity.go:430-440,1189-1205) -----------------
 

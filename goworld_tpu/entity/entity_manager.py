@@ -17,7 +17,7 @@ from __future__ import annotations
 import time
 from typing import Optional, Type
 
-from goworld_tpu import consts, dispatchercluster
+from goworld_tpu import consts, dispatchercluster, telemetry
 from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
 from goworld_tpu.entity.attrs import MapAttr
 from goworld_tpu.entity.entity import (
@@ -27,11 +27,24 @@ from goworld_tpu.entity.entity import (
     EntityTypeDesc,
 )
 from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.entity.slabs import EntitySlabs
 from goworld_tpu.entity.space import SPACE_KIND_NIL, Space
 from goworld_tpu.entity.vector import Vector3
-from goworld_tpu.proto.conn import pack_client_sync_blocks
 from goworld_tpu.utils import gwlog, gwutils, post as post_mod
 from goworld_tpu.utils.timer import TimerService
+
+# Sync fan-out per-hop attribution (shared family with game_pack in
+# game/service.py and the dispatcher/gate hops): the game-side half is
+# split into collect (flag scan + interest-edge gather over the slabs)
+# and pack (per-gate structured-array build + wire bytes) so a fan-out
+# regression names the sub-stage (bench.py --fanout hop_shares).
+_HOP = telemetry.counter(
+    "fanout_hop_seconds_total",
+    "Busy wall seconds per sync fan-out hop (game_collect|game_pack|"
+    "game_send|dispatcher_route|gate_demux|client_write).",
+    ("hop",))
+_HOP_COLLECT = _HOP.labels("game_collect")
+_HOP_PACK = _HOP.labels("game_pack")
 
 
 class Runtime:
@@ -39,6 +52,10 @@ class Runtime:
 
     def __init__(self) -> None:
         self.gameid: int = 1
+        # Columnar hot-state store (entity/slabs.py): every Entity gets a
+        # slot at construction; the batched AOI engine allocates from the
+        # SAME slot space.
+        self.slabs = EntitySlabs()
         self.timer_service = TimerService()
         self.save_interval: float = 0.0  # 0 = no periodic save (tests)
         self.position_sync_interval: float = consts.POSITION_SYNC_INTERVAL
@@ -106,6 +123,7 @@ class Runtime:
 
     def tick(self) -> None:
         self.timer_service.tick()
+        self.slabs.run_tick_batches(self.now())
         if self.aoi_service is not None:
             self.aoi_service.tick()
         post_mod.tick()
@@ -421,38 +439,28 @@ def on_game_ready() -> None:
 def collect_entity_sync_infos() -> dict[int, bytes]:
     """Build one coalesced buffer per gate of [clientid(16) + 32B sync
     record] blocks for every entity whose position/yaw changed since last
-    collection. The scan gathers (clientid, eid, x, y, z, yaw) rows per
-    destination gate; the wire bytes are then assembled in ONE vectorized
-    structured-array pack per gate (proto.conn.pack_client_sync_blocks)
-    instead of a struct.pack + append per record — at fan-out scale
-    (every neighbor's client gets a row) the per-record packing was the
-    sync phase's dominant host cost."""
-    per_gate: dict[int, list] = {}
-    for e in _entities.values():
-        flag = e._sync_info_flag
-        if not flag:
-            continue
-        e._sync_info_flag = 0
-        pos = e.position
-        row = (e.id, pos.x, pos.y, pos.z, e.yaw)
-        if (
-            flag & SIF_SYNC_OWN_CLIENT
-            and e.client is not None
-            and not e._syncing_from_client
-        ):
-            c = e.client
-            per_gate.setdefault(c.gateid, []).append((c.clientid,) + row)
-        if flag & SIF_SYNC_NEIGHBOR_CLIENTS:
-            for other in e.interested_by:
-                c = other.client
-                if c is not None:
-                    per_gate.setdefault(c.gateid, []).append(
-                        (c.clientid,) + row
-                    )
-    return {
-        gateid: pack_client_sync_blocks(rows)
-        for gateid, rows in per_gate.items()
+    collection — pure column ops over the entity slabs (slabs.collect_sync):
+    the own-client rows are one boolean-mask gather over the flag slab and
+    the neighbor fan-out rows come from the slot-indexed interest-edge
+    table instead of a Python loop over every entity's ``interested_by``
+    set, so cost scales with flagged rows + live edges, not entity count.
+    Destroyed entities and unbound clients are dropped STRUCTURALLY: slot
+    release / client unbind clear the flag and cid columns the masks read.
+    Wall time lands on fanout_hop_seconds_total{hop=game_collect|game_pack}
+    (the two game-side sub-hops of bench.py --fanout's breakdown)."""
+    slabs = runtime.slabs
+    t0 = time.perf_counter()
+    sel = slabs.collect_sync_selection()
+    t1 = time.perf_counter()
+    _HOP_COLLECT.inc(t1 - t0)
+    if sel is None:
+        return {}
+    out = {
+        gateid: arr.tobytes()
+        for gateid, arr in slabs.pack_sync(sel).items()
     }
+    _HOP_PACK.inc(time.perf_counter() - t1)
+    return out
 
 
 # --- migration receive side (EntityManager.go:279-339) -----------------------

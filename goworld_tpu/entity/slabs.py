@@ -1,0 +1,563 @@
+"""Columnar entity slabs: structure-of-arrays storage for hot entity state.
+
+The "Essence of Entity Component System" refactor (ROADMAP item 2): the
+per-entity hot fields — position/yaw, sync flags, client binding — live in
+process-wide numpy columns indexed by a per-entity SLOT, and the Python
+``Entity`` object holds only the slot (its ``position``/``yaw``/``client``
+attributes are descriptor views over these columns, entity/entity.py).
+What this buys:
+
+- ``collect_entity_sync_infos`` becomes pure column ops: the own-client
+  rows are one boolean-mask gather over the flag slab and the neighbor
+  fan-out rows come from a slot-indexed interest-edge table instead of a
+  Python loop over every entity's ``interested_by`` set — the per-gate
+  wire buffers are built by column assignment with zero Python row tuples
+  (the ``game_pack`` hop that dominated the fan-out pipeline in ISSUE 6's
+  per-hop breakdown).
+- The batched AOI engine reads positions STRAIGHT from the slab: the
+  ``xz`` column is the (N, 2) float32 array ``NeighborEngine.step_async``
+  takes, so a position write IS the AOI update (aoi/batched.py allocates
+  its slots from this store — one slot space, no mirroring).
+- Per-class batched behaviors: a class defining a classmethod
+  ``on_tick_batch(view)`` gets ONE call per tick over a
+  :class:`SlabTickView` of all its live entities (``run_tick_batches``),
+  replacing N per-entity timer callbacks; ``vmapped_position_tick`` lifts
+  a pure numeric per-entity function into that hook via jax.jit+vmap
+  (AsyncTaichi's imperative-to-batched lowering, PAPERS.md).
+
+Slot lifecycle (mirrors the AOI engine's quarantine contract): a slot is
+allocated at entity construction and released at destroy; while a batched
+AOI service is attached, released slots are QUARANTINED until the engine
+step that observed their deactivation has delivered its events — the
+entity mapping survives quarantine so in-flight leave diffs still resolve,
+and a slot can never be re-issued (aliased) mid-tick. Release always
+clears the flag/client/eid columns first, so the vectorized sync collect
+structurally cannot emit rows for destroyed entities or unbound clients.
+"""
+
+from __future__ import annotations
+
+import inspect
+import time
+from typing import Optional
+
+import numpy as np
+
+from goworld_tpu import telemetry
+from goworld_tpu.utils import gwutils
+
+# sync-info flags (Entity.go sifSyncOwnClient / sifSyncNeighborClients).
+# Defined HERE (entity/entity.py re-exports them) so the columnar collect
+# needs no import of the entity module.
+SIF_SYNC_OWN_CLIENT = 1
+SIF_SYNC_NEIGHBOR_CLIENTS = 2
+
+_INITIAL_CAPACITY = 256
+_INITIAL_EDGES = 256
+
+# One wire block of the game→dispatcher→gate sync fan-out:
+# [clientid(16)][sync record: eid(16) + x,y,z,yaw float32] — the canonical
+# layout lives with the other wire dtypes in proto/conn.py.
+from goworld_tpu.proto.conn import CLIENT_SYNC_BLOCK_DTYPE  # noqa: E402
+
+
+class _TickBucket:
+    """Live entities of one on_tick_batch class: a dense entity list with a
+    mirrored slot array (swap-remove keeps both O(1) per add/remove)."""
+
+    __slots__ = ("entities", "slots", "index", "last_tick")
+
+    def __init__(self) -> None:
+        self.entities: list = []
+        self.slots = np.empty(8, np.int32)
+        self.index: dict[int, int] = {}  # id(entity) -> dense position
+        self.last_tick = 0.0
+
+    def add(self, entity, slot: int) -> None:
+        key = id(entity)
+        if key in self.index:
+            return
+        n = len(self.entities)
+        if n == len(self.slots):
+            self.slots = np.resize(self.slots, n * 2)
+        self.entities.append(entity)
+        self.slots[n] = slot
+        self.index[key] = n
+
+    def remove(self, entity) -> None:
+        pos = self.index.pop(id(entity), None)
+        if pos is None:
+            return
+        last = len(self.entities) - 1
+        if pos != last:
+            moved = self.entities[last]
+            self.entities[pos] = moved
+            self.slots[pos] = self.slots[last]
+            self.index[id(moved)] = pos
+        self.entities.pop()
+
+
+class SlabTickView:
+    """One class's entities as columns, handed to ``on_tick_batch``.
+
+    ``x``/``y``/``z``/``yaw`` are float32 gathers (copies — mutate freely);
+    ``entities`` is the matching object list and ``dt`` the seconds since
+    this class's previous batch tick. ``set_position_yaw`` writes columns
+    back, marks every written entity for own+neighbor client sync (the
+    exact ``_set_position_yaw`` contract), and notifies non-columnar AOI
+    backends; entities destroyed by the hook mid-batch are skipped.
+    """
+
+    __slots__ = ("_slabs", "_slots", "entities", "dt")
+
+    def __init__(self, slabs: "EntitySlabs", slots: np.ndarray,
+                 entities: list, dt: float) -> None:
+        self._slabs = slabs
+        self._slots = slots
+        self.entities = entities
+        self.dt = dt
+
+    def __len__(self) -> int:
+        return len(self.entities)
+
+    @property
+    def slots(self) -> np.ndarray:
+        return self._slots
+
+    @property
+    def x(self) -> np.ndarray:
+        return self._slabs.xz[self._slots, 0]
+
+    @property
+    def y(self) -> np.ndarray:
+        return self._slabs.y[self._slots]
+
+    @property
+    def z(self) -> np.ndarray:
+        return self._slabs.xz[self._slots, 1]
+
+    @property
+    def yaw(self) -> np.ndarray:
+        return self._slabs.yaw[self._slots]
+
+    def set_position_yaw(self, x=None, y=None, z=None, yaw=None) -> None:
+        s = self._slabs
+        slots = self._slots
+        entities = self.entities
+        # A hook may destroy entities mid-batch (their slots are released/
+        # quarantined); write only the still-live rows.
+        alive = np.fromiter(
+            (not getattr(e, "_destroyed", False) for e in entities),
+            bool, count=len(entities))
+        if not alive.all():
+            idx = np.flatnonzero(alive)
+            slots = slots[idx]
+            entities = [entities[i] for i in idx]
+            x = x if x is None else np.asarray(x)[idx]
+            y = y if y is None else np.asarray(y)[idx]
+            z = z if z is None else np.asarray(z)[idx]
+            yaw = yaw if yaw is None else np.asarray(yaw)[idx]
+        if x is not None:
+            s.xz[slots, 0] = x
+        if y is not None:
+            s.y[slots] = y
+        if z is not None:
+            s.xz[slots, 1] = z
+        if yaw is not None:
+            s.yaw[slots] = yaw
+        s.flags[slots] |= SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS
+        # Non-columnar AOI backends (xzlist) keep per-entity structures;
+        # the batched manager reads positions from the slab directly
+        # (positions_in_slabs) and needs no per-entity notification.
+        if x is not None or z is not None:
+            nx = s.xz[slots, 0]
+            nz = s.xz[slots, 1]
+            for i, e in enumerate(entities):
+                sp = getattr(e, "space", None)
+                if sp is None:
+                    continue
+                mgr = getattr(sp, "aoi_mgr", None)
+                if mgr is None or getattr(mgr, "positions_in_slabs", False):
+                    continue
+                desc = getattr(e, "_type_desc", None)
+                if desc is not None and desc.use_aoi:
+                    mgr.moved(e, float(nx[i]), float(nz[i]))
+
+
+def vmapped_position_tick(fn):
+    """Lift a pure per-entity numeric function into an ``on_tick_batch``
+    classmethod: ``fn(x, y, z, yaw, dt) -> (x, y, z, yaw)`` on scalars,
+    applied to every live entity of the class in ONE ``jax.jit(jax.vmap)``
+    call per tick (compiled once, cached on the hook). Falls back to
+    calling ``fn`` with whole columns (numpy broadcasting) when jax is
+    unavailable, so numeric behaviors written with array-generic ops run
+    either way."""
+    state: dict = {}
+
+    def hook(cls, view: SlabTickView) -> None:
+        if len(view) == 0:
+            return
+        batched = state.get("fn")
+        if batched is None:
+            try:
+                import jax
+
+                jitted = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None)))
+
+                def batched(x, y, z, yaw, dt):
+                    out = jitted(x, y, z, yaw, dt)
+                    return tuple(np.asarray(o) for o in out)
+
+            except Exception:  # pragma: no cover - jax is in the image
+                batched = fn
+            state["fn"] = batched
+        x, y, z, yaw = batched(
+            view.x, view.y, view.z, view.yaw, np.float32(view.dt))
+        view.set_position_yaw(x, y, z, yaw)
+
+    return classmethod(hook)
+
+
+class EntitySlabs:
+    """The process-wide slab store: one slot per live entity."""
+
+    def __init__(self, capacity: int = _INITIAL_CAPACITY) -> None:
+        capacity = max(8, int(capacity))
+        self.capacity = capacity
+        self.xz = np.zeros((capacity, 2), np.float32)
+        self.y = np.zeros(capacity, np.float32)
+        self.yaw = np.zeros(capacity, np.float32)
+        self.flags = np.zeros(capacity, np.uint8)
+        self.syncing = np.zeros(capacity, np.uint8)
+        self.gateid = np.zeros(capacity, np.int32)
+        self.cid = np.zeros(capacity, "S16")
+        # Mirror of `cid != b""` kept as bool so the per-collect masks are
+        # byte-flag gathers, not 16-byte string compares.
+        self.has_client = np.zeros(capacity, bool)
+        self.eid = np.zeros(capacity, "S16")
+        # Batched-AOI meta columns (the engine's active/space/radius inputs
+        # live here so one growth path covers every per-slot array).
+        self.active = np.zeros(capacity, bool)
+        self.space_ids = np.zeros(capacity, np.int32)
+        self.radius = np.zeros(capacity, np.float32)
+        self.entities: list = [None] * capacity
+        self._free: list[int] = list(range(capacity - 1, -1, -1))
+        self._quarantine: list[int] = []
+        self.used = 0
+        # Hard ceiling (multihost AOI slabs are fixed-size); None = grow.
+        self.max_capacity: Optional[int] = None
+        self.exhausted_hint = ""
+        # The attached batched-AOI service, if any: released slots then
+        # defer recycling to its dispatch/deliver cycle (see module doc).
+        self.aoi_service = None
+        # Interest edges, slot-indexed: edge (subject, watcher) exists iff
+        # watcher.interested_in contains subject (maintained by
+        # Entity.interest/uninterest). _edge_refs[slot] counts edges
+        # touching a slot so release() can skip the purge scan when the
+        # interest sets were already severed (the normal path).
+        self._e_subj = np.zeros(_INITIAL_EDGES, np.int32)
+        self._e_wat = np.zeros(_INITIAL_EDGES, np.int32)
+        self._e_n = 0
+        self._e_map: dict[int, int] = {}
+        self._edge_refs = np.zeros(capacity, np.int32)
+        # Per-class batched tick hooks (on_tick_batch classes only).
+        self._tick_buckets: dict[type, _TickBucket] = {}
+        # Steady-state sync-selection cache: a mover population that flags
+        # the same slots with the same bits every collection (the common
+        # case — avatars moving every tick) re-derives an IDENTICAL
+        # selection, so the row selection, the per-gate grouping, and the
+        # cid/eid halves of the wire blocks are reused verbatim and only
+        # the position columns are refilled. Keyed by a topology version
+        # bumped on every input the selection reads besides the flags
+        # (interest edges, client bindings, syncing marks, slot release) +
+        # a memcmp of the flagged slots/bits.
+        self._topo_version = 0
+        self._sync_cache = None  # (flagged, f, version, sel, out, gates_dict)
+        telemetry.gauge(
+            "entity_slab_capacity",
+            "Allocated slot capacity of the entity slab store.",
+        ).set_function(lambda: self.capacity)
+        telemetry.gauge(
+            "entity_slab_used",
+            "Live (allocated, unreleased) entity slab slots.",
+        ).set_function(lambda: self.used)
+
+    # --- allocation ---------------------------------------------------------
+
+    def alloc(self, entity) -> int:
+        """Allocate a slot for ``entity`` (its row starts zeroed)."""
+        if not self._free:
+            if (self.max_capacity is not None
+                    and self.capacity >= self.max_capacity):
+                raise RuntimeError(
+                    self.exhausted_hint
+                    or f"entity slab capacity {self.capacity} exhausted")
+            self._grow(self.capacity * 2)
+        slot = self._free.pop()
+        self.entities[slot] = entity
+        self.used += 1
+        cls = type(entity)
+        if getattr(cls, "on_tick_batch", None) is not None:
+            self._tick_register(cls, entity, slot)
+        return slot
+
+    def release(self, slot: int, entity=None) -> None:
+        """Destroy-time release: clear the row's sync-visible columns (so
+        the vectorized collect can never emit for it), purge any interest
+        edges still referencing it, and quarantine or recycle the slot."""
+        e = self.entities[slot] if entity is None else entity
+        self._topo_version += 1
+        self.flags[slot] = 0
+        self.syncing[slot] = 0
+        self.cid[slot] = b""
+        self.has_client[slot] = False
+        self.eid[slot] = b""
+        self.gateid[slot] = 0
+        if self.active[slot]:
+            self.active[slot] = False
+            if self.aoi_service is not None:
+                self.aoi_service._meta_dirty = True
+        if self._edge_refs[slot]:
+            self._purge_edges(slot)
+        if e is not None:
+            cls = type(e)
+            bucket = self._tick_buckets.get(cls)
+            if bucket is not None:
+                bucket.remove(e)
+        self.used -= 1
+        if self.aoi_service is not None:
+            # The entity mapping survives quarantine: the in-flight engine
+            # step may still deliver this slot's leave events.
+            self._quarantine.append(slot)
+        else:
+            self.entities[slot] = None
+            self._free.append(slot)
+
+    def take_quarantine(self) -> list[int]:
+        """Hand the current quarantine to the AOI dispatch that will observe
+        these slots' deactivation (recycled via :meth:`recycle` after that
+        step's events have been delivered)."""
+        q = self._quarantine
+        self._quarantine = []
+        return q
+
+    def recycle(self, slots) -> None:
+        for slot in slots:
+            self.entities[slot] = None
+            self._free.append(slot)
+
+    def ensure_capacity(self, n: int) -> None:
+        if n > self.capacity:
+            cap = self.capacity
+            while cap < n:
+                cap *= 2
+            self._grow(max(cap, n))
+
+    def _grow(self, n: int) -> None:
+        old = self.capacity
+
+        def pad(arr, shape, dtype):
+            out = np.zeros(shape, dtype)
+            out[: arr.shape[0]] = arr
+            return out
+
+        self.xz = pad(self.xz, (n, 2), np.float32)
+        self.y = pad(self.y, (n,), np.float32)
+        self.yaw = pad(self.yaw, (n,), np.float32)
+        self.flags = pad(self.flags, (n,), np.uint8)
+        self.syncing = pad(self.syncing, (n,), np.uint8)
+        self.gateid = pad(self.gateid, (n,), np.int32)
+        self.cid = pad(self.cid, (n,), "S16")
+        self.has_client = pad(self.has_client, (n,), bool)
+        self.eid = pad(self.eid, (n,), "S16")
+        self.active = pad(self.active, (n,), bool)
+        self.space_ids = pad(self.space_ids, (n,), np.int32)
+        self.radius = pad(self.radius, (n,), np.float32)
+        self._edge_refs = pad(self._edge_refs, (n,), np.int32)
+        self.entities.extend([None] * (n - old))
+        # New slots go UNDER existing free ones so pop() hands out the
+        # lowest unused index first (keeps engine-visible slots dense).
+        self._free = list(range(n - 1, old - 1, -1)) + self._free
+        self.capacity = n
+
+    # --- interest edges -----------------------------------------------------
+
+    def edge_add(self, subj: int, watcher: int) -> None:
+        key = (subj << 32) | watcher
+        if key in self._e_map:
+            return
+        n = self._e_n
+        if n == len(self._e_subj):
+            self._e_subj = np.resize(self._e_subj, n * 2)
+            self._e_wat = np.resize(self._e_wat, n * 2)
+        self._e_subj[n] = subj
+        self._e_wat[n] = watcher
+        self._e_map[key] = n
+        self._e_n = n + 1
+        self._edge_refs[subj] += 1
+        self._edge_refs[watcher] += 1
+        self._topo_version += 1
+
+    def edge_remove(self, subj: int, watcher: int) -> None:
+        key = (subj << 32) | watcher
+        idx = self._e_map.pop(key, None)
+        if idx is None:
+            return
+        last = self._e_n - 1
+        if idx != last:
+            ls, lw = int(self._e_subj[last]), int(self._e_wat[last])
+            self._e_subj[idx] = ls
+            self._e_wat[idx] = lw
+            self._e_map[(ls << 32) | lw] = idx
+        self._e_n = last
+        self._edge_refs[subj] -= 1
+        self._edge_refs[watcher] -= 1
+        self._topo_version += 1
+
+    def edge_count(self) -> int:
+        return self._e_n
+
+    def _purge_edges(self, slot: int) -> None:
+        """Backstop for release(): drop edges still naming a slot whose
+        interest sets were not severed (destroy outside any AOI space)."""
+        n = self._e_n
+        subj, wat = self._e_subj[:n], self._e_wat[:n]
+        hits = np.flatnonzero((subj == slot) | (wat == slot))
+        for s, w in [(int(subj[i]), int(wat[i])) for i in hits]:
+            self.edge_remove(s, w)
+
+    # --- vectorized sync collection ----------------------------------------
+
+    def touch_sync_topology(self) -> None:
+        """Invalidate the steady-state sync-selection cache. Called on every
+        selection input EXCEPT the flags themselves: interest-edge changes,
+        client bind/unbind, syncing-mark changes, slot release."""
+        self._topo_version += 1
+
+    def collect_sync_selection(self):
+        """Stage 1 of the columnar ``collect_entity_sync_infos`` (the
+        ``game_collect`` hop): select which (subject, destination) slot
+        pairs emit a sync row this collection. Own-client rows are one
+        boolean-mask gather over the flag slab (client bound, not
+        client-driven); neighbor rows come from the slot-indexed interest
+        edges (watcher has a client). Flags clear for every flagged slot,
+        row or not — the legacy per-entity contract. Returns ``None`` when
+        nothing is flagged, else an opaque selection for :meth:`pack_sync`.
+
+        Steady-state fast path: when the flagged slots+bits are memcmp-
+        identical to the previous collection and nothing the selection
+        reads has changed since (``_topo_version``), the previous
+        selection — including the per-gate grouping and the cid/eid halves
+        of the wire blocks — is reused verbatim; only the float columns
+        are refilled by pack_sync."""
+        flags = self.flags
+        flagged = np.flatnonzero(flags)
+        if flagged.size == 0:
+            return None
+        f = flags[flagged]
+        cache = self._sync_cache
+        if (
+            cache is not None
+            and cache[2] == self._topo_version
+            and np.array_equal(cache[0], flagged)
+            and np.array_equal(cache[1], f)
+        ):
+            flags[flagged] = 0
+            return cache
+        has_client = self.has_client
+        own = flagged[
+            (f & SIF_SYNC_OWN_CLIENT).astype(bool)
+            & has_client[flagged]
+            & (self.syncing[flagged] == 0)
+        ]
+        n = self._e_n
+        if n:
+            subj, wat = self._e_subj[:n], self._e_wat[:n]
+            m = (
+                (flags[subj] & SIF_SYNC_NEIGHBOR_CLIENTS).astype(bool)
+                & has_client[wat]
+            )
+            nsubj, nwat = subj[m], wat[m]
+        else:
+            nsubj = nwat = np.empty(0, np.int64)
+        flags[flagged] = 0
+        subjects = np.concatenate([own, nsubj])
+        if subjects.size == 0:
+            return None
+        dests = np.concatenate([own, nwat])
+        gates = self.gateid[dests]
+        # Order rows by (gate, destination slot): per-gate buffers come out
+        # as ONE contiguous slice each, and within a gate every client's
+        # rows form a contiguous run — the gate's demux then slices runs
+        # straight off the wire buffer without re-sorting (gate/service.py
+        # _handle_sync_on_clients).
+        if (gates == gates[0]).all():
+            order = np.argsort(dests, kind="stable")
+        else:
+            order = np.argsort(
+                (gates.astype(np.int64) << 32) | dests, kind="stable")
+        so, do, gs = subjects[order], dests[order], gates[order]
+        out = np.empty(len(so), CLIENT_SYNC_BLOCK_DTYPE)
+        out["cid"] = self.cid[do]
+        out["eid"] = self.eid[so]
+        bounds = [0] + (np.flatnonzero(gs[1:] != gs[:-1]) + 1).tolist()
+        bounds.append(len(gs))
+        per_gate = {
+            int(gs[bounds[i]]): out[bounds[i]:bounds[i + 1]]
+            for i in range(len(bounds) - 1)
+        }
+        cache = (flagged, f, self._topo_version, (so, do, gs), out, per_gate)
+        self._sync_cache = cache
+        return cache
+
+    def pack_sync(self, selection) -> dict[int, np.ndarray]:
+        """Stage 2 (the ``game_pack`` hop): one structured array of
+        [cid + sync record] wire blocks per destination gate, built by
+        column assignment — zero Python row tuples. The cid/eid halves were
+        filled when the selection was built (they are selection-invariant);
+        this refills the position/yaw columns from the live slabs. The
+        returned per-gate arrays are views into one shared buffer, valid
+        until the next collection."""
+        so = selection[3][0]
+        out = selection[4]
+        out["x"] = self.xz[so, 0]
+        out["y"] = self.y[so]
+        out["z"] = self.xz[so, 1]
+        out["yaw"] = self.yaw[so]
+        return selection[5]
+
+    def collect_sync(self) -> dict[int, np.ndarray]:
+        """Both stages in one call (tests / embedded drivers)."""
+        sel = self.collect_sync_selection()
+        return {} if sel is None else self.pack_sync(sel)
+
+    # --- per-class batched tick hooks --------------------------------------
+
+    def _tick_register(self, cls: type, entity, slot: int) -> None:
+        bucket = self._tick_buckets.get(cls)
+        if bucket is None:
+            hook = inspect.getattr_static(cls, "on_tick_batch", None)
+            if not isinstance(hook, (classmethod, staticmethod)):
+                raise TypeError(
+                    f"{cls.__name__}.on_tick_batch must be a classmethod "
+                    f"(one call per CLASS per tick over a SlabTickView)")
+            bucket = self._tick_buckets[cls] = _TickBucket()
+            bucket.last_tick = time.monotonic()
+        bucket.add(entity, slot)
+
+    def run_tick_batches(self, now: float | None = None) -> None:
+        """Fire each adopted class's ``on_tick_batch`` once over its live
+        entities (the vectorized replacement for per-entity timers)."""
+        if not self._tick_buckets:
+            return
+        if now is None:
+            now = time.monotonic()
+        for cls, bucket in list(self._tick_buckets.items()):
+            n = len(bucket.entities)
+            if n == 0:
+                continue
+            dt = now - bucket.last_tick
+            bucket.last_tick = now
+            view = SlabTickView(
+                self, bucket.slots[:n].copy(), list(bucket.entities), dt)
+            gwutils.run_panicless(lambda c=cls, v=view: c.on_tick_batch(v))

@@ -29,12 +29,16 @@ from goworld_tpu.utils import async_jobs, crontab, gwlog, gwutils, post
 
 # Sync fan-out per-hop attribution (shared family with the dispatcher's
 # dispatcher_route and the gate's gate_demux/client_write hops; bench.py
-# --fanout reads the deltas into per-hop shares).
-_HOP_GAME_PACK = telemetry.counter(
+# --fanout reads the deltas into per-hop shares). The game side is split
+# into game_collect + game_pack (entity_manager.collect_entity_sync_infos
+# owns both compute sub-hops) and game_send — the per-gate dispatcher-link
+# writes below, kept separate so pack COMPUTE is attributable apart from
+# wire work (mirroring the gate's gate_demux vs client_write split).
+_HOP_GAME_SEND = telemetry.counter(
     "fanout_hop_seconds_total",
-    "Busy wall seconds per sync fan-out hop "
-    "(game_pack|dispatcher_route|gate_demux|client_write).",
-    ("hop",)).labels("game_pack")
+    "Busy wall seconds per sync fan-out hop (game_collect|game_pack|"
+    "game_send|dispatcher_route|gate_demux|client_write).",
+    ("hop",)).labels("game_send")
 
 # run states (GameService.go rsRunning/rsTerminating/rsFreezing...)
 RS_RUNNING = 0
@@ -118,6 +122,11 @@ class GameService:
         rt.aoi_mesh_shards = max(1, self.cfg.aoi.mesh_shards)
         rt.aoi_delivery = self.cfg.aoi.delivery
         rt.aoi_sync_wait_budget = self.cfg.aoi.sync_wait_budget
+        ecfg = getattr(self.cfg, "entity", None)
+        if ecfg is not None:
+            # Pre-size the slab store ([entity] slab_initial) so steady-
+            # state populations don't pay growth reallocation mid-login.
+            rt.slabs.ensure_capacity(ecfg.slab_initial)
         if rt.aoi_backend != "xzlist" and rt.aoi_params is None:
             from goworld_tpu.entity.aoi.batched import params_from_config
 
@@ -356,7 +365,20 @@ class GameService:
         aoi_backlog = telemetry.gauge("aoi_event_backlog")
         while True:
             try:
-                msgtype, packet = await asyncio.wait_for(self._queue.get(), timeout=tick)
+                # Wake at the next position-sync deadline when it lands
+                # inside the tick window: a fixed 5 ms wait ADDS to the
+                # iteration's work time, so the configured sync rate ran
+                # ~25% slow on a quiet queue (6.3 ms achieved periods at a
+                # 5 ms interval — bench.py --fanout is cadence-bound on
+                # exactly this).
+                timeout = tick
+                if self.position_sync_interval > 0:
+                    due = (self._last_sync_collect
+                           + self.position_sync_interval - time.monotonic())
+                    if due < timeout:
+                        timeout = max(0.0, due)
+                msgtype, packet = await asyncio.wait_for(
+                    self._queue.get(), timeout=timeout)
                 tracer.begin()
                 self._last_packet_at = time.monotonic()
                 self._handle_packet(msgtype, packet)
@@ -384,6 +406,10 @@ class GameService:
                     self._tick_trace_id = timer_scope.ctx.trace_id
                 with timer_scope:
                     rt.timer_service.tick()
+            # Per-class batched behaviors: ONE on_tick_batch call per
+            # adopted class over its entities' slab view — the vectorized
+            # replacement for per-entity timers (entity/slabs.py).
+            rt.slabs.run_tick_batches()
             tracer.mark("entity_logic")
             # NOTE on the multi-HOST (DCN) tier: the wait=False machinery
             # below is lockstep-SAFE as is. Frame-skip only DEFERS a
@@ -443,7 +469,18 @@ class GameService:
             tracer.mark("entity_logic")
             now = time.monotonic()
             if now - self._last_sync_collect >= self.position_sync_interval:
-                self._last_sync_collect = now
+                # Scheduled-rate cadence: advance the deadline by the
+                # INTERVAL (not to `now`) so a loop iteration landing late
+                # doesn't stretch the average sync period — the configured
+                # position_sync_interval is a rate, and under load the old
+                # fixed-delay reset ran it ~25% slow (5 ms config, ~6.2 ms
+                # achieved — measured by bench.py --fanout, where delivered
+                # records are cadence-bound). Clamped to one interval of
+                # backlog: a long stall must not trigger a catch-up burst.
+                self._last_sync_collect = max(
+                    self._last_sync_collect + self.position_sync_interval,
+                    now - self.position_sync_interval,
+                )
                 self._send_entity_sync_infos()
                 tracer.mark("sync_send")
             committed = tracer.commit()
@@ -514,17 +551,21 @@ class GameService:
 
     def _send_entity_sync_infos(self) -> None:
         """Push batched position syncs, one coalesced packet per gate
-        (§3.3; records are packed in one vectorized pass per gate —
-        entity_manager.collect_entity_sync_infos). Wall time lands on
-        fanout_hop_seconds_total{hop="game_pack"} — the first hop of the
-        per-hop breakdown bench.py --fanout reports."""
-        t0 = time.perf_counter()
+        (§3.3; rows are selected and packed as pure column ops over the
+        entity slabs — entity_manager.collect_entity_sync_infos). Wall
+        time lands on fanout_hop_seconds_total{hop="game_collect"|
+        "game_pack"}; the dispatcher-link writes below land on game_send —
+        the game-side hops of the per-hop breakdown bench.py --fanout
+        reports."""
         per_gate = entity_manager.collect_entity_sync_infos()
+        if not per_gate:
+            return
+        t0 = time.perf_counter()
         for gateid, buf in per_gate.items():
             dispatchercluster.select_by_gate_id(gateid).send_sync_position_yaw_on_clients(
                 gateid, buf
             )
-        _HOP_GAME_PACK.inc(time.perf_counter() - t0)
+        _HOP_GAME_SEND.inc(time.perf_counter() - t0)
 
     # --- packet handlers (GameService.go:92-157) ------------------------------
 

@@ -76,8 +76,8 @@ _KILLS_ERROR = _CLIENT_KILLS.labels("error")
 # end-of-batch uncork sweep that actually writes the corked client conns.
 _HOP_SECONDS = _telemetry.counter(
     "fanout_hop_seconds_total",
-    "Busy wall seconds per sync fan-out hop "
-    "(game_pack|dispatcher_route|gate_demux|client_write).",
+    "Busy wall seconds per sync fan-out hop (game_collect|game_pack|"
+    "game_send|dispatcher_route|gate_demux|client_write).",
     ("hop",))
 _HOP_GATE_DEMUX = _HOP_SECONDS.labels("gate_demux")
 _HOP_CLIENT_WRITE = _HOP_SECONDS.labels("client_write")
@@ -588,12 +588,16 @@ class GateService:
 
     def _handle_sync_on_clients(self, packet: Packet) -> None:
         """De-multiplex [clientid + 32 B record] blocks per client
-        (GateService.go:346-371) — vectorized: one structured-array view +
-        one stable argsort groups the whole packet's blocks by clientid,
-        then each client's record run leaves as a single contiguous
-        ``tobytes()`` instead of a per-block decode/append loop. Wall time
-        lands on fanout_hop_seconds_total{hop="gate_demux"} (the corked
-        client writes themselves are costed under client_write at the
+        (GateService.go:346-371) — vectorized: one structured-array view,
+        then each maximal run of equal clientids leaves as a single
+        contiguous ``tobytes()`` slice. The game packs each collection's
+        rows grouped by destination client (slabs.py collect_sync_selection
+        orders by destination slot), so the adjacent-run scan recovers the
+        per-client grouping without the argsort this path used to pay; an
+        ungrouped producer only costs extra (smaller) sends, never a wrong
+        route. Wall time lands on
+        fanout_hop_seconds_total{hop="gate_demux"} (the corked client
+        writes themselves are costed under client_write at the
         end-of-batch uncork sweep)."""
         t0 = time.perf_counter()
         packet.read_uint16()  # gateid
@@ -602,25 +606,23 @@ class GateService:
         if not k:
             return
         arr = np.frombuffer(data, CLIENT_SYNC_DTYPE, count=k)
+        cids = arr["cid"]
         if k == 1:
-            cp = self.clients.get(arr["cid"][0].decode("ascii"))
+            cp = self.clients.get(cids[0].decode("ascii"))
             if cp is not None:
                 cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
                         arr["rec"].tobytes())
             _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
             return
-        order = np.argsort(arr["cid"], kind="stable")
-        cid_s = arr["cid"][order]
-        rec_s = arr["rec"][order]
-        bounds = np.flatnonzero(
-            np.r_[True, cid_s[1:] != cid_s[:-1]]
-        ).tolist() + [k]
+        rec = arr["rec"]
+        bounds = [0] + (np.flatnonzero(cids[1:] != cids[:-1]) + 1).tolist() + [k]
+        clients = self.clients
         for i in range(len(bounds) - 1):
             lo, hi = bounds[i], bounds[i + 1]
-            cp = self.clients.get(cid_s[lo].decode("ascii"))
+            cp = clients.get(cids[lo].decode("ascii"))
             if cp is not None:
                 cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
-                        rec_s[lo:hi].tobytes())
+                        rec[lo:hi].tobytes())
         _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
 
     # --- filter props (FilterTree.go, GateService.go:300-344) ----------------

@@ -36,8 +36,16 @@ SYNC_DTYPE = np.dtype(
 # half is kept as one opaque 32 B field so the gate's demux can slice
 # per-client record runs with a single .tobytes() per client.
 CLIENT_SYNC_DTYPE = np.dtype([("cid", "S16"), ("rec", "V32")])
+# The same wire block with the record half split into named fields — the
+# layout the columnar sync collect fills by column assignment
+# (entity/slabs.py pack_sync; pack_client_sync_columns below).
+CLIENT_SYNC_BLOCK_DTYPE = np.dtype(
+    [("cid", "S16"), ("eid", "S16"), ("x", "<f4"), ("y", "<f4"),
+     ("z", "<f4"), ("yaw", "<f4")]
+)
 assert SYNC_DTYPE.itemsize == SYNC_RECORD_SIZE
 assert CLIENT_SYNC_DTYPE.itemsize == 16 + SYNC_RECORD_SIZE
+assert CLIENT_SYNC_BLOCK_DTYPE.itemsize == 16 + SYNC_RECORD_SIZE
 
 # Process-wide wire volume (telemetry): counted HERE because every peer
 # connection of every process — dispatcher↔game/gate streams AND gate
@@ -94,14 +102,22 @@ def pack_client_sync_blocks(
     struct.pack + bytearray append per record."""
     if not rows:
         return b""
-    arr = np.array(
-        rows,
-        dtype=np.dtype(
-            [("cid", "S16"), ("eid", "S16"), ("x", "<f4"), ("y", "<f4"),
-             ("z", "<f4"), ("yaw", "<f4")]
-        ),
-    )
-    return arr.tobytes()
+    return np.array(rows, dtype=CLIENT_SYNC_BLOCK_DTYPE).tobytes()
+
+
+def pack_client_sync_columns(cid, eid, x, y, z, yaw) -> bytes:
+    """Columnar variant of :func:`pack_client_sync_blocks`: fill the wire
+    blocks by column assignment from parallel arrays (the slab store's
+    collect path builds its per-gate buffers this way — zero Python row
+    tuples; this helper is the standalone seam for tests and tools)."""
+    out = np.empty(len(cid), CLIENT_SYNC_BLOCK_DTYPE)
+    out["cid"] = cid
+    out["eid"] = eid
+    out["x"] = x
+    out["y"] = y
+    out["z"] = z
+    out["yaw"] = yaw
+    return out.tobytes()
 
 
 class GoWorldConnection:
@@ -378,11 +394,11 @@ class GoWorldConnection:
 
     def send_sync_position_yaw_on_clients(self, gateid: int, records: bytes) -> None:
         """records = concatenated [clientid(16) + 32 B sync record] blocks
-        (game→dispatcher→gate, Entity.go:1221-1267)."""
-        p = Packet()
-        p.append_uint16(gateid)
-        p.append_bytes(records)
-        self.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, p)
+        (game→dispatcher→gate, Entity.go:1221-1267). Built as one bytes
+        payload so the Packet rides the zero-copy constructor (the sync
+        fan-out's largest per-tick buffer pays exactly one copy here)."""
+        self.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
+                  Packet(struct.pack("<H", gateid) + records))
 
     # --- process / deployment events ---------------------------------------
 

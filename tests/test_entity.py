@@ -752,7 +752,7 @@ def test_batched_aoi_grow_reentrant_from_delivery_callback():
         space_slots=4, cell_capacity=16, max_events=512,
     )
     orig_tier = batched_mod._MIN_TIER
-    batched_mod._MIN_TIER = 8
+    batched_mod._MIN_TIER = 16
     try:
         sp = _setup_space()
         spawned = []
@@ -767,27 +767,30 @@ def test_batched_aoi_grow_reentrant_from_delivery_callback():
                     sp._enter(e, Vector3(30.0, 0, 0))
 
         em.register_entity(SpawnerAvatar)
-        # Fill the 8-slot tier exactly (spawner included), with a freed
-        # slot held in quarantine so the free list is empty at delivery.
+        # Fill the 16-slot tier exactly (slab slots are allocated at
+        # ENTITY CREATION now, so the arena space itself occupies one:
+        # 14 avatars + spawner fill the rest), with a destroyed entity's
+        # slot held in quarantine so a spawn inside delivery must grow
+        # the engine.
         victim = em.create_entity_locally("Avatar")
         sp._enter(victim, Vector3(90.0, 0, 0))
         others = []
-        for i in range(6):
+        for i in range(13):
             e = em.create_entity_locally("Avatar")
             sp._enter(e, Vector3(float(i * 5), 0, 0))
             others.append(e)
         spawner = em.create_entity_locally("SpawnerAvatar")
         sp._enter(spawner, Vector3(20.0, 0, 0))
-        em.runtime.tick()  # dispatch #1 (sees 8 actives: tier full)
-        sp._leave(victim)  # quarantined; slot NOT yet recyclable
-        victim.destroy()
+        em.runtime.tick()  # dispatch #1 (sees the actives: tier full)
+        sp._leave(victim)  # interest severed synchronously
+        victim.destroy()   # slot quarantined; NOT yet recyclable
         svc = em.runtime.aoi_service
-        assert svc.params.capacity == 8
+        assert svc.params.capacity == 16
         # Tick #2: dispatches, then DELIVERS #1's enters — the spawner's
-        # callback spawns with the free list empty and the victim's slot
+        # callback spawns with the tier full and the victim's slot
         # quarantined: _grow runs re-entrantly inside _deliver.
         em.runtime.tick()
-        assert svc.params.capacity > 8, "re-entrant grow did not trigger"
+        assert svc.params.capacity > 16, "re-entrant grow did not trigger"
         for _ in range(4):
             em.runtime.tick()
         assert spawned, "delivery callback never fired"

@@ -440,14 +440,8 @@ def test_compressed_client_connection(clean_entities, tmp_path):
 # --- vectorized sync demux (ISSUE 2) -----------------------------------------
 
 
-def test_sync_on_clients_vectorized_demux():
-    """The argsort-grouped demux must deliver each client exactly the
-    records addressed to it, concatenated in packet order, one send per
-    client — and ignore a truncated trailing block."""
-    from goworld_tpu.gate.service import GateService
-    from goworld_tpu.gate.service import ClientProxy
-    from goworld_tpu.netutil.packet import Packet
-    from goworld_tpu.proto.conn import pack_sync_record
+def _demux_gate():
+    from goworld_tpu.gate.service import ClientProxy, GateService
 
     class RecConn:
         def __init__(self):
@@ -458,13 +452,54 @@ def test_sync_on_clients_vectorized_demux():
 
     cfg = GoWorldConfig()
     gate = GateService(1, cfg)
-    cids = ["A" * 16, "B" * 16, "C" * 16]
     proxies = {}
-    for cid in cids:
+    for cid in ("A" * 16, "B" * 16, "C" * 16):
         cp = ClientProxy(RecConn())
         cp.clientid = cid
         gate.clients[cid] = cp
         proxies[cid] = cp
+    return gate, proxies
+
+
+def test_sync_on_clients_vectorized_demux():
+    """A client-grouped packet (what the columnar game pack produces —
+    slabs.collect_sync_selection orders rows by destination slot) must
+    deliver each client exactly its records, concatenated in packet
+    order, ONE send per client — and ignore a truncated trailing block."""
+    from goworld_tpu.netutil.packet import Packet
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    gate, proxies = _demux_gate()
+    cids = list(proxies)
+    recs = [pack_sync_record("E%015d" % i, float(i), 0.0, 0.0, 0.0)
+            for i in range(5)]
+    blocks = (
+        cids[0].encode() + recs[0]
+        + cids[0].encode() + recs[2]
+        + cids[1].encode() + recs[1]
+        + cids[1].encode() + recs[4]
+        + cids[2].encode() + recs[3]
+    )
+    p = Packet()
+    p.append_uint16(1)
+    p.append_bytes(blocks + b"\x00" * 10)  # truncated trailing junk block
+    gate._handle_sync_on_clients(p)
+    a, b, c = (proxies[cid].conn.sent for cid in cids)
+    assert a == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[0] + recs[2])]
+    assert b == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[1] + recs[4])]
+    assert c == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[3])]
+
+
+def test_sync_on_clients_interleaved_demux_still_routes():
+    """An UNGROUPED producer (cids interleaved) costs extra per-run sends
+    but never a wrong route: each client still receives exactly its
+    records in packet order (the run-sliced demux's degradation contract,
+    replacing the old always-argsort path)."""
+    from goworld_tpu.netutil.packet import Packet
+    from goworld_tpu.proto.conn import pack_sync_record
+
+    gate, proxies = _demux_gate()
+    cids = list(proxies)
     recs = [pack_sync_record("E%015d" % i, float(i), 0.0, 0.0, 0.0)
             for i in range(5)]
     blocks = (
@@ -476,9 +511,13 @@ def test_sync_on_clients_vectorized_demux():
     )
     p = Packet()
     p.append_uint16(1)
-    p.append_bytes(blocks + b"\x00" * 10)  # truncated trailing junk block
+    p.append_bytes(blocks)
     gate._handle_sync_on_clients(p)
     a, b, c = (proxies[cid].conn.sent for cid in cids)
-    assert a == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[0] + recs[2])]
-    assert b == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[1] + recs[4])]
-    assert c == [(MsgType.SYNC_POSITION_YAW_ON_CLIENTS, recs[3])]
+    # Per-run sends: concatenating each client's payloads recovers its
+    # records in exact packet order.
+    assert b"".join(pl for _, pl in a) == recs[0] + recs[2]
+    assert b"".join(pl for _, pl in b) == recs[1] + recs[4]
+    assert b"".join(pl for _, pl in c) == recs[3]
+    assert all(mt == MsgType.SYNC_POSITION_YAW_ON_CLIENTS
+               for mt, _ in a + b + c)

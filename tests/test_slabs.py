@@ -1,0 +1,460 @@
+"""Columnar entity-slab tests (ISSUE 7).
+
+The load-bearing piece is the randomized legacy-vs-slab parity oracle:
+the pre-slab ``collect_entity_sync_infos`` loop (objects + ``interested_by``
+sets) is reimplemented here verbatim as the reference, and the columnar
+path must emit the same per-gate multiset of 48-byte wire blocks across
+randomized populations — flags combinations, client bindings across gates,
+``_syncing_from_client`` suppression, destroy-with-pending-flag and
+unbind-with-pending-flag races, and position/yaw mutation orderings.
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import (
+    SIF_SYNC_NEIGHBOR_CLIENTS,
+    SIF_SYNC_OWN_CLIENT,
+    Entity,
+)
+from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.entity.slabs import SlabTickView, vmapped_position_tick
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.proto.conn import (
+    CLIENT_SYNC_BLOCK_DTYPE,
+    pack_client_sync_blocks,
+    pack_client_sync_columns,
+)
+
+BLOCK = CLIENT_SYNC_BLOCK_DTYPE.itemsize
+
+
+class MySpace(Space):
+    pass
+
+
+class Avatar(Entity):
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True)
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    em.cleanup_for_tests()
+    em.register_space(MySpace)
+    em.register_entity(Avatar)
+    yield
+    em.cleanup_for_tests()
+
+
+def _blocks(buf: bytes) -> list[bytes]:
+    return [buf[i:i + BLOCK] for i in range(0, len(buf), BLOCK)]
+
+
+def _legacy_collect_reference() -> dict[int, bytes]:
+    """The exact pre-slab object-path loop (entity_manager@HEAD~1),
+    kept as the parity oracle."""
+    per_gate: dict[int, list] = {}
+    for e in em.entities().values():
+        flag = e._sync_info_flag
+        if not flag:
+            continue
+        e._sync_info_flag = 0
+        pos = e.position
+        row = (e.id, pos.x, pos.y, pos.z, e.yaw)
+        if (
+            flag & SIF_SYNC_OWN_CLIENT
+            and e.client is not None
+            and not e._syncing_from_client
+        ):
+            c = e.client
+            per_gate.setdefault(c.gateid, []).append((c.clientid,) + row)
+        if flag & SIF_SYNC_NEIGHBOR_CLIENTS:
+            for other in e.interested_by:
+                c = other.client
+                if c is not None:
+                    per_gate.setdefault(c.gateid, []).append(
+                        (c.clientid,) + row)
+    return {g: pack_client_sync_blocks(rows)
+            for g, rows in per_gate.items()}
+
+
+def _assert_same_rows(legacy: dict[int, bytes], slab: dict[int, bytes]):
+    assert set(legacy) == set(slab)
+    for g in legacy:
+        # Row ORDER within a gate buffer is not part of the contract
+        # (records address distinct (client, eid) pairs); compare as
+        # multisets of whole wire blocks.
+        assert sorted(_blocks(legacy[g])) == sorted(_blocks(slab[g])), (
+            f"gate {g} rows diverged")
+
+
+def test_parity_oracle_randomized():
+    rng = np.random.default_rng(7)
+    for trial in range(15):
+        em.cleanup_for_tests()
+        em.register_space(MySpace)
+        em.register_entity(Avatar)
+        n = int(rng.integers(2, 30))
+        ents = [em.create_entity_locally("Avatar") for _ in range(n)]
+        # Random client bindings across 3 gates (some unbound).
+        for i, e in enumerate(ents):
+            if rng.random() < 0.7:
+                e.client = GameClient(
+                    ("c%03d" % i) + "x" * 12, int(rng.integers(1, 4)), e.id)
+        # Random interest edges (watcher interested in subject).
+        for _ in range(int(rng.integers(0, n * 3))):
+            a, b = rng.integers(0, n, 2)
+            if a != b:
+                ents[a].interest(ents[b])
+        # Random position/yaw mutations in random orders.
+        for e in ents:
+            for _ in range(int(rng.integers(0, 3))):
+                op = rng.integers(0, 3)
+                if op == 0:
+                    e.set_position(Vector3(*rng.normal(size=3)))
+                elif op == 1:
+                    e.set_yaw(float(rng.normal()))
+                else:
+                    e.set_client_syncing(True)
+                    e.on_sync_position_yaw_from_client(
+                        *[float(v) for v in rng.normal(size=4)])
+                    e.set_client_syncing(bool(rng.random() < 0.3))
+        # Random extra flag combinations, incl. flag-no-client rows.
+        for e in ents:
+            bits = int(rng.integers(0, 4))
+            if bits:
+                e._sync_info_flag = bits
+        # Race cases: destroy / unbind AFTER flags were set.
+        for e in ents:
+            if rng.random() < 0.1:
+                e.destroy()
+            elif rng.random() < 0.1 and e.client is not None:
+                e.client = None
+        saved = {e: e._sync_info_flag for e in ents if not e.is_destroyed()}
+        legacy = _legacy_collect_reference()
+        for e, flag in saved.items():
+            e._sync_info_flag = flag
+        slab = em.collect_entity_sync_infos()
+        _assert_same_rows(legacy, slab)
+        # Both paths clear flags: a second collection is empty.
+        assert em.collect_entity_sync_infos() == {}
+
+
+def test_destroy_with_pending_flag_emits_nothing():
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    for e, g in ((a, 1), (b, 2)):
+        e.client = GameClient("C" + e.id[:15], g, e.id)
+    b.interest(a)  # b watches a: a's neighbor rows go to b's client
+    a.set_position(Vector3(1, 2, 3))
+    a.destroy()
+    # a's own row AND its neighbor row to b must both be dropped.
+    assert em.collect_entity_sync_infos() == {}
+
+
+def test_unbind_with_pending_flag_drops_own_and_neighbor_rows():
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    a.client = GameClient("A" * 16, 1, a.id)
+    b.client = GameClient("B" * 16, 2, b.id)
+    b.interest(a)
+    a.set_position(Vector3(1, 2, 3))
+    # Both the subject's own client and the WATCHER's client unbind
+    # between flag-set and collection.
+    a.notify_client_disconnected()
+    b.notify_client_disconnected()
+    assert em.collect_entity_sync_infos() == {}
+
+
+def test_syncing_from_client_suppresses_own_row_only():
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    a.client = GameClient("A" * 16, 1, a.id)
+    b.client = GameClient("B" * 16, 2, b.id)
+    b.interest(a)
+    a.set_client_syncing(True)
+    a.on_sync_position_yaw_from_client(5.0, 6.0, 7.0, 8.0)
+    infos = em.collect_entity_sync_infos()
+    # Client-driven sync: no own-client echo (gate 1), neighbor row only.
+    assert set(infos) == {2}
+    arr = np.frombuffer(infos[2], CLIENT_SYNC_BLOCK_DTYPE)
+    assert arr["cid"][0] == b"B" * 16
+    assert arr["x"][0] == np.float32(5.0)
+    assert arr["yaw"][0] == np.float32(8.0)
+
+
+def test_migrate_restore_roundtrip_wire_identical():
+    """Slab state must survive a migrate→restore round-trip byte-identically
+    on the wire: the sync record emitted before the migration equals the
+    one emitted by the restored entity."""
+    a = em.create_entity_locally("Avatar")
+    a.client = GameClient("A" * 16, 1, a.id)
+    watcher = em.create_entity_locally("Avatar")
+    watcher.client = GameClient("W" * 16, 1, watcher.id)
+    watcher.interest(a)
+    a.set_client_syncing(True)
+    a._set_position_yaw(Vector3(1.25, -2.5, 3.875), 42.5)
+    before = em.collect_entity_sync_infos()[1]
+    eid = a.id
+    data = a.get_migrate_data()
+    a._destroy(is_migrate=True)
+    assert em.get_entity(eid) is None
+    e2 = em.restore_entity(eid, data, is_migrate=True)
+    assert e2._syncing_from_client is True
+    # Re-establish the watcher edge (migration rebuilds interest via AOI
+    # re-entry in production) and re-flag: wire bytes must match exactly.
+    watcher.interest(e2)
+    e2._set_position_yaw(e2.position, e2.yaw)
+    after = em.collect_entity_sync_infos()[1]
+    assert sorted(_blocks(before)) == sorted(_blocks(after))
+
+
+def test_per_gate_buffers_are_client_grouped():
+    """The pack orders rows by destination slot, so each client's rows are
+    one contiguous run — the property the gate's run-sliced demux relies
+    on for one-send-per-client coalescing."""
+    ents = [em.create_entity_locally("Avatar") for _ in range(6)]
+    for i, e in enumerate(ents):
+        e.client = GameClient(("c%02d" % i) + "x" * 13, 1, e.id)
+    for e in ents:
+        for o in ents:
+            if o is not e:
+                e.interest(o)
+    for e in ents:
+        e.set_position(Vector3(1, 0, 1))
+    buf = em.collect_entity_sync_infos()[1]
+    cids = np.frombuffer(buf, CLIENT_SYNC_BLOCK_DTYPE)["cid"]
+    runs = 1 + int(np.count_nonzero(cids[1:] != cids[:-1]))
+    assert runs == len(set(cids.tolist())), "client rows not contiguous"
+
+
+def test_sync_selection_cache_invalidation():
+    """The steady-state selection cache must never serve stale rows: the
+    same flag pattern re-collected after a client unbind, a new interest
+    edge, or an entity destroy must re-derive the selection."""
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    c = em.create_entity_locally("Avatar")
+    for e, tag in ((a, "A"), (b, "B"), (c, "C")):
+        e.client = GameClient(tag * 16, 1, e.id)
+    b.interest(a)
+
+    def collect():
+        for e in (a, b, c):
+            e._sync_info_flag = (
+                SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS)
+        infos = em.collect_entity_sync_infos()
+        return sorted(_blocks(infos.get(1, b"")))
+
+    base = collect()
+    assert collect() == base  # cache hit: identical
+    # Positions still refresh on hits.
+    a.set_position(Vector3(9, 9, 9))
+    moved = collect()
+    assert moved != base
+    # New edge → extra row.
+    c.interest(a)
+    assert len(collect()) == len(moved) + 1
+    # Unbind a WATCHER → its neighbor rows vanish.
+    b.notify_client_disconnected()
+    fewer = collect()
+    assert len(fewer) == len(moved) + 1 - 2  # b's own row + its watch row
+    # Destroy → all of c's rows and rows to c vanish.
+    c.destroy()
+    final_rows = collect()
+    assert all(blk[:16] != b"C" * 16 for blk in final_rows)
+
+
+def test_pack_client_sync_columns_matches_rows():
+    rows = [("c" * 16, "e" * 16, 1.0, 2.0, 3.0, 4.0),
+            ("d" * 16, "f" * 16, -1.5, 0.25, 8.0, -42.0)]
+    ref = pack_client_sync_blocks(rows)
+    cols = pack_client_sync_columns(
+        np.array([r[0].encode() for r in rows], "S16"),
+        np.array([r[1].encode() for r in rows], "S16"),
+        np.array([r[2] for r in rows], "<f4"),
+        np.array([r[3] for r in rows], "<f4"),
+        np.array([r[4] for r in rows], "<f4"),
+        np.array([r[5] for r in rows], "<f4"),
+    )
+    assert ref == cols
+
+
+# --- slab store mechanics -----------------------------------------------------
+
+
+def test_slab_grow_preserves_state_and_slot_identity():
+    slabs = em.runtime.slabs
+    ents = [em.create_entity_locally("Avatar") for _ in range(8)]
+    ents[3].set_position(Vector3(1, 2, 3))
+    slots = [e._slot for e in ents]
+    slabs.ensure_capacity(slabs.capacity * 4)
+    assert [e._slot for e in ents] == slots
+    assert ents[3].position.as_tuple() == (1.0, 2.0, 3.0)
+
+
+def test_slab_release_quarantines_under_aoi_and_recycles_after():
+    slabs = em.runtime.slabs
+
+    class FakeSvc:
+        _meta_dirty = False
+
+    slabs.aoi_service = FakeSvc()
+    e = em.create_entity_locally("Avatar")
+    slot = e._slot
+    free_before = len(slabs._free)
+    e.destroy()
+    # Quarantined, not yet free; entity mapping survives for late leaves.
+    assert len(slabs._free) == free_before
+    assert slabs.entities[slot] is e
+    q = slabs.take_quarantine()
+    assert slot in q
+    slabs.recycle(q)
+    assert slabs.entities[slot] is None
+    assert slot in slabs._free
+
+
+def test_slab_edges_purged_on_release_without_aoi_sever():
+    slabs = em.runtime.slabs
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    # Manual interest without any AOI manager to sever it.
+    b.interest(a)
+    a.interest(b)
+    assert slabs.edge_count() == 2
+    a.destroy()
+    assert slabs.edge_count() == 0
+
+
+def test_slab_max_capacity_exhaustion_message():
+    from goworld_tpu.entity.slabs import EntitySlabs
+
+    s = EntitySlabs(capacity=8)
+    s.max_capacity = 8
+    s.exhausted_hint = "custom bound hit"
+    for i in range(8):
+        s.alloc(object())
+    with pytest.raises(RuntimeError, match="custom bound hit"):
+        s.alloc(object())
+
+
+def test_slab_gauges_exported():
+    from goworld_tpu import telemetry
+
+    em.create_entity_locally("Avatar")
+    text = telemetry.render()
+    assert "entity_slab_capacity" in text
+    assert "entity_slab_used" in text
+
+
+# --- per-class batched tick hooks ---------------------------------------------
+
+
+def test_on_tick_batch_one_call_per_class_per_tick():
+    calls = []
+
+    class Batcher(Entity):
+        @classmethod
+        def on_tick_batch(cls, view):
+            calls.append((len(view), list(view.x)))
+
+    em.register_entity(Batcher)
+    a = em.create_entity_locally("Batcher")
+    b = em.create_entity_locally("Batcher")
+    a.set_position(Vector3(1, 0, 0))
+    b.set_position(Vector3(2, 0, 0))
+    em.runtime.slabs.run_tick_batches()
+    assert len(calls) == 1
+    n, xs = calls[0]
+    assert n == 2 and sorted(xs) == [1.0, 2.0]
+    em.runtime.slabs.run_tick_batches()
+    assert len(calls) == 2
+    b.destroy()
+    em.runtime.slabs.run_tick_batches()
+    assert calls[-1][0] == 1
+
+
+def test_on_tick_batch_view_write_sets_sync_flags():
+    class Mover(Entity):
+        @classmethod
+        def on_tick_batch(cls, view):
+            view.set_position_yaw(x=view.x + 1.0, yaw=view.yaw + 90.0)
+
+    em.register_entity(Mover)
+    e = em.create_entity_locally("Mover")
+    e.client = GameClient("M" * 16, 1, e.id)
+    e.set_position(Vector3(5, 0, 0))
+    em.collect_entity_sync_infos()  # drain the initial flag
+    em.runtime.slabs.run_tick_batches()
+    assert e.position.x == 6.0 and e.yaw == 90.0
+    infos = em.collect_entity_sync_infos()
+    arr = np.frombuffer(infos[1], CLIENT_SYNC_BLOCK_DTYPE)
+    assert arr["x"][0] == np.float32(6.0)
+    assert arr["yaw"][0] == np.float32(90.0)
+
+
+def test_on_tick_batch_skips_entities_destroyed_by_hook():
+    class Reaper(Entity):
+        @classmethod
+        def on_tick_batch(cls, view):
+            for e in view.entities:
+                if not e.is_destroyed():
+                    e.destroy()
+            view.set_position_yaw(x=view.x + 1.0)  # must not write freed rows
+
+    em.register_entity(Reaper)
+    e = em.create_entity_locally("Reaper")
+    slot = e._slot
+    em.runtime.slabs.run_tick_batches()
+    assert e.is_destroyed()
+    assert em.runtime.slabs.flags[slot] == 0  # no resurrection of the row
+
+
+def test_on_tick_batch_requires_classmethod():
+    class Bad(Entity):
+        def on_tick_batch(self, view):  # instance method: rejected
+            pass
+
+    em.register_entity(Bad)
+    with pytest.raises(TypeError, match="classmethod"):
+        em.create_entity_locally("Bad")
+
+
+def test_vmapped_position_tick_numeric_behavior():
+    def drift(x, y, z, yaw, dt):
+        return x + 1.0, y, z + 2.0, yaw + 10.0
+
+    class Boid(Entity):
+        on_tick_batch = vmapped_position_tick(drift)
+
+    em.register_entity(Boid)
+    ents = [em.create_entity_locally("Boid") for _ in range(5)]
+    for i, e in enumerate(ents):
+        e.set_position(Vector3(float(i), 0.0, 0.0))
+    em.runtime.slabs.run_tick_batches()
+    for i, e in enumerate(ents):
+        assert e.position.x == float(i) + 1.0
+        assert e.position.z == 2.0
+        assert e.yaw == 10.0
+        assert e._sync_info_flag & SIF_SYNC_OWN_CLIENT
+
+
+def test_tick_view_columns_match_entities():
+    seen = {}
+
+    class Viewer(Entity):
+        @classmethod
+        def on_tick_batch(cls, view: SlabTickView):
+            seen["pairs"] = list(zip(view.entities, view.x.tolist()))
+
+    em.register_entity(Viewer)
+    ents = [em.create_entity_locally("Viewer") for _ in range(4)]
+    for i, e in enumerate(ents):
+        e.set_position(Vector3(10.0 * i, 0, 0))
+    em.runtime.slabs.run_tick_batches()
+    for e, x in seen["pairs"]:
+        assert x == e.position.x
