@@ -436,6 +436,163 @@ def bench_pinned_floor() -> dict:
     }
 
 
+# --- sharded-AOI floor: the spatial halo-exchange engine on a forced mesh ----
+
+# FIXED config (same never-self-tuned philosophy as the pinned floor): the
+# grid-strip spatially sharded engine (parallel/spatial.py) on a FORCED
+# 8-device CPU mesh — the multichip dryrun that used to report "requires
+# tpu/multi-chip" every round, as a measured number. 8192 entities over a
+# 128-column torus (16 columns per strip), 12.5% slot slack so strips keep
+# row budget, radius == cell_size like the other floors. halo_cap 768
+# covers the ~384-row uniform bands 2x. The headline also reports the
+# structural comms: halo bytes vs what the all-gather formulation would
+# move (the reduction is THE point of the spatial engine — on the virtual
+# CPU mesh wall-clock cannot show it, since all 8 "devices" share the
+# host's cores and comms are memcpys).
+SHARDED_FLOOR_CONFIG = {
+    "n": 8192, "cell_size": 100.0, "grid": 128, "space_slots": 1,
+    "cell_capacity": 32, "max_events": 32768, "shards": 8,
+    "halo_cap": 768, "active": 7168, "steps": 20, "repeats": 3,
+    "parity_ticks": 3,
+}
+
+
+def bench_sharded() -> dict:
+    """``bench.py --sharded``: updates/sec of the spatially sharded AOI
+    engine at the fixed config above, best-of-``repeats`` pipelined runs,
+    after an exact event-set parity check against the single-device
+    engine on the same trace. Gated against BENCH_FLOOR.json["sharded"]
+    by tier-1 (tests/test_telemetry.py::test_sharded_floor_gate)."""
+    c = SHARDED_FLOOR_CONFIG
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # Must land before the first jax import; --update-floor and the
+        # tier-1 gate run this in a subprocess for exactly that reason.
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={c['shards']}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < c["shards"]:
+        return {
+            "metric": "sharded_updates_per_sec", "value": 0.0,
+            "unit": "entity-updates/sec",
+            "error": f"only {len(jax.devices())} devices; jax initialized "
+                     "before the forced-mesh flag (run via a fresh "
+                     "process: python bench.py --sharded)",
+        }
+    from goworld_tpu.ops import NeighborEngine, NeighborParams
+    from goworld_tpu.parallel import make_mesh
+    from goworld_tpu.parallel.spatial import SpatialShardedNeighborEngine
+
+    n = c["n"]
+    params = NeighborParams(
+        capacity=n, cell_size=c["cell_size"], grid_x=c["grid"],
+        grid_z=c["grid"], space_slots=c["space_slots"],
+        cell_capacity=c["cell_capacity"], max_events=c["max_events"],
+    )
+    mesh = make_mesh(c["shards"])
+    world = c["grid"] * c["cell_size"]
+
+    def make_world():
+        rng = np.random.default_rng(0)
+        pos = rng.uniform(0, world, (n, 2)).astype(np.float32)
+        active = np.zeros(n, bool)
+        active[:c["active"]] = True
+        space = np.zeros(n, np.int32)
+        radius = np.full(n, 100.0, np.float32)
+        vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
+        return pos, active, space, radius, vel
+
+    eng = SpatialShardedNeighborEngine(
+        params, mesh, halo_cap=c["halo_cap"], prewarm_fallback=False
+    )
+
+    # Exact event-set parity on the measured trace (the floor's honesty
+    # clause: the fast number must be the CORRECT number).
+    single = NeighborEngine(params, backend="jnp")
+    single.reset()
+    eng.reset()
+    pos, active, space, radius, vel = make_world()
+    parity = True
+    for _ in range(c["parity_ticks"]):
+        e1, l1, d1 = single.step(pos, active, space, radius)
+        e2, l2, d2 = eng.step(pos, active, space, radius)
+        if (d1 != d2
+                or sorted(map(tuple, e1)) != sorted(map(tuple, e2))
+                or sorted(map(tuple, l1)) != sorted(map(tuple, l2))):
+            parity = False
+            break
+        pos += vel
+        np.clip(pos, 0.0, world, out=pos)
+
+    runs = []
+    fallback_ticks = 0
+    migrations = 0
+    for _rep in range(c["repeats"]):
+        eng.reset()
+        fb0, mg0 = eng.total_fallbacks, eng.total_migrations
+        pos, active, space, radius, vel = make_world()
+        eng.step(pos, active, space, radius)  # enter storm
+        pending = None
+        t0 = time.perf_counter()
+        for _ in range(c["steps"]):
+            pos += vel
+            np.clip(pos, 0.0, world, out=pos)
+            nxt = eng.step_async(pos, active, space, radius,
+                                 meta_dirty=False)
+            if pending is not None:
+                pending.collect()
+            pending = nxt
+        pending.collect()
+        runs.append(c["steps"] / (time.perf_counter() - t0) * n)
+        fallback_ticks += eng.total_fallbacks - fb0
+        migrations += eng.total_migrations - mg0
+    return {
+        "metric": "sharded_updates_per_sec",
+        "value": round(max(runs), 1),
+        "unit": "entity-updates/sec",
+        "runs": [round(r, 1) for r in runs],
+        "config": dict(c),
+        "mesh": f"1x{c['shards']}",
+        "mesh_devices": c["shards"],
+        "backend": "cpu(jnp,forced-mesh)",
+        "shard_mode": "spatial",
+        "platform": "cpu",
+        "parity_with_single_device": parity,
+        # The comms story, structurally: what the halo exchange moves per
+        # tick vs what the all-gather formulation would move.
+        "halo_bytes_per_tick": eng.halo_bytes_per_tick,
+        "allgather_equiv_bytes_per_tick": eng.allgather_bytes_per_tick,
+        "halo_smaller_than_allgather":
+            eng.halo_bytes_per_tick < eng.allgather_bytes_per_tick,
+        "comms_reduction": round(
+            eng.allgather_bytes_per_tick / max(1, eng.halo_bytes_per_tick),
+            2),
+        "fallback_ticks": fallback_ticks,
+        "shard_migrations": migrations,
+        "floor_file": PINNED_FLOOR_FILE,
+    }
+
+
+def _sharded_floor_tier1_env() -> dict:
+    """bench_sharded in a FRESH subprocess: the forced-mesh XLA flag must
+    precede the first jax init (same reasoning as _pinned_floor_tier1_env,
+    which this mirrors)."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--sharded"],
+        capture_output=True, text=True, env=env, timeout=600, check=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 # --- fan-out floor: game→gate→bots delivered sync records/s ------------------
 
 # FIXED end-to-end configs (same never-self-tuned philosophy as the pinned
@@ -449,21 +606,23 @@ def bench_pinned_floor() -> dict:
 # path ISSUES 2 and 6 rebuilt.
 #
 # ISSUE 6 re-shaped the committed config from 12 bots @ 20 ms to a
-# SATURATING shape: the old config offered only 12*11*50 = 6,600 records/s
-# (the "stuck at 6,336" floor was the offered load, not a capacity wall),
-# so the floor could never show a fan-out win or loss — 24 bots @ 5 ms
-# offer ~110k records/s and the measured number is real capacity.
+# saturating 24 bots @ 5 ms; ISSUE 7's slab pipeline then caught up with
+# THAT offered load too (delivery at the 110k ceiling with ~40% loop
+# idle), so ISSUE 8 re-shaped again: 80 bots @ 5 ms offer ~1.26M
+# records/s, measured delivery ~0.87M — the loop saturates and the floor
+# is real capacity once more. (Keep raising bots whenever delivery
+# reaches ~95% of bots*(bots-1)/sync_interval.)
 FANOUT_CONFIG = {
-    "bots": 24, "gates": 1, "sync_interval": 0.005, "measure_s": 2.0,
+    "bots": 80, "gates": 1, "sync_interval": 0.005, "measure_s": 2.0,
     "windows": 3, "aoi_distance": 100.0,
 }
 # Multi-gate floor variant (ISSUE 6): 2 gates x 52 bots each — the fan-out
 # demux runs per gate and the game packs one buffer per gate, so this
-# shape exercises the per-gate split of every hop. 104 mutually-interested
-# avatars offer ~104*103*20 ≈ 214k records/s at 50 ms cadence: saturating,
-# so the measured number is capacity here too.
+# shape exercises the per-gate split of every hop. ISSUE 8 dropped the
+# cadence 50 ms → 5 ms (offered ~2.1M records/s) because the slab
+# pipeline had caught up with the 50 ms config's 214k offered load.
 FANOUT_MULTI_CONFIG = {
-    "bots": 104, "gates": 2, "sync_interval": 0.05, "measure_s": 2.0,
+    "bots": 104, "gates": 2, "sync_interval": 0.005, "measure_s": 2.0,
     "windows": 2, "aoi_distance": 400.0,
 }
 
@@ -558,7 +717,12 @@ def bench_fanout(trace_sample_rate: int | None = None,
                 if arena is not None:
                     # Clustered well inside one AOI radius: full N x N
                     # interest, every sync fans to every other client.
-                    x = 3.0 * holder["joined"]
+                    # Spacing shrinks past 30 bots so the whole line still
+                    # fits the radius (3*i overflows aoi_distance=100 at
+                    # ~34 bots — the ISSUE 8 re-saturation hit exactly
+                    # that wall).
+                    gap = min(3.0, 90.0 / max(1, n_bots))
+                    x = gap * holder["joined"]
                     holder["joined"] += 1
                     self.enter_space(arena.id, Vector3(x, 0.0, 10.0))
 
@@ -572,8 +736,8 @@ def bench_fanout(trace_sample_rate: int | None = None,
                 cls._accum = min(cls._accum - c["sync_interval"],
                                  c["sync_interval"])
                 cls._phase ^= 1
-                # Avatars sit at x = 3*i (+0.5 on odd phases): jitter in
-                # place without leaving the shared AOI neighborhood.
+                # Avatars jitter half a unit in place on odd phases,
+                # never leaving the shared AOI neighborhood.
                 import numpy as _np
 
                 x = _np.floor(view.x) + (0.5 if cls._phase else 0.0)
@@ -1094,7 +1258,15 @@ def update_floor(allow_lower: bool = False) -> int:
     the same commit as any deliberate AOI/sync hot-path perf change."""
     spec = json.loads(open(PINNED_FLOOR_FILE).read())
     kept: dict = {}
+    # Floor provenance keys copied into BENCH_FLOOR.json verbatim: which
+    # code path / mesh produced the number, so a re-baseline is
+    # attributable (sync_path for the fan-out floors, mesh shape +
+    # backend for the sharded floor).
+    prov_keys = ("sync_path", "slab_entities", "mesh", "backend",
+                 "shard_mode", "parity_with_single_device",
+                 "halo_bytes_per_tick", "allgather_equiv_bytes_per_tick")
     for key, fn in (("pinned", _pinned_floor_tier1_env),
+                    ("sharded", _sharded_floor_tier1_env),
                     ("fanout", bench_fanout),
                     ("fanout_multi", bench_fanout_multi)):
         vals = []
@@ -1103,19 +1275,16 @@ def update_floor(allow_lower: bool = False) -> int:
             vals.append(r["value"])
             line = {"floor": key, "measured": r["value"],
                     "runs": r["runs"]}
-            if "sync_path" in r:
-                # Record WHICH entity path produced the number (slab vs
-                # legacy) and how many slab slots were live — a floor
-                # re-baseline must be attributable to its code path.
-                line["sync_path"] = r["sync_path"]
-                line["slab_entities"] = r["slab_entities"]
+            for k in prov_keys:
+                if k in r:
+                    line[k] = r[k]
             print(json.dumps(line, separators=(",", ":")))
         measured = min(vals)
         entry = spec.setdefault(key, {
             "metric": r["metric"], "tolerance": 0.25, "unit": r["unit"]})
-        if "sync_path" in r:
-            entry["sync_path"] = r["sync_path"]
-            entry["slab_entities"] = r["slab_entities"]
+        for k in prov_keys:
+            if k in r:
+                entry[k] = r[k]
         old = entry.get("floor")
         if old is not None and measured < old and not allow_lower:
             kept[key] = old
@@ -1131,6 +1300,7 @@ def update_floor(allow_lower: bool = False) -> int:
         f.write("\n")
     print(json.dumps({"updated": PINNED_FLOOR_FILE,
                       "pinned": spec["pinned"]["floor"],
+                      "sharded": spec["sharded"]["floor"],
                       "fanout": spec["fanout"]["floor"],
                       "fanout_multi": spec["fanout_multi"]["floor"],
                       "kept": kept or None},
@@ -1144,6 +1314,8 @@ def main() -> int:
     for flag, fn, metric, unit in (
         ("--pinned-floor", bench_pinned_floor,
          "pinned_floor_updates_per_sec", "entity-updates/sec"),
+        ("--sharded", bench_sharded,
+         "sharded_updates_per_sec", "entity-updates/sec"),
         ("--fanout-multi", bench_fanout_multi,
          "fanout_multi_sync_records_per_sec", "sync-records/sec"),
         ("--fanout", bench_fanout,

@@ -175,7 +175,12 @@ class AOIConfig:
     platform: str = "auto"  # auto | cpu | tpu
     cell_capacity: int = 64
     max_entities: int = 16384  # padded capacity of the batched engine
-    mesh_shards: int = 1  # entity-shard axis over devices
+    mesh_shards: int = 1  # device shards of the batched engine's mesh
+    # How mesh_shards > 1 splits the work: "spatial" shards the AOI grid
+    # into column strips with halo exchange (O(boundary) comms,
+    # parallel/spatial.py); "entity" shards entity rows with a full
+    # all-gather per tick (parallel/mesh.py — the Pallas-kernel tier).
+    shard_mode: str = "spatial"  # spatial | entity
     # Grid geometry (0 = derive from max_entities; see params_from_config).
     grid: int = 0  # cells per side (grid_x = grid_z)
     cell_size: float = 0.0  # cell side length; must be >= max AOI distance
@@ -189,6 +194,15 @@ class AOIConfig:
     # the same op sequence). Mutually exclusive with mesh_shards > 1.
     multihost_coordinator: str = ""  # "" = disabled
     multihost_processes: int = 0  # 0 = len(games)
+    # Persistent XLA compilation cache for the batched engine's jits:
+    # "auto" = <process cwd>/.goworld_jax_cache (the cwd already hosts
+    # freeze files), "off" = disabled, anything else = explicit dir. The
+    # point is the RESPAWN path: a freeze->restore restart re-compiles
+    # every step jit from scratch (~4-6 s on a small host) inside the
+    # 5 s RPC window buffered clients are waiting out; with the cache the
+    # restored process LOADS the executables instead (measured 6.0 s ->
+    # 2.5 s boot-to-warm on the verify rig).
+    compilation_cache: str = "auto"  # auto | off | <dir>
     # Delivery model of the batched engine: "pipelined" (default — diffs
     # land one game tick late, the loop never stalls on device compute) or
     # "sync" (diffs land the same tick, within one readback of the step
@@ -417,6 +431,8 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             cell_capacity=int(s.get("cell_capacity", 64)),
             max_entities=int(s.get("max_entities", 16384)),
             mesh_shards=int(s.get("mesh_shards", 1)),
+            shard_mode=s.get("shard_mode", "spatial").strip().lower(),
+            compilation_cache=s.get("compilation_cache", "auto").strip(),
             grid=int(s.get("grid", 0)),
             cell_size=float(s.get("cell_size", 0.0)),
             space_slots=int(s.get("space_slots", 0)),
@@ -499,6 +515,11 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[aoi] cell_capacity must be in [1, 128]")
     if a.mesh_shards < 1:
         raise ValueError("[aoi] mesh_shards must be >= 1")
+    if a.shard_mode not in ("spatial", "entity"):
+        raise ValueError("[aoi] shard_mode must be spatial or entity")
+    if not a.compilation_cache:
+        raise ValueError(
+            "[aoi] compilation_cache must be auto, off, or a directory")
     if a.grid != 0 and not (4 <= a.grid <= 512):
         raise ValueError("[aoi] grid must be 0 (derive) or in [4, 512]")
     if a.cell_size < 0.0:

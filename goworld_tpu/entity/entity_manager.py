@@ -63,6 +63,9 @@ class Runtime:
         self.aoi_service = None  # BatchAOIService, lazily created
         self.aoi_params = None  # NeighborParams override
         self.aoi_mesh_shards: int = 1  # [aoi] mesh_shards: devices to shard over
+        # [aoi] shard_mode: spatial (grid-strip halo exchange) | entity
+        # (all-gather rows); only read when mesh_shards > 1.
+        self.aoi_shard_mode: str = "spatial"
         # Multi-HOST (DCN) tier: True once this process has joined the
         # jax.distributed mesh ([aoi] multihost_coordinator; the game
         # service calls init_multihost before any jax use).
@@ -94,6 +97,7 @@ class Runtime:
             self.aoi_service = BatchAOIService(
                 params, mesh_shards=self.aoi_mesh_shards,
                 multihost=self.aoi_multihost,
+                shard_mode=self.aoi_shard_mode,
             )
             self.aoi_service.delivery = self.aoi_delivery
             self.aoi_service.sync_wait_budget = self.aoi_sync_wait_budget

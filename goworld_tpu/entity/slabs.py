@@ -194,15 +194,14 @@ def vmapped_position_tick(fn):
     either way."""
     state: dict = {}
 
-    def hook(cls, view: SlabTickView) -> None:
-        if len(view) == 0:
-            return
+    def _batched():
         batched = state.get("fn")
         if batched is None:
             try:
                 import jax
 
                 jitted = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None)))
+                state["jitted"] = jitted
 
                 def batched(x, y, z, yaw, dt):
                     out = jitted(x, y, z, yaw, dt)
@@ -211,10 +210,40 @@ def vmapped_position_tick(fn):
             except Exception:  # pragma: no cover - jax is in the image
                 batched = fn
             state["fn"] = batched
-        x, y, z, yaw = batched(
+        return batched
+
+    def hook(cls, view: SlabTickView) -> None:
+        if len(view) == 0:
+            return
+        x, y, z, yaw = _batched()(
             view.x, view.y, view.z, view.yaw, np.float32(view.dt))
         view.set_position_yaw(x, y, z, yaw)
 
+    def prewarm(n: int, dt: float = 0.05) -> None:
+        """Compile the hook's jit at population ``n`` with a dummy-shaped
+        call (results discarded). The vmapped jit specializes on the view
+        LENGTH, so a restored game pre-warms each adopted class at its
+        restored population BEFORE re-handshaking — otherwise the first
+        live tick after clients re-attach pays the XLA trace while RPCs
+        are already flowing (the ~4.7 s respawn stall of ISSUE 7)."""
+        if n <= 0:
+            return
+        z = np.zeros(n, np.float32)
+        _batched()(z, z, z, z, np.float32(dt))
+
+    def jit_cache_size() -> int:
+        """Compiled-trace count of the underlying jit (0 before first
+        use; tests assert the restore path adds no fresh trace)."""
+        jitted = state.get("jitted")
+        if jitted is None:
+            return 0
+        try:
+            return int(jitted._cache_size())
+        except Exception:  # pragma: no cover - private-API drift
+            return -1
+
+    hook.prewarm = prewarm
+    hook.jit_cache_size = jit_cache_size
     return classmethod(hook)
 
 
@@ -544,6 +573,22 @@ class EntitySlabs:
             bucket = self._tick_buckets[cls] = _TickBucket()
             bucket.last_tick = time.monotonic()
         bucket.add(entity, slot)
+
+    def prewarm_tick_hooks(self) -> None:
+        """Dummy-shaped compile of every adopted class's batched tick jit
+        at its CURRENT live population (vmapped_position_tick.prewarm).
+        The restore path calls this before the cluster re-handshake so
+        the first live tick triggers no fresh trace; hooks without a
+        prewarm surface (hand-written on_tick_batch bodies) are skipped —
+        whatever they lazily build is their own contract."""
+        for cls, bucket in list(self._tick_buckets.items()):
+            n = len(bucket.entities)
+            if n == 0:
+                continue
+            hook = inspect.getattr_static(cls, "on_tick_batch", None)
+            pw = getattr(getattr(hook, "__func__", None), "prewarm", None)
+            if pw is not None:
+                gwutils.run_panicless(lambda p=pw, k=n: p(k))
 
     def run_tick_batches(self, now: float | None = None) -> None:
         """Fire each adopted class's ``on_tick_batch`` once over its live

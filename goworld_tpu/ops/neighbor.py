@@ -205,6 +205,27 @@ def sorted_ranks(key: jax.Array, n: int, num_buckets: int):
     return order, sorted_key, iota - first
 
 
+def sorted_ranks_by(key: jax.Array, tie: jax.Array, n_rows: int):
+    """Stable (key, tie) lexicographic sort + within-key-run ranks.
+
+    Like :func:`sorted_ranks`, but ties within a bucket break by ``tie``
+    (the entity SLOT id) instead of row position. The spatially sharded
+    engine's strip-local table builds use this (parallel/spatial.py): a
+    seam cell's rows exist as copies on two shards in different local
+    orders, so cell-capacity drop choices must key on something globally
+    stable — slot order, which is also exactly the single-device engine's
+    row order. Returns (order, sorted_key, rank)."""
+    iota = jnp.arange(n_rows, dtype=jnp.int32)
+    sorted_key, _, order = jax.lax.sort(
+        (key, tie, iota), num_keys=2
+    )
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), jnp.bool_), sorted_key[1:] != sorted_key[:-1]]
+    )
+    first = jax.lax.cummax(jnp.where(boundary, iota, 0))
+    return order, sorted_key, iota - first
+
+
 def _build_table(
     p: NeighborParams, bucket: jax.Array, active: jax.Array, stride: int
 ):
@@ -1046,6 +1067,14 @@ class NeighborEngine:
                 jnp.arange(n, dtype=jnp.int32),  # porder
                 jnp.full((n,), table_size, jnp.int32),  # pdst
             )
+
+    def carried_epoch(self) -> tuple:
+        """The last dispatched (pos, active, space, radius) as numpy in
+        SLOT space — the tier-growth reseed contract every engine speaks
+        (the spatial engine's device state is row-permuted, so callers
+        must not peek at ``_state`` directly)."""
+        assert self._state is not None, "call reset() first"
+        return tuple(np.asarray(a) for a in self._state[0:4])
 
     def _page(self, ctx, remaining: int, start_flat: int) -> np.ndarray:
         chunks = []

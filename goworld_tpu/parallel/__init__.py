@@ -4,5 +4,10 @@ entity-sharding across game processes (SURVEY.md §2.9).
 """
 
 from goworld_tpu.parallel.mesh import ShardedNeighborEngine, make_mesh
+from goworld_tpu.parallel.spatial import SpatialShardedNeighborEngine
 
-__all__ = ["ShardedNeighborEngine", "make_mesh"]
+__all__ = [
+    "ShardedNeighborEngine",
+    "SpatialShardedNeighborEngine",
+    "make_mesh",
+]

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from goworld_tpu.parallel.compat import resolve_shard_map
 from goworld_tpu.ops.neighbor import (
     LANES,
     _PACK,
@@ -176,6 +177,7 @@ def _sharded_step_pallas(
     p: NeighborParams,
     events_inline: int,
     interpret: bool,
+    n_dev: int,
     ppos_l, pact_l, pspc_l, prad_l,
     pos_l, act_l, spc_l, rad_l,
 ):
@@ -190,7 +192,9 @@ def _sharded_step_pallas(
     because each entity lives in exactly one cell per pass.
     """
     n = p.capacity
-    n_dev = jax.lax.axis_size(SHARD_AXIS)
+    # n_dev rides in statically from the jit builder: jax.lax.axis_size
+    # does not exist on this image's jax (0.4.37), and the mesh size is a
+    # compile-time constant here anyway (rows must be static).
     rows = p.grid_z // n_dev
     shard = jax.lax.axis_index(SHARD_AXIS)
     lo = shard * rows
@@ -314,7 +318,7 @@ def _sharded_drain(
 
 @functools.lru_cache(maxsize=None)
 def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int):
-    from jax import shard_map
+    shard_map = resolve_shard_map()
 
     body = functools.partial(_sharded_step, params, events_inline)
     spec = P(SHARD_AXIS)
@@ -336,10 +340,11 @@ def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int)
 def _jitted_sharded_step_pallas(
     params: NeighborParams, mesh: Mesh, events_inline: int, interpret: bool
 ):
-    from jax import shard_map
+    shard_map = resolve_shard_map()
 
     body = functools.partial(
-        _sharded_step_pallas, params, events_inline, interpret
+        _sharded_step_pallas, params, events_inline, interpret,
+        mesh.devices.size,
     )
     spec = P(SHARD_AXIS)
     mapped = shard_map(
@@ -359,7 +364,7 @@ def _jitted_sharded_step_pallas(
 def _jitted_sharded_drain(
     params: NeighborParams, mesh: Mesh, events_inline: int, chunk: int
 ):
-    from jax import shard_map
+    shard_map = resolve_shard_map()
 
     body = functools.partial(_sharded_drain, params, events_inline, chunk)
     spec = P(SHARD_AXIS)
@@ -373,7 +378,7 @@ def _jitted_sharded_drain(
 def _jitted_sharded_drain_bits(
     params: NeighborParams, mesh: Mesh, events_inline: int
 ):
-    from jax import shard_map
+    shard_map = resolve_shard_map()
 
     body = functools.partial(_sharded_drain_bits, params, events_inline)
     spec = P(SHARD_AXIS)
@@ -522,6 +527,12 @@ class ShardedNeighborEngine:
             put(np.zeros((n,), np.int32)),
             put(np.zeros((n,), np.float32)),
         )
+
+    def carried_epoch(self) -> tuple:
+        """Last dispatched world in slot space (rows == slots here);
+        see NeighborEngine.carried_epoch."""
+        assert self._state is not None, "call reset() first"
+        return tuple(np.asarray(a) for a in self._state[0:4])
 
     def _page(
         self, ctx: tuple, deficit: np.ndarray, starts: np.ndarray

@@ -1,0 +1,844 @@
+"""Spatially sharded AOI: grid-column strips with halo exchange.
+
+The entity-sharded engine (parallel/mesh.py) all-gathers EVERY feature
+array every tick so each device can rebuild the whole world's grid — an
+O(N) replicated broadcast plus a replicated N-key sort per device. This
+engine shards the *grid* instead: the torus's columns are split into D
+contiguous strips, each device owns the entity rows whose cell lies in its
+strip, and per tick the only cross-device traffic is a ``ppermute`` of the
+boundary-strip rows (cells within one interaction radius of a seam,
+covering BOTH epochs so enter/leave diffs at the seam stay exact) to the
+two ring neighbors. Communication drops from O(N) to O(boundary), and the
+per-tick table build sorts only a strip's rows instead of all N.
+
+Host-side layout (the part jax never sees):
+
+- Entity→shard assignment is recomputed from the slab's ``xz`` columns
+  each dispatch with ONE CELL of hysteresis: a row migrates only after its
+  cell is a full column past the seam, so seam-straddlers don't thrash.
+  The ownership invariant at every dispatch is
+  ``cx ∈ [strip_lo - 1, strip_hi]`` (one column of slack each side).
+- Strip boundaries come from observed column density — an
+  equal-population split re-planned at a slow cadence (and immediately
+  when a strip overflows its row budget) — the AoiZora-style
+  density-aware placement seed (PAPERS.md).
+- Row permutation: device rows ``[d*chunk, (d+1)*chunk)`` hold the slots
+  assigned to shard d (active slots sorted by slot id, then inactive
+  fill). When any slot migrates, the PREVIOUS epoch is re-uploaded in the
+  new layout from the host mirror, so the device diff never sees a
+  migration as a despawn+spawn — event streams are migration-transparent.
+
+Exactness contract (same event sets as the single-device engine):
+
+- Each query's 3×3 cell neighborhood, in both epochs, is fully populated
+  on its owner: neighbors exchange the rows whose current OR previous
+  cell lies within 3 columns of the seam, and strips are kept ≥ 4
+  columns wide so one ring hop suffices.
+- Cell-capacity drops break ties by SLOT id (ops/neighbor.sorted_ranks_by),
+  so a seam cell's surviving set is identical on every shard holding a
+  copy — and identical to the single-device engine's.
+- Ticks the strip invariants cannot cover — a teleport whose previous
+  cell escapes the halo, a halo-budget overflow, a strip whose population
+  exceeds its row budget even after a re-plan — fall back to the exact
+  all-gather program (parallel/mesh._sharded_step) for that tick, counted
+  on ``aoi_shard_fallback_total{reason}``.
+
+Same host interface as the other engines: ``step_async`` returns a
+pending with ONE blocking packed readback in ``collect()``, storm paging
+beyond the per-shard inline budget, and the ``meta_dirty=False`` upload
+elision (which additionally requires an unchanged row permutation here).
+jnp backend only: the Pallas grid-slab kernel path already shards the
+kernel grid spatially (mesh.py) — this engine is the comms-side analog
+for the all-gather-bound jnp tier.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from goworld_tpu.ops.neighbor import (
+    NeighborParams,
+    _bins,
+    _drain_ids,
+    _gather_cands,
+    _pair_valid,
+    bins_reference,
+    check_radius,
+    sorted_ranks_by,
+)
+from goworld_tpu.parallel.compat import resolve_shard_map
+from goworld_tpu.parallel.mesh import (
+    SHARD_AXIS,
+    ShardedPendingStep,
+    _jitted_sharded_drain,
+    _jitted_sharded_step,
+)
+
+# Halo feature-block bytes per exchanged row: f32 (px, pz, x, z) + i32
+# (pspc, spc, slot) + bool (pact, act). Radius does NOT travel: the pair
+# predicate only reads the QUERY side's radius, and queries never leave
+# their owner.
+HALO_ROW_BYTES = 4 * 4 + 3 * 4 + 2 * 1
+
+# Minimum strip width (columns). 3 is the correctness floor (a 3-column
+# halo band must not reach past the adjacent strip); 4 adds one column of
+# margin so the band arithmetic never wraps into the same strip twice.
+MIN_STRIP_COLS = 4
+
+
+def _build_table_spatial(p: NeighborParams, bucket, active, slots, chunk):
+    """Strip-local table build over the combined (own + ghost) rows.
+
+    Differs from ops/neighbor._build_table in two load-bearing ways: table
+    values are COMBINED-ROW indices (sentinel n_rows), and cell-capacity
+    ties break by SLOT id — every shard holding a copy of a seam cell
+    must drop the same members the single-device engine would.
+    Returns (table, in_table bool[n_rows], own_dropped)."""
+    n_rows = bucket.shape[0]
+    m = p.cell_capacity
+    key = jnp.where(active, bucket, p.num_buckets)
+    order, sorted_key, rank = sorted_ranks_by(key, slots, n_rows)
+    ok = (sorted_key < p.num_buckets) & (rank < m)
+    table_size = p.num_buckets * m
+    dst = jnp.where(ok, sorted_key * m + rank, table_size)
+    table = jnp.full((table_size,), n_rows, dtype=jnp.int32)
+    table = table.at[dst].set(order.astype(jnp.int32), mode="drop")
+    in_table = jnp.zeros((n_rows,), bool).at[order].set(ok)
+    dropped_sorted = (sorted_key < p.num_buckets) & ~ok
+    own_dropped = jnp.sum(dropped_sorted & (order < chunk)).astype(jnp.int32)
+    return table, in_table, own_dropped
+
+
+def _spatial_step_impl(
+    p: NeighborParams,
+    events_inline: int,
+    halo_cap: int,
+    n_dev: int,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    slot_l,
+    send_lo_idx,
+    send_hi_idx,
+):
+    n = p.capacity
+    chunk = pos_l.shape[0]
+    h = halo_cap
+    n_all = chunk + 2 * h
+
+    def pack_band(idx):
+        safe = jnp.minimum(idx, chunk - 1)
+        pad = idx >= chunk
+        f32b = jnp.stack(
+            [ppos_l[safe, 0], ppos_l[safe, 1], pos_l[safe, 0], pos_l[safe, 1]],
+            axis=1,
+        )
+        i32b = jnp.stack(
+            [pspc_l[safe], spc_l[safe], jnp.where(pad, n, slot_l[safe])],
+            axis=1,
+        )
+        boolb = jnp.stack([pact_l[safe] & ~pad, act_l[safe] & ~pad], axis=1)
+        return f32b, i32b, boolb
+
+    fwd = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+    bwd = [(i, (i - 1) % n_dev) for i in range(n_dev)]
+
+    def exchange(blocks, perm):
+        return tuple(
+            jax.lax.ppermute(b, SHARD_AXIS, perm=perm) for b in blocks
+        )
+
+    # from_left = my predecessor's high-seam band; from_right = my
+    # successor's low-seam band.
+    from_left = exchange(pack_band(send_hi_idx), fwd)
+    from_right = exchange(pack_band(send_lo_idx), bwd)
+
+    def unpack(blocks):
+        f32b, i32b, boolb = blocks
+        return (
+            f32b[:, 0:2], f32b[:, 2:4],  # ppos, pos
+            i32b[:, 0], i32b[:, 1], i32b[:, 2],  # pspc, spc, slot
+            boolb[:, 0], boolb[:, 1],  # pact, act
+        )
+
+    gl_ppos, gl_pos, gl_pspc, gl_spc, gl_slot, gl_pact, gl_act = unpack(
+        from_left
+    )
+    gr_ppos, gr_pos, gr_pspc, gr_spc, gr_slot, gr_pact, gr_act = unpack(
+        from_right
+    )
+
+    pos_all = jnp.concatenate([pos_l, gl_pos, gr_pos], axis=0)
+    ppos_all = jnp.concatenate([ppos_l, gl_ppos, gr_ppos], axis=0)
+    act_all = jnp.concatenate([act_l, gl_act, gr_act])
+    pact_all = jnp.concatenate([pact_l, gl_pact, gr_pact])
+    spc_all = jnp.concatenate([spc_l, gl_spc, gr_spc])
+    pspc_all = jnp.concatenate([pspc_l, gl_pspc, gr_pspc])
+    slot_all = jnp.concatenate([slot_l, gl_slot, gr_slot])
+
+    cxc, czc, smc = _bins(p, pos_all, spc_all)
+    cxp, czp, smp = _bins(p, ppos_all, pspc_all)
+    buc_c = (smc * p.grid_z + czc) * p.grid_x + cxc
+    buc_p = (smp * p.grid_z + czp) * p.grid_x + cxp
+    # Strip-local sorts over chunk + 2h keys — the replicated N-key sorts
+    # of the all-gather formulation are what this engine deletes.
+    table_c, av_c, own_drop = _build_table_spatial(
+        p, buc_c, act_all, slot_all, chunk
+    )
+    table_p, av_p, _ = _build_table_spatial(
+        p, buc_p, pact_all, slot_all, chunk
+    )
+
+    q_iota = jnp.arange(chunk, dtype=jnp.int32)
+
+    def emask(cand, q_pos, q_av, q_spc, q_rad, pos_a, av_a, spc_a):
+        safe = jnp.minimum(cand, n_all - 1)
+        not_self = (cand < n_all) & (cand != q_iota[:, None])
+        return _pair_valid(
+            q_av[:, None],
+            q_spc[:, None],
+            (q_rad * q_rad)[:, None],
+            q_pos[:, 0][:, None],
+            q_pos[:, 1][:, None],
+            av_a[safe],
+            spc_a[safe],
+            pos_a[:, 0][safe],
+            pos_a[:, 1][safe],
+            not_self,
+        )
+
+    # Enter pass: candidates from the current grid, own rows as queries.
+    cand_c = _gather_cands(p, table_c, cxc[:chunk], czc[:chunk], smc[:chunk])
+    vc = emask(cand_c, pos_l, av_c[:chunk], spc_l, rad_l,
+               pos_all, av_c, spc_all)
+    vp_on_c = emask(cand_c, ppos_l, av_p[:chunk], pspc_l, prad_l,
+                    ppos_all, av_p, pspc_all)
+    enter_mask = vc & ~vp_on_c
+
+    # Leave pass on the previous grid. (No single-launch fast path here:
+    # both builds are strip-local already, so the second table costs a
+    # chunk+2h sort, not the all-gather path's replicated N-key sort.)
+    cand_p = _gather_cands(p, table_p, cxp[:chunk], czp[:chunk], smp[:chunk])
+    vp = emask(cand_p, ppos_l, av_p[:chunk], pspc_l, prad_l,
+               ppos_all, av_p, pspc_all)
+    vc_on_p = emask(cand_p, pos_l, av_c[:chunk], spc_l, rad_l,
+                    pos_all, av_c, spc_all)
+    leave_mask = vp & ~vc_on_p
+
+    def slot_of(cand):
+        return slot_all[jnp.minimum(cand, n_all - 1)]
+
+    enter_ids = jnp.where(enter_mask, slot_of(cand_c), n)
+    leave_ids = jnp.where(leave_mask, slot_of(cand_p), n)
+    n_enters = jnp.sum(enter_mask).astype(jnp.int32)
+    n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
+    dropped_total = jax.lax.psum(own_drop, SHARD_AXIS).astype(jnp.int32)
+
+    ep, ei = _drain_ids(enter_ids, n, events_inline, jnp.int32(0))
+    lp, li = _drain_ids(leave_ids, n, events_inline, jnp.int32(0))
+
+    def slotize(pairs):
+        ent = pairs[:, 0]
+        ent = jnp.where(
+            ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n
+        )
+        return jnp.stack([ent, pairs[:, 1]], axis=1)
+
+    header = jnp.stack(
+        [
+            jnp.stack([n_enters, n_leaves]),
+            jnp.stack([dropped_total, jnp.int32(0)]),
+            jnp.stack([ei[events_inline - 1], li[events_inline - 1]]),
+        ]
+    ).astype(jnp.int32)
+    # Replicated per-shard counts: same storm-paging convergence contract
+    # as parallel/mesh._sharded_step (ShardedPendingStep reads them).
+    counts_all = jax.lax.all_gather(header[0], SHARD_AXIS)  # [D, 2]
+    out = jnp.concatenate(
+        [header, counts_all, slotize(ep), slotize(lp)], axis=0
+    )
+    return enter_ids, leave_ids, out
+
+
+def _spatial_drain(
+    p: NeighborParams, events_inline: int, chunk: int,
+    ids_l: jax.Array,  # [chunk, 9M] this shard's SLOT-id event matrix
+    slot_l: jax.Array,  # [chunk] row → slot
+    start_l: jax.Array,  # [1] resume cursor (local flat index)
+):
+    n = p.capacity
+    pairs, idx = _drain_ids(ids_l, n, events_inline, start_l[0])
+    ent = pairs[:, 0]
+    ent = jnp.where(ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n)
+    pairs = jnp.stack([ent, pairs[:, 1]], axis=1)
+    return pairs, idx[None]
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_step(
+    params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(
+        _spatial_step_impl, params, events_inline, halo_cap,
+        mesh.devices.size,
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * 11,
+        out_specs=(spec, spec, spec),
+    )
+    return jax.jit(mapped)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_drain(
+    params: NeighborParams, mesh: Mesh, events_inline: int, chunk: int
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(_spatial_drain, params, events_inline, chunk)
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec),
+        out_specs=(spec, spec),
+    )
+    return jax.jit(mapped)
+
+
+def plan_strips(
+    col_pop: np.ndarray, n_dev: int, min_cols: int = MIN_STRIP_COLS
+) -> np.ndarray:
+    """Equal-population strip boundaries from an observed column histogram.
+
+    Returns int32[D+1] with boundaries[0] == 0 and boundaries[D] == grid_x.
+    Each strip gets ≥ min_cols columns (the halo-correctness floor); the
+    split otherwise walks the population cumsum so every strip carries
+    ~1/D of the entities — hot columns get narrow strips, empty space gets
+    wide ones (the AoiZora-style density-aware placement seed)."""
+    gx = len(col_pop)
+    if gx < n_dev * min_cols:
+        raise ValueError(
+            f"grid_x {gx} < {n_dev} shards * {min_cols} min columns"
+        )
+    cum = np.concatenate([[0], np.cumsum(col_pop, dtype=np.int64)])
+    total = cum[-1]
+    bounds = np.zeros(n_dev + 1, np.int32)
+    bounds[n_dev] = gx
+    for d in range(1, n_dev):
+        target = total * d // n_dev
+        b = int(np.searchsorted(cum, target, side="left"))
+        # Clamp so every strip (including the ones still to come) keeps
+        # its minimum width.
+        b = max(b, int(bounds[d - 1]) + min_cols)
+        b = min(b, gx - (n_dev - d) * min_cols)
+        bounds[d] = b
+    return bounds
+
+
+class SpatialShardedNeighborEngine:
+    """Grid-strip sharded AOI engine (see module docstring).
+
+    Interface parity with ShardedNeighborEngine: ``reset`` /
+    ``step_async`` / ``step``, one packed readback per tick, paging past
+    the per-shard inline budget. Extra observability attributes:
+    ``last_mode`` ("spatial" | "fallback:<reason>"), ``shard_population``
+    (np int64[D] active rows per shard at the last dispatch),
+    ``halo_bytes_per_tick`` (structural ppermute payload), and the
+    telemetry counters wired in ``__init__``.
+    """
+
+    backend = "jnp"  # paging is flat-index (rank_paging False)
+
+    def __init__(
+        self,
+        params: NeighborParams,
+        mesh: Mesh,
+        halo_cap: int | None = None,
+        replan_interval: int = 64,
+        prewarm_fallback: bool = True,
+    ) -> None:
+        n_dev = int(mesh.devices.size)
+        if n_dev < 2:
+            raise ValueError("spatial sharding needs >= 2 devices")
+        if params.capacity % (8 * n_dev) != 0:
+            raise ValueError(
+                f"capacity {params.capacity} must be a multiple of 8*{n_dev}"
+            )
+        if params.max_events % n_dev != 0:
+            raise ValueError(
+                f"max_events {params.max_events} must be divisible by {n_dev}"
+            )
+        if params.grid_x < MIN_STRIP_COLS * n_dev:
+            raise ValueError(
+                f"grid_x {params.grid_x} < {MIN_STRIP_COLS}*{n_dev} "
+                f"(each strip needs >= {MIN_STRIP_COLS} columns for the "
+                f"halo contract); raise [aoi] grid or lower mesh_shards"
+            )
+        self.params = params
+        self.mesh = mesh
+        self.n_devices = n_dev
+        self.chunk = params.capacity // n_dev
+        self.events_inline = params.max_events // n_dev
+        if halo_cap is None:
+            # ~6 band columns of the uniform-density column population,
+            # doubled for clustering, clamped to the chunk (an overflow
+            # past this budget falls back for the tick, it never breaks).
+            est = 12 * params.capacity // params.grid_x
+            halo_cap = max(64, min(self.chunk, ((est + 7) // 8) * 8))
+        self.halo_cap = int(halo_cap)
+        self.replan_interval = int(replan_interval)
+        self.halo_bytes_per_tick = (
+            n_dev * 2 * self.halo_cap * HALO_ROW_BYTES
+        )
+        # What the all-gather formulation moves instead: every OTHER
+        # shard's rows, both epochs (pos 8B + act 1B + spc 4B + rad 4B
+        # each), received by each of the D devices.
+        self.allgather_bytes_per_tick = (
+            n_dev * (params.capacity - self.chunk) * 34
+        )
+        self._jit_step = _jitted_spatial_step(
+            params, mesh, self.events_inline, self.halo_cap
+        )
+        self._jit_drain = _jitted_spatial_drain(
+            params, mesh, self.events_inline, self.chunk
+        )
+        # Exact all-gather program for ticks the strip invariants cannot
+        # cover (teleports past the halo, halo overflow, strip overflow).
+        self._jit_fallback = _jitted_sharded_step(
+            params, mesh, self.events_inline
+        )
+        self._jit_fallback_drain = _jitted_sharded_drain(
+            params, mesh, self.events_inline, self.chunk
+        )
+        self._flat_end = self.chunk * 9 * params.cell_capacity
+        self._sharding = NamedSharding(mesh, P(SHARD_AXIS))
+        self._state: tuple | None = None
+        self.last_grid_dropped = 0
+        self.last_mode = "spatial"
+        self.shard_population = np.zeros(n_dev, np.int64)
+        self.total_migrations = 0
+        self.total_fallbacks = 0
+        self.total_replans = 0
+        from goworld_tpu import telemetry
+
+        telemetry.gauge(
+            "aoi_shard_count",
+            "Device shards of the spatially sharded AOI engine.",
+        ).set(n_dev)
+        self._m_shard_entities = telemetry.gauge(
+            "aoi_shard_entities",
+            "Active entity rows owned by each AOI grid-strip shard at the "
+            "last dispatch.",
+            ("shard",),
+        )
+        self._m_halo_bytes = telemetry.counter(
+            "aoi_halo_bytes_total",
+            "Bytes ppermuted between shards for AOI halo exchange "
+            "(structural: halo_cap rows x 2 directions x D shards per "
+            "spatial tick).",
+        )
+        self._m_migrations = telemetry.counter(
+            "aoi_shard_migrations_total",
+            "Entities reassigned to a different AOI grid-strip shard "
+            "(hysteresis: one full cell past the seam).",
+        )
+        self._m_fallback = telemetry.counter(
+            "aoi_shard_fallback_total",
+            "Ticks the spatial engine ran the exact all-gather program "
+            "instead of the halo exchange.",
+            ("reason",),
+        )
+        self._m_replans = telemetry.counter(
+            "aoi_shard_replans_total",
+            "Density-driven strip re-plans adopted (equal-population "
+            "boundary moves).",
+        )
+        if prewarm_fallback:
+            # The fallback program compiles lazily on its (rare) first
+            # tick otherwise — a synchronous XLA compile inside the game
+            # loop. Best-effort daemon warmup, same pattern as
+            # BatchAOIService._prewarm_next_tier.
+            threading.Thread(
+                target=self._prewarm_fallback, name="aoi-spatial-fallback",
+                daemon=True,
+            ).start()
+
+    # --- host-side shard layout ---------------------------------------------
+
+    def _prewarm_fallback(self) -> None:
+        try:
+            n = self.params.capacity
+            put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+            z = (
+                put(np.zeros((n, 2), np.float32)),
+                put(np.zeros((n,), bool)),
+                put(np.zeros((n,), np.int32)),
+                put(np.zeros((n,), np.float32)),
+            )
+            jax.block_until_ready(self._jit_fallback(*z, *z)[2])
+        except Exception:  # pragma: no cover - prewarm is best-effort
+            pass
+
+    def reset(self) -> None:
+        n = self.params.capacity
+        gx = self.params.grid_x
+        d = self.n_devices
+        self.boundaries = np.array(
+            [round(i * gx / d) for i in range(d)] + [gx], np.int32
+        )
+        self._rebuild_col_owner()
+        self.perm = np.arange(n, dtype=np.int32)
+        self.row_of = np.arange(n, dtype=np.int32)
+        self.assign = (self.perm // self.chunk).astype(np.int32)
+        zeros = (
+            np.zeros((n, 2), np.float32),
+            np.zeros((n,), bool),
+            np.zeros((n,), np.int32),
+            np.zeros((n,), np.float32),
+        )
+        self._host_prev = zeros
+        self._prev_cx = bins_reference(self.params, zeros[0], zeros[2])[0]
+        self._dispatches = 0
+        self._perm_dirty = False
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        self._state = tuple(put(a) for a in zeros)
+        self._perm_dev = put(self.perm)
+
+    def _rebuild_col_owner(self) -> None:
+        gx = self.params.grid_x
+        owner = np.empty(gx, np.int32)
+        for d in range(self.n_devices):
+            owner[self.boundaries[d]:self.boundaries[d + 1]] = d
+        self._col_owner = owner
+        # Hysteresis band columns, one per side of each strip.
+        self._band_lo = (self.boundaries[:-1] - 1) % gx
+        self._band_hi = self.boundaries[1:] % gx
+
+    def carried_epoch(self) -> tuple:
+        """The last dispatched world in SLOT space (what the tier-growth
+        reseed needs — the device state is row-permuted here)."""
+        return tuple(np.array(a) for a in self._host_prev)
+
+    def _in_strip_or_band(self, cx: np.ndarray, shard: np.ndarray):
+        """Hysteresis keep-test: column inside the shard's strip, or in
+        its one-column slack band on either side."""
+        return (
+            (self._col_owner[cx] == shard)
+            | (cx == self._band_lo[shard])
+            | (cx == self._band_hi[shard])
+        )
+
+    def _replan(self, cx: np.ndarray, active: np.ndarray) -> bool:
+        """Re-split strips from the observed column density; adopt only
+        when the split meaningfully improves the worst strip load."""
+        pop = np.bincount(cx[active], minlength=self.params.grid_x)
+        new = plan_strips(pop, self.n_devices)
+        if np.array_equal(new, self.boundaries):
+            return False
+        cum = np.concatenate([[0], np.cumsum(pop, dtype=np.int64)])
+
+        def worst(bounds):
+            loads = cum[bounds[1:]] - cum[bounds[:-1]]
+            return int(loads.max()) if len(loads) else 0
+
+        if worst(new) > 0.9 * worst(self.boundaries):
+            return False
+        self.boundaries = new
+        self._rebuild_col_owner()
+        self.total_replans += 1
+        self._m_replans.inc()
+        return True
+
+    def _rebuild_perm(self, placed: np.ndarray) -> None:
+        """Row layout from the current assignment: shard d's rows hold its
+        PLACED slots (active in either epoch — a freshly-despawned slot
+        must stay on the strip its previous-epoch pairs live on, or its
+        neighbors' leave events would never find it) in slot order, then
+        free fill (deterministic)."""
+        n = self.params.capacity
+        d = self.n_devices
+        chunk = self.chunk
+        perm = np.empty(n, np.int32)
+        inactive = np.flatnonzero(~placed).astype(np.int32)
+        cursor = 0
+        for s in range(d):
+            mine = np.flatnonzero(placed & (self.assign == s)).astype(
+                np.int32
+            )
+            k = len(mine)
+            assert k <= chunk, "strip overflow must fall back before here"
+            perm[s * chunk:s * chunk + k] = mine
+            fill = chunk - k
+            pad = inactive[cursor:cursor + fill]
+            perm[s * chunk + k:(s + 1) * chunk] = pad
+            # Inactive slots inherit the shard of the row that parks them
+            # (keeps the keep-test well-defined when they activate).
+            self.assign[pad] = s
+            cursor += fill
+        self.perm = perm
+        self.row_of = np.empty(n, np.int32)
+        self.row_of[perm] = np.arange(n, dtype=np.int32)
+
+    # --- dispatch -----------------------------------------------------------
+
+    def step_async(
+        self,
+        pos: np.ndarray,
+        active: np.ndarray,
+        space: np.ndarray,
+        radius: np.ndarray,
+        meta_dirty: bool = True,
+    ):
+        assert self._state is not None, "call reset() first"
+        check_radius(self.params, radius, active)
+        p = self.params
+        gx = p.grid_x
+        # Copies, not views: these become the host prev mirror and must
+        # not alias caller buffers (same contract as the other engines).
+        cur = (
+            np.array(pos, np.float32),
+            np.array(active, bool),
+            np.array(space, np.int32),
+            np.array(radius, np.float32),
+        )
+        cur_pos, cur_act, cur_spc, _ = cur
+        cx = bins_reference(p, cur_pos, cur_spc)[0]
+        self._dispatches += 1
+
+        from goworld_tpu.telemetry import tracing
+
+        halo_span = tracing.child_scope("tick.halo")
+        t0 = time.monotonic()
+
+        perm_rebuilt = False
+        migrations = 0
+        # Slow-cadence density re-plan.
+        if (
+            self.replan_interval
+            and self._dispatches % self.replan_interval == 0
+            and self._replan(cx, cur_act)
+        ):
+            self._perm_dirty = True
+        # Hysteresis migration: move a row only when its cell is a full
+        # column past the seam.
+        act_idx = np.flatnonzero(cur_act)
+        keep = self._in_strip_or_band(cx[act_idx], self.assign[act_idx])
+        movers = act_idx[~keep]
+        if len(movers):
+            self.assign[movers] = self._col_owner[cx[movers]]
+            migrations += len(movers)
+            self._perm_dirty = True
+
+        fallback_reason = None
+        prev_act = self._host_prev[1]
+        # Row placement covers slots live in EITHER epoch: a slot that
+        # just despawned still owns a row on its strip this tick so its
+        # neighbors' leave events resolve there.
+        placed_idx = np.flatnonzero(cur_act | prev_act)
+        counts = np.bincount(
+            self.assign[placed_idx], minlength=self.n_devices
+        ).astype(np.int64)
+        if counts.max(initial=0) > self.chunk:
+            # A strip outgrew its row budget: re-plan NOW; if one column
+            # is hotter than a whole shard's budget even alone, spatial
+            # sharding cannot represent it — exact fallback.
+            if self._replan(cx, cur_act):
+                # Boundary move: reassign by owner column (hysteresis slack
+                # resets), counting only rows that actually changed shard.
+                new_assign = self._col_owner[cx[act_idx]]
+                migrations += int((new_assign != self.assign[act_idx]).sum())
+                self.assign[act_idx] = new_assign
+                self._perm_dirty = True
+                counts = np.bincount(
+                    self.assign[placed_idx], minlength=self.n_devices
+                ).astype(np.int64)
+            if counts.max(initial=0) > self.chunk:
+                fallback_reason = "strip_overflow"
+        self.shard_population = counts
+
+        if fallback_reason is None:
+            # Teleport guard: every row active in the PREVIOUS epoch must
+            # have its previous cell inside its (current) shard's slack
+            # band, or its leave pass would reach past the halo.
+            pa_idx = np.flatnonzero(prev_act)
+            ok = self._in_strip_or_band(
+                self._prev_cx[pa_idx], self.assign[pa_idx]
+            )
+            if not ok.all():
+                fallback_reason = "teleport"
+
+        if self._perm_dirty and fallback_reason != "strip_overflow":
+            # Bands are expressed as LOCAL row indices, so the layout must
+            # be rebuilt before they are selected. (The dirty flag is
+            # persistent state: a strip-overflow fallback tick defers the
+            # rebuild — chunk cannot hold the strip — without losing it.)
+            self._rebuild_perm(cur_act | prev_act)
+            self._perm_dirty = False
+            perm_rebuilt = True
+        send_lo = send_hi = None
+        if fallback_reason is None:
+            send_lo, send_hi, overflow = self._build_bands(
+                cx, cur_act, prev_act
+            )
+            if overflow:
+                fallback_reason = "halo_overflow"
+        if migrations:
+            self.total_migrations += migrations
+            self._m_migrations.inc(migrations)
+        for d in range(self.n_devices):
+            self._m_shard_entities.labels(str(d)).set(int(counts[d]))
+        if halo_span is not None:
+            halo_span.args["migrations"] = migrations
+            halo_span.args["mode"] = fallback_reason or "spatial"
+            tracing.record_span(
+                halo_span.name, t0, time.monotonic() - t0,
+                halo_span.ctx.trace_id, halo_span.ctx.span_id,
+                halo_span.parent_id, halo_span.args,
+            )
+
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        perm = self.perm
+        if perm_rebuilt:
+            # The previous epoch must live in the NEW layout or the device
+            # diff would read a migration as despawn+spawn. Cheap at the
+            # host tier: four slot-space gathers + uploads.
+            hp = self._host_prev
+            self._state = (
+                put(hp[0][perm]), put(hp[1][perm]),
+                put(hp[2][perm]), put(hp[3][perm]),
+            )
+            self._perm_dev = put(perm)
+        if meta_dirty or perm_rebuilt:
+            meta = (
+                put(cur[1][perm]), put(cur[2][perm]), put(cur[3][perm])
+            )
+        else:
+            meta = self._state[1:4]
+        cur_dev = (put(cur[0][perm]),) + meta
+
+        if fallback_reason is None:
+            enter_ids, leave_ids, out = self._jit_step(
+                *self._state, *cur_dev, self._perm_dev,
+                put(send_lo), put(send_hi),
+            )
+            enter_ctx = ("spatial", enter_ids, self._perm_dev)
+            leave_ctx = ("spatial", leave_ids, self._perm_dev)
+            self.last_mode = "spatial"
+            self._m_halo_bytes.inc(self.halo_bytes_per_tick)
+            pending = ShardedPendingStep(self, enter_ctx, leave_ctx, out)
+        else:
+            enter_ids, leave_ids, out = self._jit_fallback(
+                *self._state, *cur_dev
+            )
+            enter_ctx = ("fallback", enter_ids)
+            leave_ctx = ("fallback", leave_ids)
+            self.last_mode = f"fallback:{fallback_reason}"
+            self.total_fallbacks += 1
+            self._m_fallback.labels(fallback_reason).inc()
+            pending = _FallbackPendingStep(
+                self, enter_ctx, leave_ctx, out, perm.copy()
+            )
+
+        self._state = cur_dev
+        self._host_prev = cur
+        self._prev_cx = cx
+        return pending
+
+    def _build_bands(self, cx, cur_act, prev_act):
+        """Per-shard send-index arrays for both seams (flattened
+        [D*halo_cap], sentinel chunk) from current AND previous columns."""
+        gx = self.params.grid_x
+        d = self.n_devices
+        h = self.halo_cap
+        rel = np.flatnonzero(cur_act | prev_act)
+        sh = self.assign[rel]
+        lo = self.boundaries[sh]
+        hi = self.boundaries[sh + 1]
+        c = cx[rel]
+        pc = self._prev_cx[rel]
+
+        def in_lo_band(col, act_mask):
+            return act_mask & (((col - (lo - 1)) % gx) < 3)
+
+        def in_hi_band(col, act_mask):
+            return act_mask & (((col - (hi - 2)) % gx) < 3)
+
+        ca = cur_act[rel]
+        pa = prev_act[rel]
+        low = in_lo_band(c, ca) | in_lo_band(pc, pa)
+        high = in_hi_band(c, ca) | in_hi_band(pc, pa)
+        if d == 2:
+            # Ring of two: both bands land on the same peer — one copy.
+            high &= ~low
+        send_lo = np.full(d * h, self.chunk, np.int32)
+        send_hi = np.full(d * h, self.chunk, np.int32)
+        for s in range(d):
+            for mask, buf in ((low, send_lo), (high, send_hi)):
+                slots = rel[mask & (sh == s)]
+                if len(slots) > h:
+                    return None, None, True
+                rows = np.sort(self.row_of[slots] - s * self.chunk)
+                buf[s * h:s * h + len(rows)] = rows
+        return send_lo, send_hi, False
+
+    def _page(self, ctx: tuple, deficit: np.ndarray, starts: np.ndarray):
+        """Per-shard chunked drain (flat-index paging, jnp semantics) for
+        events beyond the inline budget; ctx[0] picks the program."""
+        mode, ids = ctx[0], ctx[1]
+        chunks: list[np.ndarray] = []
+        starts = starts.copy()
+        deficit = deficit.copy()
+        while deficit.any():
+            st = jax.device_put(
+                np.asarray(starts, np.int32), self._sharding
+            )
+            if mode == "spatial":
+                pairs, aux = self._jit_drain(ids, ctx[2], st)
+            else:
+                pairs, aux = self._jit_fallback_drain(ids, st)
+            pairs = np.asarray(pairs)
+            aux = np.asarray(aux)
+            e = self.events_inline
+            for d in range(self.n_devices):
+                take = int(min(e, deficit[d]))
+                if take <= 0:
+                    continue
+                chunks.append(pairs[d * e:d * e + take])
+                deficit[d] -= take
+                if deficit[d] > 0:
+                    starts[d] = aux[d, take - 1] + 1
+                else:
+                    starts[d] = self._flat_end
+        return chunks
+
+    def step(self, pos, active, space, radius):
+        return self.step_async(pos, active, space, radius).collect()
+
+
+class _FallbackPendingStep(ShardedPendingStep):
+    """A fallback tick's pending step: the all-gather program speaks ROW
+    ids — map the collected pairs back to entity slots through the row
+    permutation snapshotted at dispatch (the live perm may rotate under a
+    pipelined consumer before collect())."""
+
+    __slots__ = ("_perm",)
+
+    def __init__(self, engine, enter_ctx, leave_ctx, out, perm) -> None:
+        super().__init__(engine, enter_ctx, leave_ctx, out)
+        self._perm = perm
+
+    def collect(self):
+        enters, leaves, dropped = super().collect()
+        if len(enters):
+            enters = self._perm[enters]
+        if len(leaves):
+            leaves = self._perm[leaves]
+        return enters, leaves, dropped

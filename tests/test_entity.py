@@ -388,9 +388,10 @@ def test_batched_aoi_destroy_delivers_leaves():
 
 
 @pytest.mark.skipif(
-    not hasattr(__import__("jax"), "shard_map"),
-    reason="jax.shard_map not exported by this jax build "
-           "(parallel.mesh needs it)",
+    not __import__(
+        "goworld_tpu.parallel.compat", fromlist=["shard_map_available"]
+    ).shard_map_available(),
+    reason="no shard_map in this jax build (parallel.mesh needs it)",
 )
 def test_batched_aoi_sharded_engine_wired():
     """[aoi] mesh_shards>1 must actually build the multi-device engine and
@@ -399,10 +400,11 @@ def test_batched_aoi_sharded_engine_wired():
     _setup_batched()
     em.runtime.aoi_mesh_shards = 2
     sp = _setup_space()
-    from goworld_tpu.parallel.mesh import ShardedNeighborEngine
+    from goworld_tpu.parallel.spatial import SpatialShardedNeighborEngine
 
     svc = em.runtime.get_aoi_service()
-    assert isinstance(svc.engine, ShardedNeighborEngine)
+    # [aoi] shard_mode defaults to the spatial (halo-exchange) engine.
+    assert isinstance(svc.engine, SpatialShardedNeighborEngine)
     assert svc.engine.n_devices == 2
     a = em.create_entity_locally("Avatar")
     b = em.create_entity_locally("Avatar")
@@ -416,6 +418,86 @@ def test_batched_aoi_sharded_engine_wired():
     em.runtime.tick()
     assert not a.is_interested_in(b)
     assert a.leave_events == [b]
+
+
+@pytest.mark.skipif(
+    not __import__(
+        "goworld_tpu.parallel.compat", fromlist=["shard_map_available"]
+    ).shard_map_available(),
+    reason="no shard_map in this jax build (parallel.mesh needs it)",
+)
+def test_batched_aoi_entity_shard_mode_wired():
+    """[aoi] shard_mode = entity keeps the all-gather engine reachable
+    (the Pallas-kernel tier on real chips)."""
+    _setup_batched()
+    em.runtime.aoi_mesh_shards = 2
+    em.runtime.aoi_shard_mode = "entity"
+    sp = _setup_space()
+    from goworld_tpu.parallel.mesh import ShardedNeighborEngine
+
+    svc = em.runtime.get_aoi_service()
+    assert isinstance(svc.engine, ShardedNeighborEngine)
+    a = em.create_entity_locally("Avatar")
+    b = em.create_entity_locally("Avatar")
+    sp._enter(a, Vector3(0, 0, 0))
+    sp._enter(b, Vector3(50, 0, 0))
+    em.runtime.tick()
+    em.runtime.tick()
+    assert a.is_interested_in(b) and b.is_interested_in(a)
+
+
+def test_respawn_compilation_cache_no_fresh_compile(tmp_path):
+    """The freeze->respawn warmup satellite (ISSUE 8): with [aoi]
+    compilation_cache pointed at a directory, a process that lost its
+    in-memory executables (== a respawned game) LOADS the step jit from
+    the persistent cache instead of recompiling — observed via jax's own
+    cache-hit events. jax.clear_caches() stands in for the process
+    restart (same in-memory state loss, one process, test stays fast)."""
+    import jax
+    from jax._src import monitoring
+
+    import numpy as np
+
+    from goworld_tpu.game.service import apply_compilation_cache
+    from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
+
+    events = []
+    listener = lambda name, **kw: events.append(name)  # noqa: E731
+    monitoring.register_event_listener(listener)
+    saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        resolved = apply_compilation_cache(str(tmp_path))
+        assert resolved == str(tmp_path)
+        # Cache everything for the test (the production 0.5 s threshold
+        # would skip this deliberately tiny engine's compile).
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        p = NeighborParams(capacity=64, cell_size=100.0, grid_x=8,
+                           grid_z=8, space_slots=1, cell_capacity=16,
+                           max_events=256)
+
+        def warm():
+            eng = NeighborEngine(p, backend="jnp")
+            eng.reset()
+            n = p.capacity
+            eng.step(np.zeros((n, 2), np.float32), np.zeros(n, bool),
+                     np.zeros(n, np.int32), np.zeros(n, np.float32))
+
+        warm()
+        assert any(e.endswith("cache_misses") for e in events)
+        assert any(tmp_path.iterdir()), "cache dir never populated"
+        events.clear()
+        # "Respawn": drop every in-memory executable and jit cache, then
+        # re-warm — the compile must be served from disk.
+        from goworld_tpu.ops import neighbor as nb
+        nb._jitted_step_packed.cache_clear()
+        jax.clear_caches()
+        warm()
+        assert any(e.endswith("cache_hits") for e in events), events
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", saved_min)
+        monitoring._unregister_event_listener_by_callback(listener)
 
 
 def test_aoi_backends_agree_on_random_trace():

@@ -7,13 +7,15 @@ import jax
 import numpy as np
 import pytest
 
-if not hasattr(jax, "shard_map"):
-    # parallel/mesh.py builds its sharded jits via `from jax import
-    # shard_map`; on images whose jax predates that export the engine
-    # cannot construct at all — skip the whole module cleanly instead of
-    # erroring, so the suite's pass/fail stays a usable regression signal.
+from goworld_tpu.parallel.compat import shard_map_available
+
+if not shard_map_available():
+    # parallel/mesh.py resolves shard_map through parallel/compat.py
+    # (stable jax.shard_map OR jax.experimental.shard_map); only a build
+    # with NEITHER cannot construct the engine — skip cleanly then, so
+    # the suite's pass/fail stays a usable regression signal.
     pytest.skip(
-        "jax.shard_map not exported by this jax build "
+        "no shard_map in this jax build "
         f"({jax.__version__}); parallel.mesh needs it",
         allow_module_level=True,
     )

@@ -443,6 +443,50 @@ def test_vmapped_position_tick_numeric_behavior():
         assert e._sync_info_flag & SIF_SYNC_OWN_CLIENT
 
 
+
+def test_restore_prewarm_triggers_no_fresh_trace():
+    """The freeze->respawn warmup satellite (ISSUE 8): prewarm_tick_hooks
+    compiles each adopted class's vmapped jit at its live population, and
+    the first REAL tick afterwards must not trace again — the respawn
+    stall the 5 s strict RPC timeout was measuring."""
+
+    def drift(x, y, z, yaw, dt):
+        return x + dt, y, z, yaw
+
+    class Runner(Entity):
+        on_tick_batch = vmapped_position_tick(drift)
+
+    em.register_entity(Runner)
+    hook = Runner.on_tick_batch.__func__
+    ents = [em.create_entity_locally("Runner") for _ in range(7)]
+    for i, e in enumerate(ents):
+        e.set_position(Vector3(float(i), 0.0, 0.0))
+    assert hook.jit_cache_size() == 0  # nothing compiled yet
+    em.runtime.slabs.prewarm_tick_hooks()
+    assert hook.jit_cache_size() == 1  # the dummy-shaped compile
+    before = [e.position.x for e in ents]
+    em.runtime.slabs.run_tick_batches()
+    # Same population => same shapes => the restore path's first live
+    # tick reuses the prewarmed trace (and the dummy call moved nothing).
+    assert hook.jit_cache_size() == 1
+    assert all(e.position.x > b for e, b in zip(ents, before))
+
+
+def test_prewarm_skips_hand_written_hooks():
+    """Classes with hand-written on_tick_batch bodies have no prewarm
+    surface; prewarm_tick_hooks must skip them without error."""
+    calls = []
+
+    class Manual(Entity):
+        @classmethod
+        def on_tick_batch(cls, view):
+            calls.append(len(view))
+
+    em.register_entity(Manual)
+    em.create_entity_locally("Manual")
+    em.runtime.slabs.prewarm_tick_hooks()  # no prewarm attr: no-op
+    assert calls == []  # prewarm never fires the real hook
+
 def test_tick_view_columns_match_entities():
     seen = {}
 

@@ -1,0 +1,402 @@
+"""Spatially sharded AOI (grid-strip halo exchange) must agree EXACTLY
+with the single-device engine — including entities straddling and crossing
+strip seams, migrations with hysteresis, density re-plans mid-run, event
+storms past the per-shard inline budget, cell-capacity drops at seam
+cells, and the exact all-gather fallback ticks (teleports, halo overflow,
+strip overflow)."""
+
+import jax
+import numpy as np
+import pytest
+
+from goworld_tpu.parallel.compat import shard_map_available
+
+if not shard_map_available():
+    pytest.skip(
+        "no shard_map in this jax build "
+        f"({jax.__version__}); parallel.spatial needs it",
+        allow_module_level=True,
+    )
+
+from goworld_tpu.ops import NeighborEngine, NeighborParams
+from goworld_tpu.parallel import make_mesh
+from goworld_tpu.parallel.spatial import (
+    SpatialShardedNeighborEngine,
+    plan_strips,
+)
+
+# One params object shared by most tests: engines jit per (params, mesh,
+# ...) via lru_cache, so sharing keeps the module's compile count low.
+PARAMS = NeighborParams(
+    capacity=512, cell_size=100.0, grid_x=64, grid_z=16,
+    space_slots=4, cell_capacity=64, max_events=8192,
+)
+N = 512
+WORLD_X = 6400.0  # grid_x * cell_size — every column distinct (no folding)
+
+
+def make_engines(params=PARAMS, **kw):
+    mesh = make_mesh(8)
+    single = NeighborEngine(params, backend="jnp")
+    kw.setdefault("prewarm_fallback", False)  # no daemon churn in tests
+    spatial = SpatialShardedNeighborEngine(params, mesh, **kw)
+    single.reset()
+    spatial.reset()
+    return single, spatial
+
+
+def make_world(n_active, seed, world=WORLD_X, n_spaces=3):
+    rng = np.random.default_rng(seed)
+    pos = rng.uniform(0, world, size=(N, 2)).astype(np.float32)
+    pos[:, 1] %= 1600.0
+    active = np.zeros(N, bool)
+    active[:n_active] = True
+    space = rng.integers(0, n_spaces, size=N).astype(np.int32)
+    radius = np.full(N, 100.0, np.float32)
+    return rng, pos, active, space, radius
+
+
+def to_sets(pairs, n=N):
+    out = [set() for _ in range(n)]
+    for a, b in pairs:
+        out[int(a)].add(int(b))
+    return out
+
+
+def assert_tick_parity(single, spatial, pos, active, space, radius, tag=""):
+    e1, l1, d1 = single.step(pos, active, space, radius)
+    e2, l2, d2 = spatial.step(pos, active, space, radius)
+    n = single.params.capacity
+    assert to_sets(e1, n) == to_sets(e2, n), f"enters differ {tag}"
+    assert to_sets(l1, n) == to_sets(l2, n), f"leaves differ {tag}"
+    assert d1 == d2, f"dropped differ {tag}"
+    return e1, l1
+
+
+def test_randomized_parity_with_migrations_and_replans():
+    """The headline oracle: random walk (seam straddlers AND crossers —
+    64 columns over 8 shards put every 8th column at a seam) with spawn/
+    despawn churn, density re-plans every 3 dispatches, and nonempty
+    enter+leave sets in the same tick. Every tick must run the SPATIAL
+    program (no fallback) and match the single-device stream exactly."""
+    single, spatial = make_engines(replan_interval=3)
+    rng, pos, active, space, radius = make_world(400, seed=7)
+    saw_both = 0
+    for tick in range(8):
+        e1, l1 = assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ tick {tick}"
+        )
+        assert spatial.last_mode == "spatial", spatial.last_mode
+        if tick and len(e1) and len(l1):
+            saw_both += 1
+        pos = np.clip(
+            pos + rng.normal(0, 20, pos.shape), 0, WORLD_X
+        ).astype(np.float32)
+        # Churn: ~12 spawns/despawns per tick keeps meta dirty.
+        active = active.copy()
+        active[rng.integers(0, N, 12)] ^= True
+    assert saw_both >= 4, "walk produced too few enter+leave ticks"
+    assert spatial.total_migrations > 0, "no seam crossings exercised"
+    assert spatial.total_fallbacks == 0
+
+
+def test_seam_straddle_and_cross_exact():
+    """Deterministic seam drill: two entities on opposite sides of a strip
+    seam drift across it (through the hysteresis band) while staying AOI
+    neighbors; a third pair enters and leaves radius in the same tick
+    window. Events must match the single-device engine pair-for-pair."""
+    single, spatial = make_engines()
+    pos = np.zeros((N, 2), np.float32)
+    active = np.zeros(N, bool)
+    space = np.zeros(N, np.int32)
+    radius = np.full(N, 100.0, np.float32)
+    # Strip seam for 64 cols / 8 shards sits at x=800 (column 8). The
+    # space-hash offset shifts columns identically in both engines and
+    # is constant per space, so absolute world x is fine.
+    active[:4] = True
+    pos[0] = (795.0, 50.0)  # shard A side of the 800-seam
+    pos[1] = (805.0, 50.0)  # shard B side — cross-seam AOI pair
+    pos[2] = (2000.0, 50.0)
+    pos[3] = (2250.0, 50.0)  # out of radius of 2
+    for tick in range(6):
+        assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ drill {tick}"
+        )
+        assert spatial.last_mode == "spatial"
+        pos = pos.copy()
+        pos[0, 0] += 60.0  # 0 marches across the seam and far past it
+        pos[1, 0] -= 30.0  # 1 crosses the other way
+        # 2↔3 oscillate in/out of radius: enter+leave in one tick window.
+        pos[3, 0] = 2250.0 - (tick % 2) * 200.0
+    assert spatial.total_migrations > 0
+
+
+def test_event_storm_pages_chunked_drain():
+    """First-tick enter storm past the per-shard inline budget (16/shard
+    here) must page through the chunked drain with exactly-once pairs."""
+    p = NeighborParams(
+        capacity=512, cell_size=100.0, grid_x=32, grid_z=16,
+        space_slots=4, cell_capacity=64, max_events=128,
+    )
+    single, spatial = make_engines(p)
+    rng, pos, active, space, radius = make_world(400, seed=11, world=1200.0)
+    e1, l1, _ = single.step(pos, active, space, radius)
+    e2, l2, _ = spatial.step(pos, active, space, radius)
+    assert len(e1) > p.max_events  # the storm really overflows
+    assert to_sets(e1) == to_sets(e2)
+    assert len(e1) == len(e2)  # exactly-once across chunks
+
+
+def test_seam_cell_drop_consistency():
+    """A grid cell over cell_capacity near a seam exists as COPIES on two
+    shards; the slot-id tie-break must drop the same members everywhere —
+    and the same members as the single-device engine."""
+    p = NeighborParams(
+        capacity=512, cell_size=100.0, grid_x=64, grid_z=16,
+        space_slots=4, cell_capacity=8, max_events=8192,
+    )
+    single, spatial = make_engines(p, replan_interval=2)
+    rng = np.random.default_rng(5)
+    pos = rng.uniform(0, 6400, (N, 2)).astype(np.float32)
+    pos[:, 1] %= 1600.0
+    # 24 entities into one cell (capacity 8) ON a seam column. Only 420
+    # active so the strips keep row slack and the SPATIAL path runs.
+    pos[:24] = (805.0, 405.0)
+    active = np.zeros(N, bool)
+    active[:420] = True
+    space = np.zeros(N, np.int32)
+    radius = np.full(N, 100.0, np.float32)
+    for tick in range(3):
+        e1, l1, d1 = single.step(pos, active, space, radius)
+        e2, l2, d2 = spatial.step(pos, active, space, radius)
+        assert d1 == d2 and d1 > 0
+        assert spatial.last_mode == "spatial", spatial.last_mode
+        assert to_sets(e1) == to_sets(e2), f"drop enters differ @ {tick}"
+        assert to_sets(l1) == to_sets(l2), f"drop leaves differ @ {tick}"
+        pos = np.clip(
+            pos + rng.normal(0, 10, pos.shape), 0, 6400
+        ).astype(np.float32)
+        pos[:, 1] %= 1600.0
+
+
+def test_teleport_falls_back_exactly():
+    """A mass teleport breaks the strip locality invariant (previous cell
+    outside the halo): that tick must run the exact all-gather program —
+    and still match the single-device stream (row→slot mapped)."""
+    single, spatial = make_engines()
+    rng, pos, active, space, radius = make_world(400, seed=3)
+    for tick in range(5):
+        assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ tp {tick}"
+        )
+        if tick in (1, 3):
+            pos = rng.uniform(0, WORLD_X, (N, 2)).astype(np.float32)
+            pos[:, 1] %= 1600.0
+        else:
+            pos = np.clip(
+                pos + rng.normal(0, 5, pos.shape), 0, WORLD_X
+            ).astype(np.float32)
+    assert spatial.total_fallbacks >= 2
+    assert "fallback" in spatial.last_mode or spatial.total_fallbacks
+
+
+def test_hot_column_overflow_falls_back():
+    """Everyone in ONE column: no strip split can hold them in one shard's
+    row budget, so every tick falls back (reason=strip_overflow) — and the
+    event stream stays exact (the hotspot-crowd worst case)."""
+    single, spatial = make_engines()
+    rng = np.random.default_rng(9)
+    pos = np.zeros((N, 2), np.float32)
+    pos[:, 0] = 850.0
+    pos[:, 1] = rng.uniform(0, 1600.0, N).astype(np.float32)
+    active = np.ones(N, bool)
+    space = np.zeros(N, np.int32)
+    radius = np.full(N, 100.0, np.float32)
+    for tick in range(2):
+        assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ hot {tick}"
+        )
+        assert spatial.last_mode == "fallback:strip_overflow"
+        pos = pos.copy()
+        pos[:, 1] = (pos[:, 1] + rng.normal(0, 10, N)) % 1600.0
+    assert spatial.total_fallbacks == 2
+
+
+def test_halo_overflow_falls_back():
+    """A tiny halo budget + a crowd parked ON a seam overflows the band
+    buffer: the tick falls back (reason=halo_overflow), stays exact, and
+    recovers to the spatial path once the crowd disperses."""
+    single, spatial = make_engines(halo_cap=24)
+    rng, pos, active, space, radius = make_world(260, seed=13)
+    # 30 rows parked in one seam band: past halo_cap 24 together with the
+    # background (~12/side), but small enough that the strip's row budget
+    # still holds (no strip_overflow masking it) — and 24 is enough for
+    # the background alone, so the engine RECOVERS after dispersal.
+    # One space for the crowd: the per-space hash offset would otherwise
+    # scatter them over distinct columns and dilute the band.
+    pos[:30, 0] = 801.0
+    space[:30] = 0
+    for tick in range(3):
+        assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ halo {tick}"
+        )
+        if tick == 0:
+            assert spatial.last_mode == "fallback:halo_overflow"
+            # Disperse far from any seam band.
+            pos = rng.uniform(0, WORLD_X, (N, 2)).astype(np.float32)
+            pos[:, 1] %= 1600.0
+            # (The teleport guard will keep the NEXT tick on the fallback
+            # path too; the one after runs spatial again.)
+    assert spatial.last_mode == "spatial", spatial.last_mode
+
+
+def test_density_replan_rebalances_mid_run():
+    """Skewed density (80% of entities in the left quarter of the torus)
+    must produce a non-uniform equal-population split at the replan
+    cadence, keep parity through the boundary move, and reduce the worst
+    shard load vs the uniform split."""
+    single, spatial = make_engines(replan_interval=2)
+    rng = np.random.default_rng(21)
+    pos = np.empty((N, 2), np.float32)
+    k = int(N * 0.7)
+    pos[:k, 0] = rng.uniform(0, WORLD_X / 2, k)
+    pos[k:, 0] = rng.uniform(WORLD_X / 2, WORLD_X, N - k)
+    pos[:, 1] = rng.uniform(0, 1600.0, N)
+    active = np.ones(N, bool)
+    active[320:] = False
+    space = np.zeros(N, np.int32)
+    radius = np.full(N, 100.0, np.float32)
+    uniform_worst = None
+    for tick in range(6):
+        assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ replan {tick}"
+        )
+        if tick == 0:
+            uniform_worst = spatial.shard_population.max()
+        pos = np.clip(
+            pos + rng.normal(0, 8, pos.shape), 0, WORLD_X
+        ).astype(np.float32)
+        pos[:, 1] %= 1600.0
+    assert spatial.total_replans >= 1, "skew never triggered a re-plan"
+    assert spatial.shard_population.max() <= uniform_worst
+    widths = np.diff(spatial.boundaries)
+    assert widths.max() > widths.min(), "split stayed uniform despite skew"
+    assert spatial.total_fallbacks == 0
+
+
+def test_pipelined_matches_sync():
+    """step_async pipelining parity (depth 2) across migration ticks."""
+    mesh = make_mesh(8)
+    eng_sync = SpatialShardedNeighborEngine(
+        PARAMS, mesh, prewarm_fallback=False
+    )
+    eng_pipe = SpatialShardedNeighborEngine(
+        PARAMS, mesh, prewarm_fallback=False
+    )
+    eng_sync.reset()
+    eng_pipe.reset()
+    rng, pos, active, space, radius = make_world(450, seed=13)
+    vel = rng.normal(0, 25.0, pos.shape).astype(np.float32)
+    sync_stream, pipe_stream = [], []
+    pending = None
+    for t in range(6):
+        e1, l1, _ = eng_sync.step(pos, active, space, radius)
+        sync_stream.append((sorted(map(tuple, e1)), sorted(map(tuple, l1))))
+        nxt = eng_pipe.step_async(pos, active, space, radius)
+        if pending is not None:
+            e2, l2, _ = pending.collect()
+            pipe_stream.append(
+                (sorted(map(tuple, e2)), sorted(map(tuple, l2)))
+            )
+        pending = nxt
+        pos = np.clip(pos + vel, 0, WORLD_X).astype(np.float32)
+        pos[:, 1] %= 1600.0
+    e2, l2, _ = pending.collect()
+    pipe_stream.append((sorted(map(tuple, e2)), sorted(map(tuple, l2))))
+    assert sync_stream == pipe_stream
+
+
+def test_plan_strips_properties():
+    """Planner unit: boundaries cover [0, gx], honor the minimum width,
+    and an 8x density skew pulls more columns into the sparse strips."""
+    gx = 64
+    uniform = plan_strips(np.full(gx, 10), 8)
+    assert uniform[0] == 0 and uniform[-1] == gx
+    assert (np.diff(uniform) >= 4).all()
+    skew = np.full(gx, 1)
+    skew[:8] = 100  # hot left edge
+    bounds = plan_strips(skew, 8)
+    assert (np.diff(bounds) >= 4).all()
+    # Hot strips narrow to the floor; the sparse right side widens.
+    assert np.diff(bounds)[0] <= np.diff(uniform)[0]
+    assert np.diff(bounds).max() > np.diff(uniform).max()
+    with pytest.raises(ValueError):
+        plan_strips(np.full(16, 1), 8)  # 16 cols cannot host 8 strips
+
+
+def test_constructor_validation():
+    mesh = make_mesh(8)
+    with pytest.raises(ValueError, match="grid_x"):
+        SpatialShardedNeighborEngine(
+            NeighborParams(capacity=512, grid_x=16, grid_z=16),
+            mesh, prewarm_fallback=False,
+        )
+    with pytest.raises(ValueError, match="capacity"):
+        SpatialShardedNeighborEngine(
+            NeighborParams(capacity=520, grid_x=64, grid_z=16),
+            mesh, prewarm_fallback=False,
+        )
+    with pytest.raises(ValueError):
+        SpatialShardedNeighborEngine(PARAMS, make_mesh(1),
+                                     prewarm_fallback=False)
+
+
+def test_telemetry_counters_move():
+    """aoi_halo_bytes_total / aoi_shard_migrations_total / shard gauges
+    must reflect a run (the satellites' observability contract)."""
+    from goworld_tpu import telemetry
+
+    single, spatial = make_engines()
+    halo0 = telemetry.counter("aoi_halo_bytes_total").value
+    rng, pos, active, space, radius = make_world(400, seed=17)
+    for _ in range(3):
+        spatial.step(pos, active, space, radius)
+        pos = np.clip(
+            pos + rng.normal(0, 20, pos.shape), 0, WORLD_X
+        ).astype(np.float32)
+    assert telemetry.counter("aoi_halo_bytes_total").value >= (
+        halo0 + 3 * spatial.halo_bytes_per_tick
+    )
+    assert telemetry.gauge("aoi_shard_count").value == 8
+    got = sum(
+        int(telemetry.gauge("aoi_shard_entities", labelnames=("shard",))
+            .labels(str(d)).value)
+        for d in range(8)
+    )
+    assert got == int(spatial.shard_population.sum())
+    assert spatial.halo_bytes_per_tick < spatial.allgather_bytes_per_tick
+
+
+def test_halo_span_on_traced_ticks():
+    """A traced dispatch must leave a ``tick.halo`` span in the ring with
+    the migration count and mode attributed (the observability clause of
+    the telemetry satellite); untraced dispatches must add none."""
+    from goworld_tpu.telemetry import tracing
+
+    single, spatial = make_engines()
+    rng, pos, active, space, radius = make_world(300, seed=23)
+    spatial.step(pos, active, space, radius)  # untraced
+    base = sum(1 for sp in tracing.snapshot() if sp["name"] == "tick.halo")
+    saved = tracing.sample_rate()
+    tracing.configure(sample_rate=1)
+    try:
+        scope = tracing.root_scope("test.tick")
+        assert scope is not None
+        with scope:
+            spatial.step(pos, active, space, radius)
+    finally:
+        tracing.configure(sample_rate=saved)
+    spans = [sp for sp in tracing.snapshot() if sp["name"] == "tick.halo"]
+    assert len(spans) == base + 1
+    assert spans[-1]["args"]["mode"] == "spatial"
+    assert "migrations" in spans[-1]["args"]

@@ -421,6 +421,36 @@ def test_pinned_floor_gate():
     )
 
 
+def test_sharded_floor_gate():
+    """The multi-device AOI gate (ISSUE 8): the spatially sharded
+    halo-exchange engine on the forced 8-device CPU mesh must stay within
+    tolerance of the committed floor, keep EXACT event-set parity with
+    the single-device engine on the measured trace, and move strictly
+    fewer halo bytes than the all-gather formulation would. Fresh
+    subprocess for the same reason as the pinned gate (the forced-mesh
+    XLA flag must precede jax init, and suite churn skews in-process
+    numbers)."""
+    floor_spec = json.loads(
+        (_REPO / "BENCH_FLOOR.json").read_text())["sharded"]
+    bench = _load_bench()
+    result = bench._sharded_floor_tier1_env()
+    assert result.get("error") is None, result
+    assert result["config"] == bench.SHARDED_FLOOR_CONFIG
+    assert result["parity_with_single_device"] is True
+    assert result["halo_smaller_than_allgather"] is True
+    assert result["fallback_ticks"] == 0, (
+        "the fixed floor config must run the SPATIAL program every tick; "
+        f"{result['fallback_ticks']} ticks fell back to all-gather"
+    )
+    floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
+    assert result["value"] >= floor, (
+        f"sharded-floor regression: {result['value']:.0f} upd/s < "
+        f"{floor:.0f} (floor {floor_spec['floor']} - "
+        f"{floor_spec['tolerance']:.0%} tolerance). Runs: {result['runs']}. "
+        f"See BENCH_FLOOR.json how_to_read."
+    )
+
+
 def test_fanout_floor_gate():
     """The end-to-end sync fan-out gate (ISSUE 2): a real in-process
     dispatcher+game+gate cluster with N bot sockets must keep delivering
