@@ -1,0 +1,1020 @@
+"""The six engine-specific gwlint rules.
+
+Each checker takes the parsed package (list[ParsedModule]) plus the repo
+root and returns Violations.  All checks are heuristic AST passes tuned to
+THIS codebase's idioms; anything they over-report is suppressed in the
+committed baseline with a written justification, so precision bugs cost a
+review line, never a silent pass.  The rules:
+
+- **R1 jit-hygiene** — whole-program: functions reachable from
+  ``jax.jit`` / ``vmap`` / ``shard_map`` / ``lax.scan``-style callsites
+  (cross-module call graph, ``self.*`` methods resolved) must not call
+  host-sync primitives (``.item()``, ``float()`` on non-constants,
+  ``np.asarray/np.array``, ``jax.device_get``, ``block_until_ready``) or
+  mutate module-level state under trace.
+- **R2 hot-path shape** — functions on the tick/collect/route/demux hot
+  paths (``@hot_path``-decorated or listed in ``HOT_PATHS``) must not
+  contain per-item Python loops over non-constant iterables or
+  per-record ``struct.pack`` inside a loop.
+- **R3 parse-bounds** — in ``netutil/`` and ``proto/``, unpack/index
+  reads of received buffers must be dominated by a ``len()`` guard or an
+  enclosing try/except that catches the short-read error.
+- **R4 lock discipline** — lock acquisition goes through ``with``; no
+  ``time.sleep`` / socket send/recv / blocking queue op lexically inside
+  a held-lock region.
+- **R5 telemetry hygiene** — metric families register at module scope,
+  counters never ``.dec()``, trace spans are context-managed (or
+  explicitly recorded) rather than half-entered.
+- **R6 config-key drift** — every ini key read in
+  ``config/read_config.py`` exists in ``goworld.ini.sample`` and vice
+  versa (numbered sections fold into their family; ``start_nodes_N``
+  matches the prefix reader).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterator, Optional
+
+from goworld_tpu.analysis.core import ParsedModule, Violation
+
+# --- shared AST helpers ------------------------------------------------------
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """"a.b.c" for a Name/Attribute chain; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def module_name(path: str) -> str:
+    mod = path[:-3].replace("/", ".")
+    if mod.endswith(".__init__"):
+        mod = mod[: -len(".__init__")]
+    return mod
+
+
+def import_map(mod: ParsedModule) -> dict[str, str]:
+    """Local alias -> fully qualified target (relative imports resolved)."""
+    modname = module_name(mod.path)
+    package = modname if mod.path.endswith("__init__.py") else (
+        modname.rsplit(".", 1)[0] if "." in modname else "")
+    out: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+                else:
+                    out[a.name.split(".")[0]] = a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                parts = package.split(".") if package else []
+                if node.level > 1:
+                    parts = parts[: len(parts) - (node.level - 1)]
+                base = ".".join(parts + ([node.module] if node.module else []))
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                out[a.asname or a.name] = f"{base}.{a.name}" if base else a.name
+    return out
+
+
+def walk_scoped(tree: ast.AST) -> Iterator[tuple[str, ast.AST]]:
+    """Yield (enclosing dotted scope, node) for every node."""
+
+    def visit(node: ast.AST, scope: str) -> Iterator[tuple[str, ast.AST]]:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                sub = f"{scope}.{child.name}" if scope else child.name
+                yield scope, child
+                yield from visit(child, sub)
+            else:
+                yield scope, child
+                yield from visit(child, scope)
+
+    yield from visit(tree, "")
+
+
+def body_nodes(fn: ast.AST, into_nested: bool = True) -> Iterator[ast.AST]:
+    """Every node lexically inside ``fn``'s body (optionally skipping
+    nested function/lambda bodies — deferred execution)."""
+
+    def visit(node: ast.AST) -> Iterator[ast.AST]:
+        for child in ast.iter_child_nodes(node):
+            yield child
+            if not into_nested and isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+                continue
+            yield from visit(child)
+
+    for stmt in getattr(fn, "body", []):
+        yield stmt
+        yield from visit(stmt)
+
+
+# --- R1: jit hygiene ---------------------------------------------------------
+
+# wrapper name -> positions of the traced-function argument(s)
+_JIT_WRAPPERS = {
+    "jit": (0,), "pjit": (0,), "pmap": (0,), "vmap": (0,),
+    "shard_map": (0,), "vmapped_position_tick": (0,),
+    "grad": (0,), "value_and_grad": (0,), "remat": (0,), "checkpoint": (0,),
+    "scan": (0,), "while_loop": (0, 1), "fori_loop": (2,), "cond": (1, 2),
+}
+# functions whose function-args run on HOST, not under trace
+_HOST_CALLBACK_FUNCS = {"pure_callback", "io_callback", "host_callback",
+                        "debug_callback"}
+_NUMPY_HOST_FUNCS = {"asarray", "array"}
+
+
+class _ProgramIndex:
+    def __init__(self, modules: list[ParsedModule]) -> None:
+        self.modules = {module_name(m.path): m for m in modules}
+        # modname -> {qualname: def node}
+        self.defs: dict[str, dict[str, ast.AST]] = {}
+        self.classes: dict[str, set[str]] = {}
+        self.imports: dict[str, dict[str, str]] = {}
+        self.np_aliases: dict[str, set[str]] = {}
+        for name, m in self.modules.items():
+            defs: dict[str, ast.AST] = {}
+            classes: set[str] = set()
+            for scope, node in walk_scoped(m.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    defs[qual] = node
+                elif isinstance(node, ast.ClassDef):
+                    qual = f"{scope}.{node.name}" if scope else node.name
+                    classes.add(qual)
+            self.defs[name] = defs
+            self.classes[name] = classes
+            imp = import_map(m)
+            self.imports[name] = imp
+            self.np_aliases[name] = {
+                a for a, tgt in imp.items()
+                if tgt == "numpy" or tgt.startswith("numpy.")}
+
+    def resolve(self, modname: str, scope: str,
+                ref: str) -> Optional[tuple[str, str]]:
+        """Resolve a dotted reference at ``scope`` in ``modname`` to a
+        package function: (modname, qualname), or None."""
+        defs = self.defs.get(modname, {})
+        parts = ref.split(".")
+        if parts[0] in ("self", "cls") and len(parts) == 2:
+            # method of the nearest enclosing class in the scope chain
+            sp = scope.split(".")
+            for i in range(len(sp), 0, -1):
+                cand = ".".join(sp[:i])
+                if cand in self.classes.get(modname, ()):
+                    qual = f"{cand}.{parts[1]}"
+                    if qual in defs:
+                        return (modname, qual)
+            return None
+        if len(parts) == 1:
+            # lexical scope chain, innermost first
+            sp = scope.split(".") if scope else []
+            for i in range(len(sp), -1, -1):
+                cand = ".".join(sp[:i] + [ref]) if i else ref
+                if cand in defs:
+                    return (modname, cand)
+            tgt = self.imports.get(modname, {}).get(ref)
+            if tgt and tgt.startswith("goworld_tpu"):
+                if "." in tgt:
+                    tmod, tname = tgt.rsplit(".", 1)
+                    if tname in self.defs.get(tmod, {}):
+                        return (tmod, tname)
+            return None
+        # alias.func: alias must name a package module
+        tgt = self.imports.get(modname, {}).get(parts[0])
+        if tgt and tgt.startswith("goworld_tpu") and len(parts) == 2:
+            if parts[1] in self.defs.get(tgt, {}):
+                return (tgt, parts[1])
+        return None
+
+
+def _unwrap_partial(arg: ast.AST) -> ast.AST:
+    if isinstance(arg, ast.Call):
+        inner = dotted(arg.func)
+        if inner and inner.split(".")[-1] == "partial" and arg.args:
+            return arg.args[0]
+    return arg
+
+
+def _resolve_traced_arg(index: _ProgramIndex, modname: str, scope: str,
+                        arg: ast.AST) -> Optional[tuple[str, str]]:
+    """Resolve the function argument of a jit-wrapper call, chasing one
+    level of `body = functools.partial(f, ...)` local binding."""
+    arg = _unwrap_partial(arg)
+    ref = dotted(arg)
+    if not ref:
+        return None
+    hit = index.resolve(modname, scope, ref)
+    if hit:
+        return hit
+    # local variable: find its binding assignment in the enclosing def
+    encl = index.defs.get(modname, {}).get(scope)
+    if encl is not None and "." not in ref:
+        for node in body_nodes(encl):
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == ref
+                    for t in node.targets):
+                src = _unwrap_partial(node.value)
+                ref2 = dotted(src)
+                if ref2 and ref2 != ref:
+                    hit = index.resolve(modname, scope, ref2)
+                    if hit:
+                        return hit
+    return None
+
+
+def _jit_roots(index: _ProgramIndex) -> set[tuple[str, str]]:
+    roots: set[tuple[str, str]] = set()
+    for modname, mod in index.modules.items():
+        for scope, node in walk_scoped(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{scope}.{node.name}" if scope else node.name
+                for dec in node.decorator_list:
+                    target = dec.func if isinstance(dec, ast.Call) else dec
+                    d = dotted(target)
+                    if d and d.split(".")[-1] in _JIT_WRAPPERS:
+                        roots.add((modname, qual))
+                    elif (isinstance(dec, ast.Call)
+                          and d and d.split(".")[-1] == "partial"
+                          and dec.args):
+                        inner = dotted(dec.args[0])
+                        if inner and inner.split(".")[-1] in _JIT_WRAPPERS:
+                            roots.add((modname, qual))
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if not d:
+                continue
+            name = d.split(".")[-1]
+            if name not in _JIT_WRAPPERS:
+                continue
+            for pos in _JIT_WRAPPERS[name]:
+                if pos >= len(node.args):
+                    continue
+                hit = _resolve_traced_arg(
+                    index, modname, scope, node.args[pos])
+                if hit:
+                    roots.add(hit)
+    return roots
+
+
+def _reachable(index: _ProgramIndex,
+               roots: set[tuple[str, str]]) -> set[tuple[str, str]]:
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        modname, qual = frontier.pop()
+        fn = index.defs[modname].get(qual)
+        if fn is None:
+            continue
+        mod = index.modules[modname]
+        scope = qual
+        # host-callback args are excluded from reference resolution
+        excluded: set[int] = set()
+        for node in body_nodes(fn):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func)
+                if d and d.split(".")[-1] in _HOST_CALLBACK_FUNCS:
+                    for a in node.args:
+                        for sub in ast.walk(a):
+                            excluded.add(id(sub))
+        for node in body_nodes(fn):
+            if id(node) in excluded:
+                continue
+            ref = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                ref = node.id
+            elif isinstance(node, ast.Attribute) and isinstance(
+                    node.ctx, ast.Load):
+                ref = dotted(node)
+            if not ref:
+                continue
+            hit = index.resolve(modname, scope, ref)
+            if hit and hit not in seen:
+                seen.add(hit)
+                frontier.append(hit)
+        del mod
+    return seen
+
+
+def _module_mutables(mod: ParsedModule) -> set[str]:
+    """Module-level names bound to obviously-mutable containers."""
+    out: set[str] = set()
+    for stmt in mod.tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+            value = stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name) and stmt.value is not None:
+            targets = [stmt.target]
+            value = stmt.value
+        else:
+            continue
+        mutable = isinstance(value, (ast.List, ast.Dict, ast.Set,
+                                     ast.ListComp, ast.DictComp, ast.SetComp))
+        if isinstance(value, ast.Call):
+            d = dotted(value.func)
+            if d and d.split(".")[-1] in ("list", "dict", "set",
+                                          "defaultdict", "deque",
+                                          "OrderedDict"):
+                mutable = True
+        if mutable:
+            out.update(t.id for t in targets)
+    return out
+
+
+_MUTATOR_ATTRS = {"append", "extend", "update", "setdefault", "add",
+                  "pop", "popitem", "insert", "remove", "clear"}
+
+
+def check_r1(modules: list[ParsedModule], root: str) -> list[Violation]:
+    index = _ProgramIndex(modules)
+    reach = _reachable(index, _jit_roots(index))
+    out: list[Violation] = []
+    by_mod: dict[str, list[str]] = {}
+    for modname, qual in reach:
+        by_mod.setdefault(modname, []).append(qual)
+    for modname, quals in by_mod.items():
+        mod = index.modules[modname]
+        np_alias = index.np_aliases[modname]
+        mutables = _module_mutables(mod)
+        for qual in quals:
+            fn = index.defs[modname][qual]
+            for node in body_nodes(fn):
+                if isinstance(node, ast.Global):
+                    out.append(mod.violation(
+                        "R1", node,
+                        "jit-reachable function rebinds module state "
+                        "via `global` — side effects under trace run "
+                        "once, at trace time"))
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(
+                        node, ast.Assign) else [node.target]
+                    for t in targets:
+                        if isinstance(t, ast.Subscript):
+                            base = dotted(t.value)
+                            if base in mutables:
+                                out.append(mod.violation(
+                                    "R1", node,
+                                    f"mutates module-level container "
+                                    f"{base!r} under trace — runs at "
+                                    f"trace time, not per step"))
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item" and not node.args):
+                    out.append(mod.violation(
+                        "R1", node,
+                        ".item() host-syncs the device stream inside a "
+                        "jit-reachable function"))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "block_until_ready"):
+                    out.append(mod.violation(
+                        "R1", node,
+                        "block_until_ready() host-syncs inside a "
+                        "jit-reachable function"))
+                elif d and d.split(".")[-1] == "device_get":
+                    out.append(mod.violation(
+                        "R1", node,
+                        "jax.device_get host-syncs inside a jit-reachable "
+                        "function"))
+                elif (d and "." in d and d.split(".")[0] in np_alias
+                      and d.split(".")[-1] in _NUMPY_HOST_FUNCS):
+                    out.append(mod.violation(
+                        "R1", node,
+                        f"{d}() materializes on host inside a "
+                        f"jit-reachable function (traced values would "
+                        f"host-sync; use jnp, or hoist to the host side)"))
+                elif (d == "float" and len(node.args) == 1
+                      and not isinstance(node.args[0], ast.Constant)):
+                    out.append(mod.violation(
+                        "R1", node,
+                        "float(x) on a non-constant host-syncs if x is "
+                        "traced"))
+                elif (isinstance(node.func, ast.Attribute)
+                      and node.func.attr in _MUTATOR_ATTRS):
+                    base = dotted(node.func.value)
+                    if base in mutables:
+                        out.append(mod.violation(
+                            "R1", node,
+                            f"mutates module-level container {base!r} "
+                            f"under trace — runs at trace time, not per "
+                            f"step"))
+    return out
+
+
+# --- R2: hot-path shape ------------------------------------------------------
+
+# path -> function names (bare, matched against the tail of the dotted
+# symbol).  These are the per-tick collect/route/demux/fan-out paths the
+# fanout and pinned floors measure.
+HOT_PATHS: dict[str, set[str]] = {
+    "goworld_tpu/entity/slabs.py": {
+        "collect_sync_selection", "pack_sync", "collect_sync",
+        "run_tick_batches", "set_position_yaw",
+    },
+    "goworld_tpu/dispatcher/service.py": {
+        "_handle_sync_position_yaw_from_client", "_send_pending_syncs",
+        "_flush_pending_sync", "_route_to_gate",
+    },
+    "goworld_tpu/gate/service.py": {
+        "_handle_sync_on_clients", "_flush_pending_syncs",
+    },
+    "goworld_tpu/ops/neighbor.py": {
+        "neighbor_step", "build_tables", "diff_events",
+    },
+}
+
+
+def _is_const_bounded(it: ast.AST) -> bool:
+    if isinstance(it, (ast.Tuple, ast.List, ast.Set, ast.Dict, ast.Constant)):
+        return True
+    if isinstance(it, ast.Call):
+        d = dotted(it.func)
+        if d in ("range", "enumerate", "reversed", "zip") and all(
+                _is_const_bounded(a) or isinstance(a, ast.Constant)
+                for a in it.args):
+            return True
+    return False
+
+
+def _hot_functions(mod: ParsedModule) -> list[tuple[str, ast.AST]]:
+    listed = HOT_PATHS.get(mod.path, set())
+    out = []
+    for scope, node in walk_scoped(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{scope}.{node.name}" if scope else node.name
+        decorated = any(
+            (dotted(dec) or "").split(".")[-1] == "hot_path"
+            for dec in node.decorator_list)
+        if decorated or node.name in listed:
+            out.append((qual, node))
+    return out
+
+
+def check_r2(modules: list[ParsedModule], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for mod in modules:
+        for qual, fn in _hot_functions(mod):
+            loop_spans: list[tuple[int, int]] = []
+            for node in body_nodes(fn):
+                if isinstance(node, (ast.For, ast.AsyncFor)):
+                    loop_spans.append(
+                        (node.lineno, node.end_lineno or node.lineno))
+                    if not _is_const_bounded(node.iter):
+                        src = ast.unparse(node.iter)
+                        out.append(mod.violation(
+                            "R2", node,
+                            f"per-item Python loop over {src!r} on a "
+                            f"hot path — vectorize or prove the iterable "
+                            f"O(gates), not O(entities)"))
+                elif isinstance(node, ast.While):
+                    loop_spans.append(
+                        (node.lineno, node.end_lineno or node.lineno))
+                    out.append(mod.violation(
+                        "R2", node,
+                        "while-loop on a hot path — prove bounded or "
+                        "vectorize"))
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.DictComp, ast.GeneratorExp)):
+                    for gen in node.generators:
+                        if not _is_const_bounded(gen.iter):
+                            src = ast.unparse(gen.iter)
+                            out.append(mod.violation(
+                                "R2", node,
+                                f"per-item comprehension over {src!r} on "
+                                f"a hot path"))
+            for node in body_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if not d or d.split(".")[-1] not in ("pack", "pack_into"):
+                    continue
+                parts = d.split(".")
+                packish = (parts[0] == "struct"
+                           or "struct" in parts[-2].lower()
+                           if len(parts) > 1 else False)
+                if not packish:
+                    continue
+                in_loop = any(lo < node.lineno <= hi for lo, hi in loop_spans)
+                if in_loop:
+                    out.append(mod.violation(
+                        "R2", node,
+                        f"per-record {d} inside a loop on a hot path — "
+                        f"build columns and pack once"))
+    return out
+
+
+# --- R3: parse bounds --------------------------------------------------------
+
+_BUF_PARAM_NAMES = {
+    "data", "buf", "buff", "buffer", "payload", "raw", "b", "msg", "frame",
+    "chunk", "body", "blob", "segment", "seg", "datagram", "wire", "packed",
+}
+_RECV_FUNCS = {"recv", "recvfrom", "recv_exact", "read", "read_exact",
+               "readexactly"}
+_SHORT_READ_ERRORS = {"error", "struct", "IndexError", "ValueError",
+                      "Exception", "BaseException", "KeyError"}
+
+
+def _buffer_names(fn: ast.AST) -> set[str]:
+    bufs = {a.arg for a in _all_args(fn) if a.arg in _BUF_PARAM_NAMES}
+    # propagate through simple assignments (memoryview(data), data[4:], recv)
+    changed = True
+    while changed:
+        changed = False
+        for node in body_nodes(fn):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name) or tgt.id in bufs:
+                continue
+            src_names = names_in(node.value)
+            from_recv = any(
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr in _RECV_FUNCS
+                for n in ast.walk(node.value))
+            if (src_names & bufs) or from_recv:
+                bufs.add(tgt.id)
+                changed = True
+    return bufs
+
+
+def _all_args(fn: ast.AST) -> list[ast.arg]:
+    a = fn.args
+    return [*a.posonlyargs, *a.args, *a.kwonlyargs]
+
+
+_GUARD_FN_RE = re.compile(r"(need|check|require|ensure|guard|bounds)",
+                          re.IGNORECASE)
+
+
+def _guard_lines(fn: ast.AST, bufs: set[str]) -> list[int]:
+    """Lines where a len() of a buffer name occurs, or where the buffer
+    is passed to a bounds-guard helper (``_need(data, off, 8)`` — the
+    conventional names are matched by _GUARD_FN_RE)."""
+    out = []
+    for node in body_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        if (isinstance(node.func, ast.Name) and node.func.id == "len"
+                and node.args and (names_in(node.args[0]) & bufs)):
+            out.append(node.lineno)
+            continue
+        d = dotted(node.func)
+        if (d and _GUARD_FN_RE.search(d.split(".")[-1])
+                and any(names_in(a) & bufs for a in node.args)):
+            out.append(node.lineno)
+    return out
+
+
+def _try_spans(fn: ast.AST) -> list[tuple[int, int]]:
+    spans = []
+    for node in body_nodes(fn):
+        if not isinstance(node, ast.Try):
+            continue
+        catches = False
+        for h in node.handlers:
+            if h.type is None:
+                catches = True
+            else:
+                for t in ([h.type.elts] if isinstance(h.type, ast.Tuple)
+                          else [[h.type]]):
+                    for e in t:
+                        d = dotted(e) or ""
+                        if d.split(".")[0] in _SHORT_READ_ERRORS or \
+                                d.split(".")[-1] in _SHORT_READ_ERRORS:
+                            catches = True
+        if catches and node.body:
+            lo = node.body[0].lineno
+            hi = max(s.end_lineno or s.lineno for s in node.body)
+            spans.append((lo, hi))
+    return spans
+
+
+def check_r3(modules: list[ParsedModule], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for mod in modules:
+        if not (mod.path.startswith("goworld_tpu/netutil/")
+                or mod.path.startswith("goworld_tpu/proto/")):
+            continue
+        for scope, node in walk_scoped(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            bufs = _buffer_names(node)
+            if not bufs:
+                continue
+            guards = _guard_lines(node, bufs)
+            tries = _try_spans(node)
+
+            def covered(line: int) -> bool:
+                # <= : `if len(parts) == 3 and parts[0] ...` guards
+                # same-line reads via short-circuit evaluation
+                return (any(g <= line for g in guards)
+                        or any(lo <= line <= hi for lo, hi in tries))
+
+            for sub in body_nodes(node):
+                if isinstance(sub, ast.Call):
+                    d = dotted(sub.func)
+                    risky = None
+                    if d and d.split(".")[-1] in ("unpack", "unpack_from"):
+                        if any(names_in(a) & bufs for a in sub.args):
+                            risky = f"{d}()"
+                    elif d == "int.from_bytes" and sub.args and (
+                            names_in(sub.args[0]) & bufs):
+                        risky = "int.from_bytes()"
+                    if risky and not covered(sub.lineno):
+                        out.append(mod.violation(
+                            "R3", sub,
+                            f"{risky} reads a received buffer "
+                            f"({sorted(names_in(sub) & bufs)}) with no "
+                            f"dominating len() guard or short-read "
+                            f"try/except — a truncated frame crashes the "
+                            f"connection loop"))
+                elif (isinstance(sub, ast.Subscript)
+                      and isinstance(sub.ctx, ast.Load)
+                      and isinstance(sub.value, ast.Name)
+                      and sub.value.id in bufs
+                      and not isinstance(sub.slice, ast.Slice)):
+                    if not covered(sub.lineno):
+                        out.append(mod.violation(
+                            "R3", sub,
+                            f"single-index read of received buffer "
+                            f"{sub.value.id!r} with no dominating len() "
+                            f"guard — IndexError on a truncated frame"))
+    return out
+
+
+# --- R4: lock discipline -----------------------------------------------------
+
+_BLOCKING_SOCKET_ATTRS = {"recv", "recvfrom", "sendall", "sendto",
+                          "accept", "connect", "makefile"}
+_LOCK_CTORS = {"Lock", "RLock"}
+
+
+def _locky(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    tail = name.split(".")[-1].lower()
+    return "lock" in tail or "mutex" in tail or tail in ("lk", "_lk", "mu")
+
+
+def _known_locks(mod: ParsedModule) -> set[str]:
+    """Attribute/name tails assigned a threading.Lock()/RLock()."""
+    out: set[str] = set()
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not isinstance(node.value, ast.Call):
+            continue
+        d = dotted(node.value.func) or ""
+        if d.split(".")[-1] not in _LOCK_CTORS:
+            continue
+        for t in node.targets:
+            tail = (dotted(t) or "").split(".")[-1]
+            if tail:
+                out.add(tail)
+    return out
+
+
+def check_r4(modules: list[ParsedModule], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for mod in modules:
+        known = _known_locks(mod)
+
+        def lockish(expr: ast.AST) -> bool:
+            d = dotted(expr)
+            return bool(d) and (_locky(d) or d.split(".")[-1] in known)
+
+        for scope, node in walk_scoped(mod.tree):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute):
+                if node.func.attr in ("acquire", "release") and lockish(
+                        node.func.value):
+                    out.append(mod.violation(
+                        "R4", node,
+                        f"bare .{node.func.attr}() on "
+                        f"{dotted(node.func.value)!r} — use `with` so the "
+                        f"release survives exceptions (and lockgraph can "
+                        f"see the critical section)"))
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            lock_items = [i for i in node.items
+                          if lockish(i.context_expr)]
+            if not lock_items:
+                continue
+            held = {dotted(i.context_expr) for i in lock_items}
+            for sub in body_nodes(node, into_nested=False):
+                if not isinstance(sub, ast.Call):
+                    continue
+                d = dotted(sub.func)
+                if not d:
+                    continue
+                parts = d.split(".")
+                attr = parts[-1]
+                recv = ".".join(parts[:-1])
+                msg = None
+                if d == "time.sleep":
+                    msg = "time.sleep under a held lock"
+                elif attr in _BLOCKING_SOCKET_ATTRS and len(parts) > 1:
+                    msg = f"blocking socket call .{attr}() under a held lock"
+                elif attr in ("get", "put") and "queue" in recv.lower():
+                    blockless = any(
+                        kw.arg == "block"
+                        and isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False
+                        for kw in sub.keywords) or (
+                        sub.args and isinstance(sub.args[0], ast.Constant)
+                        and sub.args[0].value is False)
+                    if not blockless:
+                        msg = (f"blocking queue .{attr}() under a held "
+                               f"lock")
+                elif attr in ("wait", "wait_connected") and \
+                        recv not in held and _locky(recv) is False:
+                    if attr == "wait_connected" or (
+                            recv and ("event" in recv.lower()
+                                      or "cond" in recv.lower()
+                                      or "future" in recv.lower())):
+                        msg = f".{attr}() under a held lock"
+                elif attr == "join" and recv and (
+                        "thread" in recv.lower() or "worker" in recv.lower()
+                        or "proc" in recv.lower()):
+                    msg = "thread join under a held lock"
+                if msg:
+                    out.append(mod.violation(
+                        "R4", sub,
+                        f"{msg} ({sorted(held)}) — every other thread "
+                        f"touching this lock stalls for the full wait"))
+    return out
+
+
+# --- R5: telemetry hygiene ---------------------------------------------------
+
+
+def check_r5(modules: list[ParsedModule], root: str) -> list[Violation]:
+    out: list[Violation] = []
+    for mod in modules:
+        counters: set[str] = set()
+        for stmt in mod.tree.body:
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.value, ast.Call):
+                d = dotted(stmt.value.func) or ""
+                if d.endswith("REGISTRY.counter"):
+                    counters.update(
+                        t.id for t in stmt.targets
+                        if isinstance(t, ast.Name))
+        for scope, node in walk_scoped(mod.tree):
+            if isinstance(node, ast.Call):
+                d = dotted(node.func) or ""
+                parts = d.split(".")
+                # registration must happen at module scope
+                if (len(parts) >= 2 and parts[-2] == "REGISTRY"
+                        and parts[-1] in ("counter", "gauge", "histogram")
+                        and scope):
+                    out.append(mod.violation(
+                        "R5", node,
+                        f"metric family {parts[-1]} registered inside "
+                        f"{scope!r} — register once at module scope so "
+                        f"re-construction can't fork the family"))
+                # counters never go down
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "dec"):
+                    chain = d or ""
+                    head = chain.split(".")[0]
+                    if head in counters or ".labels." in f".{chain}.":
+                        if head in counters:
+                            out.append(mod.violation(
+                                "R5", node,
+                                f"counter {head!r} .dec()'d — counters "
+                                f"are monotonic; use a gauge"))
+        # span scopes must be context-managed or explicitly recorded
+        for scope, fn in walk_scoped(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            has_record = any(
+                isinstance(n, ast.Call)
+                and (dotted(n.func) or "").endswith("record_span")
+                for n in body_nodes(fn))
+            with_subjects: set[str] = set()
+            for n in body_nodes(fn):
+                if isinstance(n, (ast.With, ast.AsyncWith)):
+                    for item in n.items:
+                        d = dotted(item.context_expr)
+                        if d:
+                            with_subjects.add(d)
+            enters = exits = 0
+            for n in body_nodes(fn):
+                if isinstance(n, ast.Call) and isinstance(
+                        n.func, ast.Attribute):
+                    if n.func.attr == "__enter__":
+                        enters += 1
+                    elif n.func.attr == "__exit__":
+                        exits += 1
+                if not isinstance(n, ast.Assign):
+                    continue
+                if not isinstance(n.value, ast.Call):
+                    continue
+                d = dotted(n.value.func) or ""
+                if d.split(".")[-1] not in ("root_scope", "child_scope",
+                                            "SpanScope"):
+                    continue
+                tgt = n.targets[0]
+                tname = dotted(tgt)
+                returned = tname and any(
+                    isinstance(r, ast.Return) and r.value is not None
+                    and tname in names_in(r.value)
+                    for r in body_nodes(fn))
+                if tname and (tname in with_subjects or has_record
+                              or returned):
+                    continue
+                # scope value used directly in `with` on a later line?
+                out.append(mod.violation(
+                    "R5", n,
+                    f"trace scope assigned to {tname!r} but never "
+                    f"entered via `with` nor explicitly record_span'd — "
+                    f"a half-opened span never reaches the ring"))
+            if enters != exits:
+                out.append(mod.violation(
+                    "R5", fn,
+                    f"unbalanced manual span __enter__/__exit__ "
+                    f"({enters} vs {exits}) in one function"))
+    return out
+
+
+# --- R6: config-key drift ----------------------------------------------------
+
+_SECTION_RE = re.compile(r"^\[([A-Za-z_][A-Za-z0-9_]*)\]")
+_INI_KEY_RE = re.compile(r"^;?\s*([a-z_][a-z0-9_]*)\s*=")
+_GETTERS = {"get", "getint", "getfloat", "getboolean"}
+
+
+def _family(section: str) -> str:
+    base = re.sub(r"\d+$", "", section)
+    if base.endswith("_common"):
+        base = base[: -len("_common")]
+    return base
+
+
+def _norm_key(key: str) -> str:
+    return re.sub(r"^start_nodes_.+$", "start_nodes_N", key)
+
+
+def _sample_keys(root: str) -> tuple[dict[str, set[str]],
+                                     dict[tuple[str, str], int]]:
+    fams: dict[str, set[str]] = {}
+    lines: dict[tuple[str, str], int] = {}
+    section = ""
+    path = os.path.join(root, "goworld.ini.sample")
+    with open(path, encoding="utf-8") as f:
+        for ln, raw in enumerate(f, 1):
+            line = raw.rstrip("\n")
+            m = _SECTION_RE.match(line.strip())
+            if m:
+                section = m.group(1)
+                continue
+            if line.startswith(";"):
+                # a commented-out KEY is documented at column 0
+                # ("; delivery = pipelined"); indented ';' lines are
+                # wrapped prose of an inline comment, never keys
+                inner = line[1:].lstrip()
+                if inner.startswith(";") or inner.startswith("-"):
+                    continue  # double-comment / separator line
+                line = inner
+            elif line.lstrip().startswith((";", "#")):
+                continue
+            else:
+                line = line.lstrip()
+            m2 = _INI_KEY_RE.match(line)
+            if m2 and section:
+                key = _norm_key(m2.group(1))
+                fam = _family(section)
+                fams.setdefault(fam, set()).add(key)
+                lines.setdefault((fam, key), ln)
+    return fams, lines
+
+
+def _code_keys(mod: ParsedModule) -> dict[str, dict[str, int]]:
+    """family -> {key: first line} read in read_config.py, attributed to
+    the most recent section-selecting event (linear file structure)."""
+    events: list[tuple[int, str]] = []  # (line, family)
+    reads: list[tuple[int, str, Optional[str]]] = []  # (line, key, inline fam)
+    has_start_nodes_reader = "start_nodes_" in mod.source
+
+    def const_str(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.JoinedStr):
+            # f"dispatcher{i}" -> leading constant prefix names the family
+            if node.values and isinstance(node.values[0], ast.Constant):
+                return str(node.values[0].value)
+        return None
+
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Call):
+            attr = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else (node.func.id if isinstance(node.func, ast.Name)
+                      else "")
+            if attr == "has_section" and node.args:
+                s = const_str(node.args[0])
+                if s:
+                    events.append((node.lineno, _family(s)))
+            elif attr == "merged" and node.args:
+                s = const_str(node.args[0])
+                if s:
+                    events.append((node.lineno, _family(s)))
+            elif attr in _GETTERS and node.args:
+                key = const_str(node.args[0])
+                if key is None:
+                    continue
+                inline_fam = None
+                recv = node.func.value if isinstance(
+                    node.func, ast.Attribute) else None
+                if isinstance(recv, ast.Subscript):
+                    s = const_str(recv.slice)
+                    if s:
+                        inline_fam = _family(s)
+                reads.append((node.lineno, _norm_key(key), inline_fam))
+        elif isinstance(node, ast.Subscript):
+            # cp["storage"] as a section-selecting event
+            base = dotted(node.value)
+            if base == "cp":
+                s = const_str(node.slice)
+                if s:
+                    events.append((node.lineno, _family(s)))
+
+    events.sort()
+    out: dict[str, dict[str, int]] = {}
+    for line, key, inline_fam in sorted(reads):
+        fam = inline_fam
+        if fam is None:
+            prior = [f for l, f in events if l <= line]
+            fam = prior[-1] if prior else ""
+        if fam:
+            out.setdefault(fam, {}).setdefault(key, line)
+    if has_start_nodes_reader:
+        for fam in ("storage", "kvdb"):
+            out.setdefault(fam, {}).setdefault("start_nodes_N", 1)
+    return out
+
+
+def check_r6(modules: list[ParsedModule], root: str) -> list[Violation]:
+    mod = next((m for m in modules
+                if m.path == "goworld_tpu/config/read_config.py"), None)
+    if mod is None:
+        return []
+    sample_path = os.path.join(root, "goworld.ini.sample")
+    if not os.path.exists(sample_path):
+        return []
+    sample, sample_lines = _sample_keys(root)
+    code = _code_keys(mod)
+    out: list[Violation] = []
+    for fam, keys in sorted(code.items()):
+        for key, line in sorted(keys.items()):
+            if key not in sample.get(fam, set()):
+                out.append(mod.violation(
+                    "R6", line,
+                    f"config key [{fam}] {key} is read here but not "
+                    f"documented in goworld.ini.sample — operators can't "
+                    f"discover it"))
+    for fam, keys in sorted(sample.items()):
+        for key in sorted(keys):
+            if key not in code.get(fam, {}):
+                ln = sample_lines.get((fam, key), 1)
+                out.append(Violation(
+                    "R6", "goworld.ini.sample", ln, f"[{fam}]",
+                    f"key {key} documented in goworld.ini.sample is never "
+                    f"read by config/read_config.py — drift or typo"))
+    return out
+
+
+CHECKERS = {
+    "R1": check_r1,
+    "R2": check_r2,
+    "R3": check_r3,
+    "R4": check_r4,
+    "R5": check_r5,
+    "R6": check_r6,
+}

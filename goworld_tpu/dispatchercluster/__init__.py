@@ -49,7 +49,7 @@ def set_cluster(cluster: Optional[DispatcherClusterBase]) -> None:
     _cluster = cluster
 
 
-def get_cluster() -> Optional[DispatcherClusterBase]:
+def get_cluster() -> Optional[DispatcherClusterBase]:  # gwlint: keep — accessor beside set_cluster/is_connected
     return _cluster
 
 

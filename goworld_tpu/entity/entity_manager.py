@@ -21,8 +21,6 @@ from goworld_tpu import consts, dispatchercluster, telemetry
 from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
 from goworld_tpu.entity.attrs import MapAttr
 from goworld_tpu.entity.entity import (
-    SIF_SYNC_NEIGHBOR_CLIENTS,
-    SIF_SYNC_OWN_CLIENT,
     Entity,
     EntityTypeDesc,
 )

@@ -10,7 +10,7 @@ freeze/restore (Space.go:117-125).
 
 from __future__ import annotations
 
-from goworld_tpu.entity.entity import Entity, EntityTypeDesc
+from goworld_tpu.entity.entity import Entity
 from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.utils import gwlog, gwutils
 

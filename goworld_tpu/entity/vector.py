@@ -45,6 +45,6 @@ class Vector3:
         return (self.x, self.y, self.z)
 
 
-def yaw_to_dir(yaw: float) -> Vector3:
+def yaw_to_dir(yaw: float) -> Vector3:  # gwlint: keep — Vector3 API parity (DirToYaw inverse)
     r = math.radians(yaw)
     return Vector3(math.sin(r), 0.0, math.cos(r))

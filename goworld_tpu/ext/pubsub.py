@@ -187,7 +187,7 @@ def unsubscribe(subscriber_eid: str, subject: str) -> None:
         service.call_service_shard_key(SERVICE_NAME, subject, "Unsubscribe", subscriber_eid, subject)
 
 
-def unsubscribe_all(subscriber_eid: str) -> None:
+def unsubscribe_all(subscriber_eid: str) -> None:  # gwlint: keep — reference API (Avatar.go:179)
     """Drop the subscriber from every shard (test_game/Avatar.go:179)."""
     from goworld_tpu import service
 

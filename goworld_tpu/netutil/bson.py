@@ -63,16 +63,32 @@ def encode(doc: dict) -> bytes:
     return _I32.pack(len(body) + 5) + bytes(body) + b"\x00"
 
 
+def _need(data: bytes, off: int, n: int) -> None:
+    """Bounds guard: every wire-derived offset/length passes through here
+    before a read, so a truncated or hostile document raises ValueError
+    (like the unsupported-type path) instead of struct.error/IndexError
+    out of the storage worker's decode."""
+    if off < 0 or n < 0 or off + n > len(data):
+        raise ValueError(
+            f"bson: truncated document (need {n} bytes at {off}, "
+            f"have {len(data)})")
+
+
 def _read_cstring(data: bytes, off: int) -> tuple[str, int]:
-    end = data.index(b"\x00", off)
+    end = data.index(b"\x00", off)  # raises ValueError when unterminated
     return data[off:end].decode("utf-8"), end + 1
 
 
 def _decode_value(kind: int, data: bytes, off: int):
     if kind == _DOUBLE:
+        _need(data, off, 8)
         return _F64.unpack_from(data, off)[0], off + 8
     if kind == _STRING:
+        _need(data, off, 4)
         (n,) = _I32.unpack_from(data, off)
+        if n < 1:
+            raise ValueError(f"bson: invalid string length {n}")
+        _need(data, off + 4, n)
         s = data[off + 4:off + 4 + n - 1].decode("utf-8")
         return s, off + 4 + n
     if kind == _DOC:
@@ -82,22 +98,30 @@ def _decode_value(kind: int, data: bytes, off: int):
         doc, n = _decode_doc(data, off)
         return [doc[k] for k in sorted(doc, key=int)], n
     if kind == _BOOL:
+        _need(data, off, 1)
         return data[off] != 0, off + 1
     if kind == _NULL:
         return None, off
     if kind == _INT32:
+        _need(data, off, 4)
         return _I32.unpack_from(data, off)[0], off + 4
     if kind == _INT64:
+        _need(data, off, 8)
         return _I64.unpack_from(data, off)[0], off + 8
     raise ValueError(f"bson: unsupported element type 0x{kind:02x}")
 
 
 def _decode_doc(data: bytes, off: int) -> tuple[dict, int]:
+    _need(data, off, 4)
     (total,) = _I32.unpack_from(data, off)
+    if total < 5:
+        raise ValueError(f"bson: invalid document length {total}")
+    _need(data, off, total)
     end = off + total - 1  # position of the trailing NUL
     off += 4
     doc: dict = {}
     while off < end:
+        _need(data, off, 1)
         kind = data[off]
         key, off = _read_cstring(data, off + 1)
         doc[key], off = _decode_value(kind, data, off)

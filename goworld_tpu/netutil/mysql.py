@@ -50,11 +50,16 @@ def _native_password_token(password: str, scramble: bytes) -> bytes:
 
 
 def _read_lenenc(data: bytes, off: int) -> tuple[Optional[int], int]:
+    if off >= len(data):
+        raise ValueError("mysql: truncated length-encoded integer")
     first = data[off]
     if first < 0xFB:
         return first, off + 1
     if first == 0xFB:  # NULL (in row context)
         return None, off + 1
+    width = {0xFC: 2, 0xFD: 3}.get(first, 8)
+    if off + 1 + width > len(data):
+        raise ValueError("mysql: truncated length-encoded integer")
     if first == 0xFC:
         return struct.unpack_from("<H", data, off + 1)[0], off + 3
     if first == 0xFD:

@@ -617,13 +617,6 @@ def _compiled_event_kernel(p: NeighborParams, interpret: bool,
     )
 
 
-def _unpack_bits(packed: jax.Array) -> jax.Array:
-    """i32[Q, W] 16-bit words → bool[Q, W*16]."""
-    q, w = packed.shape
-    bits = (packed[:, :, None] >> jnp.arange(_PACK, dtype=jnp.int32)) & 1
-    return bits.reshape(q, w * _PACK) > 0
-
-
 def _drain_bits(
     p: NeighborParams,
     packed_e: jax.Array,  # i32[N, W] per-entity packed event mask

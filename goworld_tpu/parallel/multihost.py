@@ -47,7 +47,6 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from goworld_tpu.ops.neighbor import (
-    LANES,
     NeighborParams,
     check_radius,
     check_space_ids,

@@ -105,7 +105,9 @@ def pack_client_sync_blocks(
     return np.array(rows, dtype=CLIENT_SYNC_BLOCK_DTYPE).tobytes()
 
 
-def pack_client_sync_columns(cid, eid, x, y, z, yaw) -> bytes:
+def pack_client_sync_columns(cid: np.ndarray, eid: np.ndarray,
+                             x: np.ndarray, y: np.ndarray,
+                             z: np.ndarray, yaw: np.ndarray) -> bytes:
     """Columnar variant of :func:`pack_client_sync_blocks`: fill the wire
     blocks by column assignment from parallel arrays (the slab store's
     collect path builds its per-gate buffers this way — zero Python row
@@ -141,7 +143,9 @@ class GoWorldConnection:
 
     # --- generic -----------------------------------------------------------
 
-    def _trace_ctx(self, packet_trace):
+    def _trace_ctx(
+        self, packet_trace: "_tracing.TraceContext | None"
+    ) -> "_tracing.TraceContext | None":
         """The context to piggyback: the active span's, else the one the
         packet itself arrived with (dispatcher buffered/replayed forwards
         outside any handling scope must not lose the trace)."""
@@ -174,7 +178,7 @@ class GoWorldConnection:
                 return
         self.conn.send_packet(msgtype, Packet(payload))
 
-    async def recv(self):
+    async def recv(self) -> tuple[int, Packet]:
         msgtype, packet = await self.conn.recv_packet()
         _PKT_IN.inc()
         _BYTES_IN.inc(packet.payload_len())
@@ -484,7 +488,8 @@ class GoWorldConnection:
         self.send(MsgType.DESTROY_ENTITY_ON_CLIENT, p)
 
     def send_notify_map_attr_change_on_client(
-        self, gateid: int, clientid: str, eid: str, path: list, key: str, val
+        self, gateid: int, clientid: str, eid: str, path: list, key: str,
+        val: object,
     ) -> None:
         p = self._client_packet(gateid, clientid)
         p.append_entity_id(eid)
@@ -511,7 +516,8 @@ class GoWorldConnection:
         self.send(MsgType.NOTIFY_MAP_ATTR_CLEAR_ON_CLIENT, p)
 
     def send_notify_list_attr_change_on_client(
-        self, gateid: int, clientid: str, eid: str, path: list, index: int, val
+        self, gateid: int, clientid: str, eid: str, path: list, index: int,
+        val: object,
     ) -> None:
         p = self._client_packet(gateid, clientid)
         p.append_entity_id(eid)
@@ -529,7 +535,7 @@ class GoWorldConnection:
         self.send(MsgType.NOTIFY_LIST_ATTR_POP_ON_CLIENT, p)
 
     def send_notify_list_attr_append_on_client(
-        self, gateid: int, clientid: str, eid: str, path: list, val
+        self, gateid: int, clientid: str, eid: str, path: list, val: object
     ) -> None:
         p = self._client_packet(gateid, clientid)
         p.append_entity_id(eid)

@@ -100,7 +100,7 @@ GATE_MAX = 1999
 CLIENT_MIN = 2001
 
 
-def is_dispatcher_handled(t: int) -> bool:
+def is_dispatcher_handled(t: int) -> bool:  # gwlint: keep — msgtype classification API beside is_gate_*
     return t < 1000
 
 
@@ -108,7 +108,7 @@ def is_gate_redirect(t: int) -> bool:
     return REDIRECT_MIN <= t <= REDIRECT_MAX
 
 
-def is_gate_handled(t: int) -> bool:
+def is_gate_handled(t: int) -> bool:  # gwlint: keep — msgtype classification API beside is_gate_redirect
     return GATE_MIN <= t <= GATE_MAX
 
 

@@ -216,14 +216,14 @@ class MetricFamily:
         self._lock = threading.Lock()
         self._children: dict[tuple[str, ...], Any] = {}
 
-    def _new_child(self):
+    def _new_child(self) -> Any:
         if self.kind == "counter":
             return Counter()
         if self.kind == "gauge":
             return Gauge()
         return Histogram(self._buckets or DEFAULT_BUCKETS)
 
-    def labels(self, *values: str, **kv: str):
+    def labels(self, *values: str, **kv: str) -> Any:
         """The child for one label-value combination (cached). Accepts
         positional values in labelname order or keyword form."""
         if kv:
@@ -275,7 +275,7 @@ class Registry:
 
     def _get_or_create(self, name: str, help: str, kind: str,
                        labelnames: Sequence[str],
-                       buckets: Optional[Sequence[float]] = None):
+                       buckets: Optional[Sequence[float]] = None) -> Any:
         labelnames = tuple(labelnames)
         with self._lock:
             fam = self._families.get(name)
@@ -299,16 +299,16 @@ class Registry:
         return fam
 
     def counter(self, name: str, help: str = "",
-                labelnames: Sequence[str] = ()):
+                labelnames: Sequence[str] = ()) -> Any:
         return self._get_or_create(name, help, "counter", labelnames)
 
     def gauge(self, name: str, help: str = "",
-              labelnames: Sequence[str] = ()):
+              labelnames: Sequence[str] = ()) -> Any:
         return self._get_or_create(name, help, "gauge", labelnames)
 
     def histogram(self, name: str, help: str = "",
                   labelnames: Sequence[str] = (),
-                  buckets: Optional[Sequence[float]] = None):
+                  buckets: Optional[Sequence[float]] = None) -> Any:
         return self._get_or_create(name, help, "histogram", labelnames, buckets)
 
     def family(self, name: str) -> Optional[MetricFamily]:

@@ -149,13 +149,13 @@ def trace_error(fmt: str, *args) -> None:
         _logger.error("%s\n%s", msg, "".join(traceback.format_stack()))
 
 
-def panicf(fmt: str, *args) -> None:
+def panicf(fmt: str, *args) -> None:  # gwlint: keep — reference gwlog API (Panicf)
     _ensure()
     _logger.critical(fmt, *args)
     raise RuntimeError(fmt % args if args else fmt)
 
 
-def fatalf(fmt: str, *args) -> None:
+def fatalf(fmt: str, *args) -> None:  # gwlint: keep — reference gwlog API (Fatalf)
     _ensure()
     _logger.critical(fmt, *args)
     sys.exit(1)

@@ -28,7 +28,7 @@ def run_panicless(fn: Callable[[], T]) -> bool:
         return False
 
 
-def catch_panic(fn: Callable[[], T]) -> BaseException | None:
+def catch_panic(fn: Callable[[], T]) -> BaseException | None:  # gwlint: keep — reference gwutils API (CatchPanic)
     """Run ``fn``; return the exception it raised, if any."""
     try:
         fn()

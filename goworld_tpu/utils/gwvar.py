@@ -8,7 +8,7 @@ server (utils/debug_http.py) at ``/vars``.
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any
 
 _vars: dict[str, Any] = {}
 
@@ -18,7 +18,7 @@ def set_var(name: str, value: Any) -> None:
     _vars[name] = value
 
 
-def get_var(name: str, default: Any = None) -> Any:
+def get_var(name: str, default: Any = None) -> Any:  # gwlint: keep — accessor beside set/unset
     v = _vars.get(name, default)
     return v() if callable(v) else v
 

@@ -64,7 +64,7 @@ def dump() -> dict[str, dict[str, float]]:
     return out
 
 
-def dump_log() -> None:
+def dump_log() -> None:  # gwlint: keep — operator-facing opmon shim (reference Dump parity)
     for name, st in sorted(dump().items()):
         gwlog.infof(
             "opmon: %-32s count=%-8d avg=%.3fms p50=%.3fms p99=%.3fms "

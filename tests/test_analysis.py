@@ -1,0 +1,708 @@
+"""Static-analysis suite (ISSUE 9): gwlint rules, baseline mechanics,
+the whole-package tier-1 gate, the typed-core mypy gate, and the runtime
+lock-order detector (unit + chaos/stress smokes).
+
+Run just these with ``pytest -m analysis``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import queue
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from goworld_tpu.analysis import core, hot_path, reach
+from goworld_tpu.analysis.lockgraph import LockGraphMonitor
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASELINE = os.path.join(REPO_ROOT, "gwlint_baseline.toml")
+
+assert hot_path  # imported for API stability; the decorator is rule input
+
+
+# --- fixture helpers ---------------------------------------------------------
+
+
+def _lint_snippet(tmp_path, relpath: str, source: str,
+                  rules: tuple[str, ...],
+                  extra: dict[str, str] | None = None) -> core.LintResult:
+    """Write ``source`` at ``relpath`` under a throwaway repo root and run
+    the given rules over it."""
+    for p, s in {relpath: source, **(extra or {})}.items():
+        dst = tmp_path / p
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(s)
+    return core.run_lint(str(tmp_path), rules=rules)
+
+
+def _messages(result: core.LintResult) -> list[str]:
+    return [v.render() for v in result.violations]
+
+
+# --- R1: jit hygiene ---------------------------------------------------------
+
+
+R1_BAD = """\
+import jax
+import numpy as np
+
+_CACHE = {}
+
+def helper(x):
+    return float(x.sum())
+
+def materialize(x):
+    return np.asarray(x)
+
+def step(x):
+    _CACHE["last"] = 1
+    v = x.item()
+    return helper(x) + materialize(x) + v
+
+jitted = jax.jit(step)
+"""
+
+R1_CLEAN = """\
+import jax
+import jax.numpy as jnp
+
+def helper(x):
+    return jnp.sum(x)
+
+def step(x):
+    return helper(x) * 2
+
+jitted = jax.jit(step)
+
+def host_wrapper(x):
+    # NOT jit-reachable: host-side use of the same primitives is fine
+    return float(jitted(x).item())
+"""
+
+
+def test_r1_flags_host_sync_in_jit_reachable(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/mod.py", R1_BAD, ("R1",))
+    msgs = "\n".join(_messages(r))
+    assert ".item()" in msgs
+    assert "float(x)" in msgs or "float" in msgs
+    assert "np.asarray" in msgs
+    assert "mutates module-level container" in msgs
+    # helper reached transitively, step directly
+    assert any(v.symbol == "helper" for v in r.violations)
+    assert any(v.symbol == "step" for v in r.violations)
+
+
+def test_r1_host_side_is_clean(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/mod.py", R1_CLEAN, ("R1",))
+    assert r.ok, _messages(r)
+
+
+def test_r1_cross_module_reachability(tmp_path):
+    r = _lint_snippet(
+        tmp_path, "goworld_tpu/a.py",
+        "import jax\nfrom goworld_tpu.b import kernel\n"
+        "jitted = jax.jit(kernel)\n",
+        ("R1",),
+        extra={"goworld_tpu/b.py":
+               "def kernel(x):\n    return x.item()\n"})
+    assert any(v.path == "goworld_tpu/b.py" for v in r.violations), \
+        _messages(r)
+
+
+# --- R2: hot-path shape ------------------------------------------------------
+
+
+R2_BAD = """\
+import struct
+
+@hot_path
+def collect(entities):
+    out = bytearray()
+    for e in entities:
+        out += struct.pack("<16s", e)
+    return bytes(out)
+"""
+
+R2_CLEAN = """\
+@hot_path
+def collect(columns):
+    for kind in ("a", "b", "c"):
+        columns.flush(kind)
+    return columns.tobytes()
+"""
+
+
+def test_r2_flags_per_item_loop_and_pack(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/hp.py", R2_BAD, ("R2",))
+    msgs = "\n".join(_messages(r))
+    assert "per-item Python loop" in msgs
+    assert "struct.pack" in msgs
+
+
+def test_r2_const_bounded_loop_is_clean(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/hp.py", R2_CLEAN, ("R2",))
+    assert r.ok, _messages(r)
+
+
+def test_r2_undecorated_function_not_checked(tmp_path):
+    src = R2_BAD.replace("@hot_path\n", "")
+    r = _lint_snippet(tmp_path, "goworld_tpu/hp.py", src, ("R2",))
+    assert r.ok, _messages(r)
+
+
+# --- R3: parse bounds --------------------------------------------------------
+
+
+R3_BAD = """\
+import struct
+
+def parse(data: bytes):
+    kind = data[0]
+    return kind, struct.unpack("<H", data[1:3])[0]
+"""
+
+R3_CLEAN = """\
+import struct
+
+def parse(data: bytes):
+    if len(data) < 3:
+        raise ValueError("short frame")
+    kind = data[0]
+    return kind, struct.unpack("<H", data[1:3])[0]
+
+def parse_try(data: bytes):
+    try:
+        return struct.unpack("<H", data[0:2])[0]
+    except struct.error:
+        return None
+
+def parse_helper(data: bytes, off: int):
+    _need(data, off, 2)
+    return struct.unpack_from("<H", data, off)[0]
+"""
+
+
+def test_r3_flags_unguarded_buffer_reads(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/netutil/p.py", R3_BAD, ("R3",))
+    assert len(r.violations) == 2, _messages(r)  # index + unpack
+
+
+def test_r3_guarded_reads_are_clean(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/netutil/p.py", R3_CLEAN, ("R3",))
+    assert r.ok, _messages(r)
+
+
+def test_r3_only_applies_to_wire_modules(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/entity/p.py", R3_BAD, ("R3",))
+    assert r.ok, _messages(r)
+
+
+# --- R4: lock discipline -----------------------------------------------------
+
+
+R4_BAD = """\
+import threading
+import time
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def bad_sleep(self):
+        with self._lock:
+            time.sleep(0.5)
+
+    def bad_bare(self):
+        self._lock.acquire()
+        try:
+            pass
+        finally:
+            self._lock.release()
+
+    def bad_queue(self, q):
+        with self._lock:
+            self.queue.get()
+"""
+
+R4_CLEAN = """\
+import threading
+import time
+
+class Svc:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def good(self):
+        with self._lock:
+            self.counter += 1
+        time.sleep(0.5)
+
+    def good_nonblocking(self, q):
+        with self._lock:
+            self.queue.get(block=False)
+"""
+
+
+def test_r4_flags_blocking_and_bare_acquire(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/svc.py", R4_BAD, ("R4",))
+    msgs = "\n".join(_messages(r))
+    assert "time.sleep under a held lock" in msgs
+    assert "bare .acquire()" in msgs
+    assert "bare .release()" in msgs
+    assert "blocking queue .get()" in msgs
+
+
+def test_r4_clean_lock_use(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/svc.py", R4_CLEAN, ("R4",))
+    assert r.ok, _messages(r)
+
+
+# --- R5: telemetry hygiene ---------------------------------------------------
+
+
+R5_BAD = """\
+from goworld_tpu.telemetry.metrics import REGISTRY
+
+REQS = REGISTRY.counter("reqs_total")
+
+def handle():
+    REQS.dec()
+
+def lazy_register():
+    c = REGISTRY.counter("oops_total")
+    return c
+
+def leaky_span():
+    scope = root_scope("x")
+    scope.args["k"] = 1
+"""
+
+R5_CLEAN = """\
+from goworld_tpu.telemetry.metrics import REGISTRY
+
+REQS = REGISTRY.counter("reqs_total")
+DEPTH = REGISTRY.gauge("depth")
+
+def handle():
+    REQS.inc()
+    DEPTH.dec()
+
+def spanned():
+    scope = root_scope("x")
+    if scope is not None:
+        with scope:
+            pass
+
+def factory():
+    scope = root_scope("x")
+    return scope
+"""
+
+
+def test_r5_flags_dec_lazy_register_leaky_span(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/t.py", R5_BAD, ("R5",))
+    msgs = "\n".join(_messages(r))
+    assert ".dec()'d" in msgs
+    assert "registered inside" in msgs
+    assert "never" in msgs and "entered" in msgs
+
+
+def test_r5_clean_telemetry_use(tmp_path):
+    r = _lint_snippet(tmp_path, "goworld_tpu/t.py", R5_CLEAN, ("R5",))
+    assert r.ok, _messages(r)
+
+
+# --- R6: config drift --------------------------------------------------------
+
+
+R6_CONFIG = """\
+import configparser
+
+def load(cp):
+    if cp.has_section("storage"):
+        s = cp["storage"]
+        t = s.get("type", "filesystem")
+        secret = s.get("undocumented_knob", "")
+    return t, secret
+"""
+
+R6_SAMPLE_DRIFT = """\
+[storage]
+type = filesystem
+orphaned_key = 1
+"""
+
+R6_SAMPLE_CLEAN = """\
+[storage]
+type = filesystem
+; undocumented_knob =       ; now documented
+"""
+
+
+def test_r6_flags_drift_both_directions(tmp_path):
+    r = _lint_snippet(
+        tmp_path, "goworld_tpu/config/read_config.py", R6_CONFIG, ("R6",),
+        extra={"goworld.ini.sample": R6_SAMPLE_DRIFT})
+    msgs = "\n".join(_messages(r))
+    assert "undocumented_knob" in msgs  # read but not documented
+    assert "orphaned_key" in msgs  # documented but never read
+
+
+def test_r6_documented_keys_are_clean(tmp_path):
+    r = _lint_snippet(
+        tmp_path, "goworld_tpu/config/read_config.py", R6_CONFIG, ("R6",),
+        extra={"goworld.ini.sample": R6_SAMPLE_CLEAN})
+    assert r.ok, _messages(r)
+
+
+# --- suppression mechanics ---------------------------------------------------
+
+
+def test_inline_pragma_suppresses_with_reason(tmp_path):
+    src = R3_BAD.replace(
+        "kind = data[0]",
+        "kind = data[0]  # gwlint: ok R3 fixture — caller pre-validates")
+    r = _lint_snippet(tmp_path, "goworld_tpu/netutil/p.py", src, ("R3",))
+    assert len(r.violations) == 1, _messages(r)  # only the unpack remains
+    assert len(r.suppressed) == 1
+
+
+def test_pragma_without_reason_does_not_suppress(tmp_path):
+    src = R3_BAD.replace("kind = data[0]",
+                         "kind = data[0]  # gwlint: ok R3")
+    r = _lint_snippet(tmp_path, "goworld_tpu/netutil/p.py", src, ("R3",))
+    assert len(r.violations) == 2, _messages(r)
+
+
+def test_baseline_suppresses_by_symbol(tmp_path):
+    (tmp_path / "goworld_tpu" / "netutil").mkdir(parents=True)
+    (tmp_path / "goworld_tpu" / "netutil" / "p.py").write_text(R3_BAD)
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(
+        '[[suppress]]\nrule = "R3"\npath = "goworld_tpu/netutil/p.py"\n'
+        'symbol = "parse"\nreason = "fixture: both reads pre-validated"\n')
+    r = core.run_lint(str(tmp_path), baseline_path=str(bl), rules=("R3",))
+    assert r.ok and len(r.suppressed) == 2
+    assert not r.stale_baseline
+
+
+def test_baseline_requires_reason(tmp_path):
+    bl = tmp_path / "baseline.toml"
+    bl.write_text('[[suppress]]\nrule = "R3"\npath = "x.py"\n')
+    with pytest.raises(ValueError, match="justification"):
+        core.load_baseline(str(bl))
+
+
+def test_stale_baseline_entries_are_reported(tmp_path):
+    (tmp_path / "goworld_tpu").mkdir(parents=True)
+    (tmp_path / "goworld_tpu" / "p.py").write_text("x = 1\n")
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(
+        '[[suppress]]\nrule = "R3"\npath = "goworld_tpu/gone.py"\n'
+        'reason = "matches nothing anymore"\n')
+    r = core.run_lint(str(tmp_path), baseline_path=str(bl), rules=("R3",))
+    assert len(r.stale_baseline) == 1
+
+
+# --- the tier-1 gates --------------------------------------------------------
+
+
+def test_gwlint_package_gate():
+    """THE gate: the whole package linted by all six rules must be clean
+    under the committed baseline, every suppression must carry a
+    justification, and the baseline must contain no stale entries (it
+    only ever shrinks outside review)."""
+    result = core.run_lint(REPO_ROOT, baseline_path=BASELINE)
+    assert result.ok, "\n" + result.render()
+    for s in core.load_baseline(BASELINE):
+        assert s.reason.strip(), f"baseline entry without reason: {s}"
+        assert not s.reason.startswith("TRIAGE"), \
+            f"untriaged baseline entry: {s}"
+    assert not result.stale_baseline, "\n" + result.render()
+
+
+def test_gwlint_cli_runs_clean():
+    """tools/gwlint.py (what developers run locally) exits 0 on the
+    committed tree."""
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "tools", "gwlint.py")],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_dead_code_report_is_empty():
+    """The reachability pass stays clean: new dead symbols either get
+    deleted or an explicit `# gwlint: keep` marker."""
+    modules = core.parse_package(REPO_ROOT)
+    dead = reach.find_dead_code(REPO_ROOT, modules)
+    assert not dead, "\n".join(d.render() for d in dead)
+
+
+def test_typed_core_mypy_gate():
+    """proto/, common/ and telemetry/metrics.py must pass mypy under
+    mypy.ini.  Skips cleanly when mypy is absent from the image (it is
+    not baked in today); the config pins the flags so the typed surface
+    only grows where mypy IS available."""
+    if shutil.which("mypy") is None:
+        try:
+            import mypy  # noqa: F401
+        except ImportError:
+            pytest.skip("mypy not installed in this image")
+    proc = subprocess.run(
+        [sys.executable, "-m", "mypy", "--config-file",
+         os.path.join(REPO_ROOT, "mypy.ini")],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=600)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# --- lockgraph: unit ---------------------------------------------------------
+
+
+def test_lockgraph_detects_ab_ba_inversion():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def t_ab():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def t_ba():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        th = threading.Thread(target=t_ab)
+        th.start(); th.join()
+        th = threading.Thread(target=t_ba)
+        th.start(); th.join()
+    r = mon.report()
+    assert r["cycles"], r["edges"]
+
+
+def test_lockgraph_consistent_order_is_acyclic():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+    r = mon.report()
+    assert r["edges"] and not r["cycles"]
+
+
+def test_lockgraph_flags_sleep_under_lock():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lk = threading.Lock()
+        with lk:
+            time.sleep(0.001)
+    r = mon.report()
+    assert len(r["blocking"]) == 1
+    assert "time.sleep" in r["blocking"][0]["call"]
+
+
+def test_lockgraph_flags_blocking_queue_get_under_lock():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lk = threading.Lock()
+        q = queue.Queue()
+        q.put(1)
+        with lk:
+            q.get(timeout=1)
+    r = mon.report()
+    assert any("queue.Queue.get" in b["call"] for b in r["blocking"])
+
+
+def test_lockgraph_sleep_outside_lock_is_clean():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lk = threading.Lock()
+        with lk:
+            pass
+        time.sleep(0.001)
+    assert not mon.report()["blocking"]
+
+
+def test_lockgraph_detects_self_deadlock_reacquire():
+    mon = LockGraphMonitor()
+    with mon.installed():
+        lk = threading.Lock()
+        lk.acquire()
+        # A blocking re-acquire would hang the test; drive the monitor's
+        # check path directly (what acquire(blocking=True) runs first).
+        mon._before_acquire(lk, True)
+        lk.release()
+    assert len(mon.report()["deadlocks"]) == 1
+
+
+def test_lockgraph_condition_and_event_compatible():
+    """threading.Condition/Event built on tracked locks must work, and
+    Condition.wait must not read as blocking-under-lock (it releases)."""
+    mon = LockGraphMonitor()
+    with mon.installed():
+        cond = threading.Condition()
+        done = []
+
+        def waiter():
+            with cond:
+                while not done:
+                    cond.wait(timeout=2)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.05)
+        with cond:
+            done.append(1)
+            cond.notify()
+        th.join(timeout=2)
+        assert not th.is_alive()
+    assert not mon.report()["blocking"]
+
+
+def test_lockgraph_uninstall_restores_primitives():
+    mon = LockGraphMonitor()
+    mon.install()
+    mon.uninstall()
+    assert threading.Lock is not mon._make_lock
+    lk = threading.Lock()
+    assert type(lk).__name__ != "_TrackedLock"
+
+
+# --- lockgraph: cluster smokes ----------------------------------------------
+
+
+def _chaos_smoke(scenario_fn=None, runtime: float = 0.0, **cluster_kw):
+    """Run a real in-process cluster under the monitor; returns (scenario
+    result, lockgraph report).  Monitor installs BEFORE construction so
+    engine locks created at build time are tracked."""
+    from goworld_tpu.chaos import ChaosCluster
+
+    mon = LockGraphMonitor()
+    with mon.installed():
+        async def run():
+            cluster = ChaosCluster(
+                cluster_kw.pop("run_dir"), n_dispatchers=2, n_bots=8,
+                storage_knobs=dict(
+                    retry_base_interval=0.05, retry_max_interval=0.2,
+                    circuit_failure_threshold=3, circuit_cooldown=0.3),
+                **cluster_kw)
+            await cluster.start()
+            try:
+                if scenario_fn is not None:
+                    return await scenario_fn(cluster)
+                await asyncio.sleep(runtime)
+                return {}
+            finally:
+                await cluster.stop()
+
+        result = asyncio.run(run())
+    return result, mon.report()
+
+
+def _assert_lock_clean(report: dict) -> None:
+    """The ISSUE 9 acceptance surface: acquisition order among ENGINE
+    locks is acyclic and no blocking call runs under an engine lock.
+    (Cycles/blocking confined to third-party locks created while the
+    monitor was installed are reported but not gated — we don't own
+    them.)"""
+    assert report["locks_created"] > 0, "monitor saw no locks — smoke broken"
+    assert report["goworld_sites"], "no engine locks tracked — smoke broken"
+    assert not report["goworld_cycles"], report["edges"]
+    assert not report["goworld_blocking"], report["goworld_blocking"]
+    assert not report["deadlocks"], report["deadlocks"]
+
+
+@pytest.mark.chaos
+def test_lockgraph_chaos_smoke(tmp_path):
+    """Dispatcher kill+restart under 8 strict bots with every engine lock
+    instrumented: the acquisition graph across the game loop, storage
+    worker and network threads must be acyclic, with no blocking call
+    under a held engine lock — and the scenario's own invariants hold."""
+    from goworld_tpu.chaos import scenario_dispatcher_restart
+
+    result, report = _chaos_smoke(scenario_dispatcher_restart,
+                                  run_dir=str(tmp_path))
+    assert result["bot_errors"] == 0
+    _assert_lock_clean(report)
+
+
+def test_lockgraph_stress_smoke(tmp_path):
+    """Steady-state stress smoke: the same instrumented cluster serving
+    bots with no fault injected — covers the pure hot-path interleavings
+    (tick loop, sync fan-out, storage saves) the chaos scenario spends
+    less time in."""
+    _, report = _chaos_smoke(runtime=1.5, run_dir=str(tmp_path))
+    _assert_lock_clean(report)
+
+
+def test_lockgraph_component_stress():
+    """Direct cross-thread hammering of the shared observability core
+    (the locks every process contends on: metric children, family
+    get-or-create, exposition render) plus a bounded work queue — the
+    cluster smokes see these locks but little nesting; this drives real
+    concurrent acquisition from 4 threads and still demands a clean
+    graph."""
+    from goworld_tpu.telemetry.metrics import Registry
+
+    mon = LockGraphMonitor()
+    with mon.installed():
+        reg = Registry()  # fresh: children created under the monitor
+        hist = reg.histogram("stress_hist")
+        fam = reg.counter("stress_total", labelnames=("k",))
+        q: queue.Queue = queue.Queue(maxsize=64)
+        stop = threading.Event()
+
+        def observer():
+            i = 0
+            while not stop.is_set():
+                hist.observe(i * 0.001)
+                fam.labels(str(i % 7)).inc()
+                i += 1
+
+        def renderer():
+            while not stop.is_set():
+                reg.render()
+                reg.snapshot()
+
+        def producer():
+            i = 0
+            while not stop.is_set():
+                try:
+                    q.put(i, timeout=0.01)
+                except queue.Full:
+                    pass
+                i += 1
+
+        def consumer():
+            while not stop.is_set():
+                try:
+                    q.get(timeout=0.01)
+                except queue.Empty:
+                    pass
+
+        threads = [threading.Thread(target=f)
+                   for f in (observer, renderer, producer, consumer)]
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+            assert not t.is_alive()
+    report = mon.report()
+    assert report["goworld_sites"], "metrics locks not tracked"
+    _assert_lock_clean(report)

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-shot static-analysis entry point (ISSUE 9): exactly what tier-1
+# gates, runnable locally before a commit.
+#   1. gwlint — six engine rules over goworld_tpu/ under the committed
+#      baseline (tools/gwlint.py)
+#   2. typed-core gate — mypy over proto/, common/, telemetry/metrics.py
+#      (skipped with a notice when mypy is not installed)
+#   3. the analysis pytest marker — rule fixtures, baseline mechanics,
+#      lockgraph units and cluster smokes
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+rc=0
+
+echo "== gwlint =="
+python tools/gwlint.py || rc=1
+
+echo "== typed core (mypy) =="
+if python -c "import mypy" 2>/dev/null; then
+    python -m mypy --config-file mypy.ini || rc=1
+else
+    echo "mypy not installed — skipping (tier-1 skips this the same way)"
+fi
+
+echo "== analysis test suite =="
+JAX_PLATFORMS=cpu python -m pytest tests/ -q -m analysis \
+    -p no:cacheprovider || rc=1
+
+exit $rc
