@@ -662,6 +662,9 @@ def bench_fanout(trace_sample_rate: int | None = None,
     import tempfile
 
     c = config or FANOUT_CONFIG
+    if trace_sample_rate is None and "BENCH_TRACE_SAMPLE_RATE" in os.environ:
+        # Env override for subprocess-fresh gate runs (_fanout_tier1_env).
+        trace_sample_rate = int(os.environ["BENCH_TRACE_SAMPLE_RATE"])
 
     async def run() -> tuple[list[float], dict]:
         from goworld_tpu.config.read_config import (
@@ -890,6 +893,30 @@ def bench_fanout(trace_sample_rate: int | None = None,
     return out
 
 
+def _fanout_tier1_env(trace_sample_rate: int | None = None) -> dict:
+    """bench_fanout in a FRESH subprocess under the tier-1 XLA env — the
+    same churn-isolation move _pinned_floor_tier1_env documents: an
+    interpreter that has run minutes of suite work (and, since ISSUE 10,
+    spawned multigame game subprocesses) measures the in-process fanout
+    loop 10-30% slow, which turned the later-running tracing-off gate
+    into a coin flip against a floor measured on a fresh process.
+    ``trace_sample_rate`` rides the BENCH_TRACE_SAMPLE_RATE env override
+    (0 = tracing off — the gated point)."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    if trace_sample_rate is not None:
+        env["BENCH_TRACE_SAMPLE_RATE"] = str(trace_sample_rate)
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--fanout"],
+        capture_output=True, text=True, env=env, timeout=600, check=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
 def bench_fanout_multi(trace_sample_rate: int | None = None) -> dict:
     """``bench.py --fanout-multi``: the 2-gate x 104-bot fan-out floor
     variant (FANOUT_MULTI_CONFIG), gated against
@@ -948,45 +975,132 @@ def bench_trace_overhead() -> dict:
 
 # --- chaos: fault-injection suite over a live in-process cluster -------------
 
-CHAOS_CONFIG = {"dispatchers": 2, "bots": 12}
+CHAOS_CONFIG = {"dispatchers": 2, "bots": 12, "multigame_bots": 12,
+                "scenarios_per_transport": 7}
 
 
 def bench_chaos() -> dict:
-    """``bench.py --chaos``: the full goworld_tpu.chaos scenario suite —
-    dispatcher kill+restart, severed link, stalled-past-heartbeat
-    dispatcher, storage outage — over a real dispatcher+game+gate cluster
-    with strict bots, run ONCE PER CLUSTER TRANSPORT (tcp, then uds):
-    fault semantics must be transport-identical, and each scenario asserts
-    zero bot errors / zero entity loss / in-deadline recovery either way.
-    Value = total scenarios passed across both transports (8 = all green);
-    any failure surfaces as an ``error`` field instead of a number."""
+    """``bench.py --chaos``: the full chaos scenario suite — dispatcher
+    kill+restart, severed link, stalled-past-heartbeat dispatcher, storage
+    outage, GAME kill+recreate, GATE kill (client reconnect wave), and
+    migrate-during-dispatcher-restart (on the 2-game multigame cluster) —
+    run ONCE PER CLUSTER TRANSPORT (tcp, then uds): fault semantics must
+    be transport-identical, and each scenario asserts zero bot errors /
+    zero entity loss / in-deadline recovery either way.
+
+    Value = total scenarios passed across both transports (14 = all
+    green). The headline carries a per-scenario map of recovery time and
+    bot-error count; failures are named per scenario in ``failures`` and
+    make the PROCESS exit non-zero (deviation from the headline-bench
+    never-die rule, deliberately: --chaos is a gate, not a telemetry
+    probe — see main())."""
     import tempfile
 
     from goworld_tpu.chaos import run_chaos
+    from goworld_tpu.chaos.multigame import run_multigame
 
     c = CHAOS_CONFIG
     per_transport: dict = {}
+    per_scenario: dict = {}
+    failures: list = []
     worst = 0.0
     passed = 0
     for transport in ("tcp", "uds"):
         with tempfile.TemporaryDirectory(prefix="bench_chaos_") as d:
             r = run_chaos(d, n_dispatchers=c["dispatchers"],
                           n_bots=c["bots"], transport=transport)
-        worst = max(worst, max(
-            s.get("recovery_s", s.get("detect_s", 0.0))
-            for s in r["scenarios"]))
-        passed += r["passed"]
+        scenarios = list(r["scenarios"])
+        # 7th scenario: commanded migrations crossing a dispatcher
+        # restart — needs two REAL game processes (multigame harness).
+        with tempfile.TemporaryDirectory(prefix="bench_chaos_mg_") as d:
+            try:
+                mg = run_multigame(d, n_bots=c["multigame_bots"],
+                                   transport=transport,
+                                   with_restart_phase=True)
+                phase = dict(mg["dispatcher_restart_phase"])
+                phase["rebalance_convergence_s"] = mg["convergence_s"]
+                scenarios.append(phase)
+            except Exception as exc:
+                failures.append({
+                    "scenario": "migrate_during_dispatcher_restart",
+                    "transport": transport,
+                    "error": f"{type(exc).__name__}: {exc}"})
+        for s in scenarios:
+            per_scenario[f"{transport}:{s['scenario']}"] = {
+                "recovery_s": s.get("recovery_s", s.get("detect_s", 0.0)),
+                "bot_errors": s.get("bot_errors", 0),
+            }
+            worst = max(worst, s.get("recovery_s",
+                                     s.get("detect_s", 0.0)))
+        failures.extend(
+            dict(f, transport=transport) for f in r["failures"])
+        passed += len(scenarios)
         per_transport[transport] = {
-            "passed": r["passed"], "scenarios": r["scenarios"]}
-    return {
+            "passed": len(scenarios), "scenarios": scenarios}
+    out = {
         "metric": "chaos_scenarios_passed",
         "value": float(passed),
         "unit": "scenarios",
         "worst_recovery_s": round(worst, 3),
+        "per_scenario": per_scenario,
+        "bot_errors": sum(v["bot_errors"] for v in per_scenario.values()),
         "transports": per_transport,
         "config": dict(c),
         "platform": "cpu",
     }
+    if failures:
+        out["failures"] = failures
+        out["error"] = "; ".join(
+            f"{f.get('transport', '?')}:{f['scenario']}: {f['error']}"
+            for f in failures)
+    return out
+
+
+# --- multigame: live-rebalance floor over 2 real game processes --------------
+
+# FIXED config (same never-self-tuned philosophy as the other floors): 2
+# game subprocesses + 2 in-parent dispatchers + 1 gate + 12 strict bots,
+# xzlist AOI, every avatar deliberately booted onto game1 (game2 is
+# boot-banned) so the initial placement is fully skewed. The measured
+# number is rebalance THROUGHPUT: entities moved per second of
+# convergence (planner resume → balanced-and-stable census), which folds
+# planning cadence, the hardened migrate path, and the report loop into
+# one number. The same run then executes the migrate-during-dispatcher-
+# restart chaos phase (zero loss required) so the floor can never go
+# green while the robustness story is broken. Timing-quantized (planning
+# rounds + report cycles), hence the wide committed tolerance.
+MULTIGAME_CONFIG = {
+    "bots": 12, "games": 2, "dispatchers": 2, "transport": "tcp",
+}
+
+
+def bench_multigame() -> dict:
+    """``bench.py --multigame``: rebalance convergence on the 2-game
+    cluster at the fixed config above. Gated against
+    BENCH_FLOOR.json["multigame"] by tier-1
+    (tests/test_telemetry.py::test_multigame_floor_gate), which also
+    requires zero entity loss, zero bot errors, and a zero-loss
+    dispatcher-restart phase."""
+    import tempfile
+
+    from goworld_tpu.chaos.multigame import run_multigame
+
+    c = MULTIGAME_CONFIG
+    with tempfile.TemporaryDirectory(prefix="bench_multigame_") as d:
+        r = run_multigame(d, n_bots=c["bots"], transport=c["transport"],
+                          with_restart_phase=True)
+    value = r["migrations_done"] / max(r["convergence_s"], 1e-9)
+    out = {
+        "metric": "multigame_rebalance_entities_per_sec",
+        "value": round(value, 2),
+        "unit": "entities/sec",
+        "runs": [round(value, 2)],
+        "config": dict(c),
+        "platform": "cpu",
+        "floor_file": PINNED_FLOOR_FILE,
+    }
+    out.update(r)
+    return out
 
 
 # Boids supercell sweep at a FIXED 100-unit interaction radius over the
@@ -1264,11 +1378,20 @@ def update_floor(allow_lower: bool = False) -> int:
     # backend for the sharded floor).
     prov_keys = ("sync_path", "slab_entities", "mesh", "backend",
                  "shard_mode", "parity_with_single_device",
-                 "halo_bytes_per_tick", "allgather_equiv_bytes_per_tick")
+                 "halo_bytes_per_tick", "allgather_equiv_bytes_per_tick",
+                 "convergence_s", "migrations_done",
+                 "migrations_rolled_back", "zero_loss")
+    # Per-floor default tolerance for NEW entries (existing entries keep
+    # theirs): multigame is timing-quantized (planning rounds + report
+    # cycles dominate its convergence time), so its gate is deliberately
+    # loose — the hard assertions (zero loss, zero errors) carry the
+    # correctness load there.
+    tolerances = {"multigame": 0.5}
     for key, fn in (("pinned", _pinned_floor_tier1_env),
                     ("sharded", _sharded_floor_tier1_env),
                     ("fanout", bench_fanout),
-                    ("fanout_multi", bench_fanout_multi)):
+                    ("fanout_multi", bench_fanout_multi),
+                    ("multigame", bench_multigame)):
         vals = []
         for _ in range(2):
             r = fn()
@@ -1281,7 +1404,8 @@ def update_floor(allow_lower: bool = False) -> int:
             print(json.dumps(line, separators=(",", ":")))
         measured = min(vals)
         entry = spec.setdefault(key, {
-            "metric": r["metric"], "tolerance": 0.25, "unit": r["unit"]})
+            "metric": r["metric"],
+            "tolerance": tolerances.get(key, 0.25), "unit": r["unit"]})
         for k in prov_keys:
             if k in r:
                 entry[k] = r[k]
@@ -1303,6 +1427,7 @@ def update_floor(allow_lower: bool = False) -> int:
                       "sharded": spec["sharded"]["floor"],
                       "fanout": spec["fanout"]["floor"],
                       "fanout_multi": spec["fanout_multi"]["floor"],
+                      "multigame": spec["multigame"]["floor"],
                       "kept": kept or None},
                      separators=(",", ":")))
     return 0
@@ -1320,6 +1445,8 @@ def main() -> int:
          "fanout_multi_sync_records_per_sec", "sync-records/sec"),
         ("--fanout", bench_fanout,
          "fanout_sync_records_per_sec", "sync-records/sec"),
+        ("--multigame", bench_multigame,
+         "multigame_rebalance_entities_per_sec", "entities/sec"),
         ("--chaos", bench_chaos,
          "chaos_scenarios_passed", "scenarios"),
         ("--trace-overhead", bench_trace_overhead,
@@ -1340,6 +1467,14 @@ def main() -> int:
                     "error": traceback.format_exc(limit=4),
                 }
             print(json.dumps(result, separators=(",", ":")))
+            if flag == "--chaos":
+                # Deliberate exception to the rc-always-0 rule: --chaos
+                # is a GATE. Any bot error or failed scenario exits
+                # non-zero with the scenario named in the JSON's
+                # failures/error fields (ISSUE 10 satellite).
+                if (result.get("error") or result.get("failures")
+                        or result.get("bot_errors")):
+                    return 1
             return 0
     diag: dict = {}
     platform = _resolve_platform(diag)

@@ -6,8 +6,11 @@ dispatchers and fault injectors. Everything runs in ONE asyncio loop and
 ONE process — "killing a dispatcher" stops its service after aborting its
 sockets (RST, not FIN: peers see a crash, not a shutdown), "pausing" one
 stalls its logic/tick loops with sockets open (the half-open-link case the
-liveness heartbeats exist for), and the storage fault wraps the live
-backend in a write-failing decorator.
+liveness heartbeats exist for), the storage fault wraps the live backend
+in a write-failing decorator, "killing the game" cancels its loop and
+wipes the per-process entity world (registry kept — a fresh interpreter
+re-importing the same server module), and "killing the gate" aborts every
+client socket so a NEW gate process (fresh generation) takes the port.
 
 Invariants every scenario asserts (ISSUE 3 acceptance):
 - zero bot errors (bots run strict — any protocol inconsistency records);
@@ -45,6 +48,15 @@ from goworld_tpu.utils import gwlog
 
 AOI_DISTANCE = 100.0
 
+# Per-scenario recovery time, scraped from /metrics and summed into the
+# bench --chaos headline (satellite of ISSUE 10: today's harness only
+# surfaced the worst recovery). Gauge, not histogram: each scenario runs
+# once per suite and the CURRENT value is the interesting one.
+_RECOVERY = telemetry.gauge(
+    "chaos_recovery_seconds",
+    "Recovery (or detection) seconds of the last run of each chaos "
+    "scenario.", ("scenario", "transport"))
+
 
 class _Holder:
     arena = None
@@ -78,6 +90,14 @@ class ChaosAvatar(Entity):
 
     def Ping_Client(self, n):
         self.call_client("Pong", n)
+
+    def on_client_disconnected(self):
+        # A detached chaos avatar has no re-attach path (its client either
+        # closed or died with a gate): despawn cleanly — AOI leaves fire
+        # to the survivors, the slab slot quarantines per contract, and
+        # the avatar census stays exact across gate kills.
+        if not self.is_destroyed():
+            self.destroy()
 
 
 class FlakyBackend:
@@ -159,6 +179,7 @@ class ChaosCluster:
         self._game_task: Optional[asyncio.Task] = None
         self._ping_seq = 0
         self._pongs: dict[str, list] = {}
+        self._bot_gen = 0
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -209,8 +230,21 @@ class ChaosCluster:
                          "cluster never became deployment-ready")
         em.create_space_locally(1)
         assert _Holder.arena is not None
+        # The gate's bound port survives restarts: a recreated GateService
+        # must come back on the SAME address or clients could never
+        # reconnect to a crashed gate in production either.
+        self.cfg.gates[1].port = self.gate.port
+        await self._spawn_bots()
+
+    async def _spawn_bots(self) -> None:
+        """Connect a fresh strict-bot fleet (initial boot, and the client
+        reconnect wave after a game or gate crash)."""
+        from goworld_tpu.entity import entity_manager as em
+
+        self._bot_gen += 1
+        gen = self._bot_gen
         for i in range(self.n_bots):
-            bot = ClientBot(name=f"chaosbot{i}", strict=True,
+            bot = ClientBot(name=f"chaosbot{gen}.{i}", strict=True,
                             heartbeat_interval=1.0)
             self._pongs[bot.name] = []
             bot.rpc_handlers[(None, "Pong")] = (
@@ -223,6 +257,11 @@ class ChaosCluster:
                         if e.typename == "ChaosAvatar"
                         and e.client is not None) == self.n_bots,
             15.0, "bots never all attached to avatars")
+
+    async def close_bots(self) -> None:
+        for b in self.bots:
+            await b.close()
+        self.bots.clear()
 
     async def stop(self) -> None:
         from goworld_tpu import kvdb, storage
@@ -330,6 +369,75 @@ class ChaosCluster:
     def resume_dispatcher(self, i: int) -> None:
         self.dispatchers[i].resume()
 
+    async def kill_game(self) -> None:
+        """Crash the game process-equivalent: RST its dispatcher links,
+        cancel its loop, and wipe the per-process entity world (the type
+        registry survives, exactly like a fresh interpreter re-importing
+        the same server module). Peers see a died game, not a shutdown."""
+        from goworld_tpu.entity import entity_manager as em
+
+        assert self.game is not None
+        for m in self.game.cluster._mgrs:
+            if m.proxy is not None:
+                m.proxy.conn.abort()
+        self._game_task.cancel()
+        try:
+            await self._game_task
+        except (asyncio.CancelledError, Exception):
+            pass
+        self.game = None
+        self._game_task = None
+        em.reset_world()
+        _Holder.arena = None
+        _Holder.joined = 0
+        gwlog.infof("chaos: game killed (world wiped, registry kept)")
+
+    async def restart_game(self) -> None:
+        """Cold-boot a replacement game with the same gameid (restore=False
+        — a crash left no freeze file; entities are gone, not frozen)."""
+        from goworld_tpu.entity import entity_manager as em
+
+        self.game = GameService(1, self.cfg, restore=False)
+        self._game_task = asyncio.get_running_loop().create_task(
+            self.game.run_async())
+        await self._wait(lambda: self.game.deployment_ready, 15.0,
+                         "recreated game never became ready")
+        em.create_space_locally(1)
+        assert _Holder.arena is not None
+        gwlog.infof("chaos: game recreated")
+
+    async def kill_gate(self) -> None:
+        """Crash the gate: RST every client socket and dispatcher link,
+        then drop the listeners. Clients see a dead server; the
+        dispatcher sees a vanished gate (reconnect-grace window starts)."""
+        assert self.gate is not None
+        for cp in list(self.gate.clients.values()):
+            cp.conn.conn.abort()
+        for m in self.gate.cluster._mgrs:
+            if m.proxy is not None:
+                m.proxy.conn.abort()
+        await self.gate.stop()
+        self.gate = None
+        gwlog.infof("chaos: gate killed")
+
+    async def restart_gate(self) -> None:
+        """A NEW gate process on the same port: its fresh handshake makes
+        the dispatchers detach the dead predecessor's client bindings on
+        every game before traffic flows."""
+        gate = GateService(1, self.cfg)
+        for _ in range(100):  # the old socket may linger briefly
+            try:
+                await gate.start()
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"chaos: could not rebind gate port "
+                f"{self.cfg.gates[1].port}")
+        self.gate = gate
+        gwlog.infof("chaos: gate restarted on port %d", gate.port)
+
 
 # --- scenarios ---------------------------------------------------------------
 
@@ -365,6 +473,7 @@ async def scenario_dispatcher_restart(
     assert not errors, f"bot errors during dispatcher restart: {errors[:5]}"
     assert drops == 0, f"{drops} packets dropped (ring overflow?)"
     assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    _RECOVERY.labels("dispatcher_restart", cluster.transport).set(recovery)
     return {"scenario": "dispatcher_restart", "recovery_s": round(recovery, 3),
             "post_roundtrip_s": round(rt, 3), "dropped": drops,
             "bot_errors": len(errors)}
@@ -388,6 +497,7 @@ async def scenario_severed_link(
     assert not errors, f"bot errors after severed link: {errors[:5]}"
     assert drops == 0, f"{drops} packets dropped after severed link"
     assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    _RECOVERY.labels("severed_link", cluster.transport).set(recovery)
     return {"scenario": "severed_link", "recovery_s": round(recovery, 3),
             "post_roundtrip_s": round(rt, 3), "dropped": drops,
             "bot_errors": len(errors)}
@@ -420,6 +530,7 @@ async def scenario_paused_dispatcher(
     errors = cluster.bot_errors()
     assert not errors, f"bot errors across paused dispatcher: {errors[:5]}"
     assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    _RECOVERY.labels("paused_dispatcher", cluster.transport).set(detected)
     return {"scenario": "paused_dispatcher",
             "detect_s": round(detected, 3),
             "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
@@ -474,17 +585,105 @@ async def scenario_storage_outage(
     missing = [i for i in range(n_saves)
                if flaky.inner.read("ChaosDoc", f"doc{i:03d}") is None]
     assert not missing, f"saves lost across the outage: {missing}"
+    _RECOVERY.labels("storage_outage", cluster.transport).set(recovery)
     return {"scenario": "storage_outage", "open_after_s": round(opened, 3),
             "recovery_s": round(recovery, 3),
-            "failed_writes": flaky.failed, "lost_saves": len(missing)}
+            "failed_writes": flaky.failed, "lost_saves": len(missing),
+            "bot_errors": len(cluster.bot_errors())}
+
+
+async def scenario_game_kill_recreate(
+    cluster: ChaosCluster, downtime: float = 0.3,
+    recovery_deadline: float = 20.0,
+) -> dict:
+    """Crash THE GAME under live bots, recreate it cold, and require a
+    consistent world afterwards: the dispatcher purges the dead
+    incarnation's entity routes at the cold-boot handshake (no RPC ever
+    routes at a ghost), clients reconnect and get fresh avatars, the
+    avatar census returns to exactly n_bots with full AOI interest, and
+    no bot sees a protocol inconsistency (strict mode)."""
+    await cluster.assert_rpc_roundtrip()
+    await cluster.kill_game()
+    await asyncio.sleep(downtime)
+    t0 = time.monotonic()
+    await cluster.restart_game()
+    # The dead incarnation's clients can't be re-attached (no boot flow
+    # re-runs for an existing connection) — clients reconnect, exactly as
+    # they would after a real server crash.
+    await cluster.close_bots()
+    await cluster._spawn_bots()
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never recovered after game recreate")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    recovery = time.monotonic() - t0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across game kill: {errors[:5]}"
+    assert cluster.live_avatars() == cluster.n_bots, (
+        f"avatar census wrong after recreate: {cluster.live_avatars()} "
+        f"!= {cluster.n_bots}")
+    # AOI consistency: the recreated arena re-derived full mutual
+    # interest (every avatar sees every other).
+    from goworld_tpu.entity import entity_manager as em
+
+    avs = [e for e in em.entities().values()
+           if e.typename == "ChaosAvatar"]
+    assert all(len(a.interested_by) == cluster.n_bots - 1 for a in avs), (
+        "AOI interest not re-derived after game recreate")
+    _RECOVERY.labels("game_kill_recreate", cluster.transport).set(recovery)
+    return {"scenario": "game_kill_recreate",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
+
+
+async def scenario_gate_kill_reconnect(
+    cluster: ChaosCluster, downtime: float = 0.3,
+    recovery_deadline: float = 20.0,
+) -> dict:
+    """Crash THE GATE under strict bots: every client socket dies. A NEW
+    gate process takes the port; its fresh handshake makes the
+    dispatchers detach the dead incarnation's client bindings on the game
+    (orphaned avatars despawn cleanly, with AOI leaves), clients
+    reconnect and get fresh avatars, and no record ever misroutes across
+    clients (strict bots would flag a sync/RPC for an entity they never
+    saw)."""
+    await cluster.assert_rpc_roundtrip()
+    await cluster.kill_gate()
+    # Client sockets are dead: drop the bot objects (their recv loops
+    # already exited) before anything reconnects.
+    await cluster.close_bots()
+    await asyncio.sleep(downtime)
+    t0 = time.monotonic()
+    await cluster.restart_gate()
+    await cluster._spawn_bots()
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never recovered after gate restart")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    # The dead incarnation's avatars must despawn (detach → destroy), the
+    # new fleet's census must be exact.
+    await cluster._wait(
+        lambda: cluster.live_avatars() == cluster.n_bots,
+        recovery_deadline,
+        f"orphaned avatars never despawned "
+        f"(census {cluster.live_avatars()} != {cluster.n_bots})")
+    recovery = time.monotonic() - t0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across gate kill: {errors[:5]}"
+    _RECOVERY.labels("gate_kill_reconnect", cluster.transport).set(recovery)
+    return {"scenario": "gate_kill_reconnect",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
 
 
 def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
               transport: str = "tcp") -> dict:
-    """Run the full scenario suite over one cluster (``bench.py --chaos``;
+    """Run the single-cluster scenario suite (``bench.py --chaos``;
     ``transport`` = "tcp" or "uds" — the fault semantics must be
     transport-identical and every scenario asserts its own invariants
-    either way). Returns a JSON-able summary; raises on any violation."""
+    either way). Returns a JSON-able summary with per-scenario recovery
+    times and bot-error counts; a scenario failure is CAPTURED (named in
+    ``failures``) and aborts the remaining scenarios on this cluster —
+    the caller decides the exit code, so one red scenario can never hide
+    the others' numbers."""
 
     async def _run() -> dict:
         cluster = ChaosCluster(
@@ -495,19 +694,36 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
                 circuit_failure_threshold=3, circuit_cooldown=0.3,
             ))
         await cluster.start()
+        results: list[dict] = []
+        failures: list[dict] = []
+        scenario_fns = (
+            scenario_dispatcher_restart,
+            scenario_severed_link,
+            scenario_paused_dispatcher,
+            scenario_storage_outage,
+            scenario_game_kill_recreate,
+            scenario_gate_kill_reconnect,
+        )
         try:
-            results = [
-                await scenario_dispatcher_restart(cluster),
-                await scenario_severed_link(cluster),
-                await scenario_paused_dispatcher(cluster),
-                await scenario_storage_outage(cluster),
-            ]
+            for fn in scenario_fns:
+                name = fn.__name__.removeprefix("scenario_")
+                try:
+                    results.append(await fn(cluster))
+                except Exception as exc:  # captured, not swallowed
+                    gwlog.trace_error("chaos: scenario %s failed", name)
+                    failures.append({
+                        "scenario": name,
+                        "error": f"{type(exc).__name__}: {exc}",
+                        "bot_errors": len(cluster.bot_errors()),
+                    })
+                    break  # cluster state is suspect; stop this transport
         finally:
             await cluster.stop()
         return {
             "scenarios": results,
+            "failures": failures,
             "passed": len(results),
-            "bot_errors": 0,
+            "bot_errors": sum(r.get("bot_errors", 0) for r in results),
             "dispatchers": n_dispatchers,
             "bots": n_bots,
             "transport": transport,

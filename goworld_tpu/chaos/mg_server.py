@@ -1,0 +1,76 @@
+"""Server module of the multigame harness's CHILD game processes.
+
+Registered by ``chaos/game_proc.py`` (the ``python -m`` entry each child
+runs) and imported by the parent only for the class names. The world is
+deliberately minimal but real: every game creates one kind-1 AOI arena at
+deployment-ready, boot avatars join their LOCAL arena (game2 is
+boot-banned, so the initial placement is fully skewed onto game1 — the
+shape the rebalancer must fix), and avatars answer Ping→Pong for the
+harness's zero-loss roundtrip probes.
+"""
+
+from __future__ import annotations
+
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+
+ARENA_KIND = 1
+AOI_DISTANCE = 100.0
+
+
+def local_arena():
+    for s in em._spaces.values():
+        if s.kind == ARENA_KIND and not s.is_destroyed():
+            return s
+    return None
+
+
+class MGSpace(Space):
+    def on_space_created(self):
+        if self.kind == ARENA_KIND:
+            self.enable_aoi(AOI_DISTANCE)
+
+    def on_game_ready(self):
+        # Runs on the nil space at deployment-ready: every game hosts one
+        # arena, so the rebalancer always has a same-kind receiver space.
+        if self.is_nil() and local_arena() is None:
+            em.create_space_locally(ARENA_KIND)
+
+
+class MGAvatar(Entity):
+    """Boot avatar: joins the local arena, echoes Ping→Pong, lets its
+    client drive position (the sync plane the migrate window buffers)."""
+
+    _joined = 0
+
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True, AOI_DISTANCE)
+
+    def on_client_connected(self):
+        self.set_client_syncing(True)
+        self._join_arena()
+
+    def _join_arena(self):
+        if self.is_destroyed() or self.client is None:
+            return
+        arena = local_arena()
+        if arena is None:
+            # Boot raced deployment-ready; the arena appears momentarily.
+            self.add_callback(0.1, "_join_arena")
+            return
+        if self.space is arena:
+            return
+        x = 2.0 * (MGAvatar._joined % 40)
+        MGAvatar._joined += 1
+        self.enter_space(arena.id, Vector3(x, 0.0, 10.0))
+
+    def Ping_Client(self, n):
+        self.call_client("Pong", n)
+
+
+def register() -> None:
+    em.register_space(MGSpace)
+    em.register_entity(MGAvatar)

@@ -1,0 +1,545 @@
+"""Multigame harness: 2 REAL game processes + in-parent dispatchers/gate.
+
+The entity manager is per-process state, so a genuine multi-game world
+needs real game processes: this harness spawns two ``chaos/game_proc.py``
+children against dispatchers, a gate, and strict bots living in the
+PARENT process — which is exactly what makes it measurable: the parent
+holds the dispatcher objects, so the rebalancer's report table, the
+migration counters, and the planner state are directly observable with no
+scraping.
+
+Two entry points, both used by bench.py:
+
+- ``run_multigame`` (the ``--multigame`` floor): boot with a deliberately
+  fully skewed placement (game2 is boot-banned, every avatar lands in
+  game1's arena), resume the planner at t0, and measure rebalance
+  convergence — time until the arena populations are balanced and stable
+  with zero entity loss and zero strict-bot errors — then run the
+  migrate-during-dispatcher-restart chaos phase on the same cluster.
+- ``scenario_migrate_during_dispatcher_restart`` (the 7th chaos
+  scenario): kill a dispatcher while commanded migrations are mid-window;
+  every migration must complete (possibly after the replay-ring flush) or
+  roll back, with the avatar census conserved and every bot answering.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from goworld_tpu.client import ClientBot
+from goworld_tpu.common import hash_entity_id
+from goworld_tpu.config.read_config import (
+    ClusterConfig,
+    DeploymentConfig,
+    DispatcherConfig,
+    GateConfig,
+    GoWorldConfig,
+    RebalanceConfig,
+)
+from goworld_tpu.dispatcher import DispatcherService
+from goworld_tpu.gate import GateService
+from goworld_tpu.netutil.packet import Packet
+from goworld_tpu.proto.msgtypes import MsgType
+from goworld_tpu.utils import gwlog
+
+ARENA_KIND = 1
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_INI = """\
+[deployment]
+dispatchers = {n_disp}
+games = 2
+gates = 1
+
+{dispatcher_sections}
+[game_common]
+save_interval = 0
+position_sync_interval = 0.05
+log_level = info
+
+[game1]
+boot_entity = MGAvatar
+log_file = game1.log
+
+[game2]
+log_file = game2.log
+
+[gate1]
+port = {gate_port}
+
+[storage]
+type = filesystem
+directory = {dir}/es
+
+[kvdb]
+type = filesystem
+directory = {dir}/kv
+
+[aoi]
+backend = xzlist
+
+[cluster]
+peer_heartbeat_timeout = {hb}
+reconnect_max_interval = 1.0
+transport = {transport}
+uds_dir = {uds_dir}
+
+[rebalance]
+enabled = true
+driver_dispatcher = 1
+interval = {interval}
+report_interval = {report_interval}
+stale_after = {stale_after}
+min_entity_delta = {min_delta}
+max_moves_per_round = {max_moves}
+migrate_timeout = {migrate_timeout}
+cooldown = {cooldown}
+"""
+
+
+
+
+class MultigameCluster:
+    """2 game subprocesses × N spaces, dispatchers + gate + bots in-parent."""
+
+    def __init__(self, run_dir: str, n_bots: int = 12,
+                 n_dispatchers: int = 2, transport: str = "tcp") -> None:
+        self.run_dir = run_dir
+        self.n_bots = n_bots
+        self.n_dispatchers = n_dispatchers
+        self.transport = transport
+        self.rebalance_cfg = RebalanceConfig(
+            enabled=True, driver_dispatcher=1, interval=0.5,
+            report_interval=0.25, stale_after=3.0, min_entity_delta=4,
+            max_moves_per_round=4, migrate_timeout=4.0, cooldown=2.0)
+        # 3 s, not the chaos harness's 1 s: the children are real
+        # processes competing for the same (often 1-core) host — a busy
+        # box legitimately deschedules a child past 1 s, and a flapping
+        # link mid-boot turns a timing artifact into a spurious restart.
+        self.peer_heartbeat_timeout = 3.0
+        self.dispatchers: list[Optional[DispatcherService]] = []
+        # Every dispatcher object ever started (dead ones included): the
+        # migration counters are summed over OBJECTS, because a stopped
+        # service unregisters its telemetry children and family sums would
+        # go backwards across a restart.
+        self._all_dispatchers: list[DispatcherService] = []
+        self.ports: list[int] = []
+        self.gate: Optional[GateService] = None
+        self.games: list[Optional[subprocess.Popen]] = []
+        self.bots: list[ClientBot] = []
+        self._sync_tasks: list[asyncio.Task] = []
+        self._ping_seq = 0
+        self._pongs: dict[str, list] = {}
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def start(self, boot_deadline: float = 60.0) -> None:
+        uds_dir = self.run_dir if self.transport == "uds" else None
+        for i in range(self.n_dispatchers):
+            d = DispatcherService(
+                i + 1, desired_games=2, desired_gates=1,
+                peer_heartbeat_timeout=self.peer_heartbeat_timeout,
+                rebalance=self.rebalance_cfg)
+            d.rebalance_pause()  # resumed at the measured t0
+            await d.start(uds_dir=uds_dir)
+            self.dispatchers.append(d)
+            self._all_dispatchers.append(d)
+            self.ports.append(d.port)
+
+        cfg = GoWorldConfig()
+        cfg.deployment = DeploymentConfig(
+            desired_games=2, desired_gates=1,
+            desired_dispatchers=self.n_dispatchers)
+        cfg.dispatchers = {
+            i + 1: DispatcherConfig(port=p)
+            for i, p in enumerate(self.ports)}
+        cfg.gates = {1: GateConfig(
+            port=0, position_sync_interval=0.05, heartbeat_timeout=30.0)}
+        cfg.cluster = ClusterConfig(
+            peer_heartbeat_timeout=self.peer_heartbeat_timeout,
+            reconnect_max_interval=1.0,
+            transport=self.transport,
+            uds_dir=self.run_dir if self.transport == "uds" else "")
+        cfg.rebalance = self.rebalance_cfg
+        self.cfg = cfg
+        self.gate = GateService(1, cfg)
+        await self.gate.start()
+
+        rb = self.rebalance_cfg
+        ini = _INI.format(
+            n_disp=self.n_dispatchers,
+            dispatcher_sections="".join(
+                f"[dispatcher{i + 1}]\nport = {p}\n\n"
+                for i, p in enumerate(self.ports)),
+            gate_port=self.gate.port, dir=self.run_dir,
+            transport=self.transport,
+            uds_dir=self.run_dir if self.transport == "uds" else "",
+            hb=self.peer_heartbeat_timeout,
+            interval=rb.interval, report_interval=rb.report_interval,
+            stale_after=rb.stale_after, min_delta=rb.min_entity_delta,
+            max_moves=rb.max_moves_per_round,
+            migrate_timeout=rb.migrate_timeout, cooldown=rb.cooldown)
+        ini_path = os.path.join(self.run_dir, "goworld.ini")
+        with open(ini_path, "w", encoding="utf-8") as f:
+            f.write(ini)
+
+        env = dict(os.environ, PYTHONPATH=_REPO, JAX_PLATFORMS="cpu")
+        for gid in (1, 2):
+            logf = open(os.path.join(self.run_dir, f"game{gid}.out.log"),
+                        "ab")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "goworld_tpu.chaos.game_proc",
+                 "-gid", str(gid), "-configfile", ini_path],
+                cwd=self.run_dir, env=env, stdout=logf,
+                stderr=subprocess.STDOUT)
+            logf.close()
+            self.games.append(proc)
+
+        await self._wait(
+            lambda: all(
+                sum(1 for gi in d.games.values() if gi.connected) == 2
+                for d in self.dispatchers if d is not None)
+            and self.dispatchers[0].deployment_ready,
+            boot_deadline, "game processes never all connected",
+            on_fail=self._game_log_tails)
+        # Both games must have reported (arena ids come from the reports).
+        await self._wait(
+            lambda: len(self._planner().reports.games()) == 2
+            and all(self._arena(g) is not None for g in (1, 2)),
+            boot_deadline, "games never reported their arenas")
+
+        for i in range(self.n_bots):
+            bot = ClientBot(name=f"mgbot{i}", strict=True,
+                            heartbeat_interval=1.0)
+            self._pongs[bot.name] = []
+            bot.rpc_handlers[(None, "Pong")] = (
+                lambda entity, n, name=bot.name: self._pongs[name].append(n))
+            await bot.connect("127.0.0.1", self.gate.port)
+            await bot.wait_player(timeout=15)
+            self.bots.append(bot)
+            self._sync_tasks.append(
+                asyncio.get_running_loop().create_task(self._sync_loop(bot)))
+        # Skew barrier: every avatar sits in game1's arena (game2 is
+        # boot-banned), visible through the load reports.
+        await self._wait(
+            lambda: self._arena_pop(1) == self.n_bots,
+            30.0, "avatars never all collected in game1's arena")
+
+    async def stop(self) -> None:
+        for t in self._sync_tasks:
+            t.cancel()
+        self._sync_tasks.clear()
+        for b in self.bots:
+            await b.close()
+        self.bots.clear()
+        if self.gate is not None:
+            await self.gate.stop()
+            self.gate = None
+        for proc in self.games:
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+        deadline = time.monotonic() + 10.0
+        for proc in self.games:
+            if proc is None:
+                continue
+            while proc.poll() is None and time.monotonic() < deadline:
+                await asyncio.sleep(0.05)
+            if proc.poll() is None:
+                proc.kill()
+        self.games.clear()
+        for d in self.dispatchers:
+            if d is not None:
+                await d.stop()
+        self.dispatchers.clear()
+
+    def _game_log_tails(self) -> str:
+        tails = []
+        for gid in (1, 2):
+            try:
+                with open(os.path.join(self.run_dir,
+                                       f"game{gid}.out.log"), "rb") as f:
+                    data = f.read()[-800:]
+                tails.append(f"game{gid}: ...{data.decode(errors='replace')}")
+            except OSError:
+                pass
+        return "\n".join(tails)
+
+    async def _wait(self, cond, timeout: float, what: str,
+                    on_fail=None) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if cond():
+                return
+            await asyncio.sleep(0.02)
+        extra = f"\n{on_fail()}" if on_fail is not None else ""
+        raise AssertionError(f"multigame: {what} (after {timeout:.1f}s)"
+                             f"{extra}")
+
+    async def _sync_loop(self, bot: ClientBot) -> None:
+        """Light client-driven position jitter (the sync plane the migrate
+        window must buffer — records sent mid-migrate must land on the
+        entity's NEW game, never a stale one)."""
+        import random
+
+        while True:
+            await asyncio.sleep(0.1)
+            p = bot.player
+            if p is not None:
+                p.sync_position(p.x + random.uniform(-0.5, 0.5), p.y,
+                                p.z + random.uniform(-0.5, 0.5), p.yaw)
+
+    # --- observability -------------------------------------------------------
+
+    def _planner(self):
+        for d in self.dispatchers:
+            if d is not None:
+                return d.planner
+        raise AssertionError("no live dispatcher")
+
+    def _report(self, gameid: int) -> dict | None:
+        return self._planner().reports.get(gameid)
+
+    def _arena(self, gameid: int):
+        r = self._report(gameid)
+        if r is None:
+            return None
+        for sid, kind, _count in r.get("spaces", []):
+            if kind == ARENA_KIND:
+                return sid
+        return None
+
+    def _arena_pop(self, gameid: int) -> int:
+        r = self._report(gameid) or {}
+        for _sid, kind, count in r.get("spaces", []):
+            if kind == ARENA_KIND:
+                return int(count)
+        return 0
+
+    def census(self) -> tuple[int, int]:
+        return self._arena_pop(1), self._arena_pop(2)
+
+    def _mig_counters(self) -> dict[str, int]:
+        return {
+            "routed": sum(d.migrates_routed for d in self._all_dispatchers),
+            "bounced": sum(d.migrates_bounced
+                           for d in self._all_dispatchers),
+            "cancel": sum(d.migrates_cancelled
+                          for d in self._all_dispatchers),
+        }
+
+    def bot_errors(self) -> list[str]:
+        return [err for b in self.bots for err in b.errors]
+
+    async def assert_rpc_roundtrip(self, deadline: float = 15.0) -> float:
+        """Every bot pings its avatar (wherever it now lives) and must get
+        its pong — the client-visible zero-loss probe."""
+        self._ping_seq += 1
+        n = self._ping_seq
+        t0 = time.monotonic()
+        for b in self.bots:
+            assert b.player is not None, f"{b.name}: player mirror lost"
+            b.player.call_server("Ping_Client", n)
+        await self._wait(
+            lambda: all(n in self._pongs[b.name] for b in self.bots),
+            deadline, f"ping {n}: not every bot got its pong")
+        return time.monotonic() - t0
+
+    # --- phases --------------------------------------------------------------
+
+    async def converge(self, deadline: float = 30.0) -> dict:
+        """Resume the planner at t0; wait until the arena populations are
+        balanced AND stable (two consecutive report snapshots agree and
+        the full census is conserved — in-flight migrations make the sum
+        dip, so a conserved sum means nothing is mid-air)."""
+        mig0 = self._mig_counters()
+        tol = self.rebalance_cfg.min_entity_delta
+        t0 = time.monotonic()
+        for d in self.dispatchers:
+            if d is not None:
+                d.rebalance_resume()
+        # Stability must SPAN report cycles (the census is read from the
+        # cached reports): balanced and unchanged for 3 report intervals,
+        # with the sum conserved (an in-flight migration makes it dip).
+        span = 3.0 * self.rebalance_cfg.report_interval
+        state = {"census": None, "since": 0.0}
+
+        def balanced() -> bool:
+            c = self.census()
+            now = time.monotonic()
+            if c != state["census"]:
+                state["census"], state["since"] = c, now
+            return (sum(c) == self.n_bots
+                    and abs(c[0] - c[1]) <= tol
+                    and now - state["since"] >= span)
+
+        await self._wait(
+            balanced, deadline, "never converged",
+            on_fail=lambda: (
+                f"census {self.census()}, reports "
+                f"{ {g: self._report(g) for g in (1, 2)} }\n"
+                + self._game_log_tails()))
+        convergence_s = time.monotonic() - t0
+        rt = await self.assert_rpc_roundtrip()
+        mig1 = self._mig_counters()
+        return {
+            "convergence_s": round(convergence_s, 3),
+            "census": list(self.census()),
+            "migrations_done": int(mig1["routed"] - mig0["routed"]),
+            "migrations_rolled_back": int(
+                (mig1["cancel"] - mig0["cancel"])
+                + (mig1["bounced"] - mig0["bounced"])),
+            "post_roundtrip_s": round(rt, 3),
+            "zero_loss": sum(self.census()) == self.n_bots,
+            "bot_errors": len(self.bot_errors()),
+        }
+
+    async def migrate_during_dispatcher_restart(
+        self, moves: int = 4, downtime: float = 1.0,
+        deadline: float = 25.0,
+    ) -> dict:
+        """THE ROADMAP-named scenario: command a batch of migrations, kill
+        a dispatcher inside the migrate window (before yielding to the
+        event loop, so nothing has completed yet), restart it, and require
+        every migration to complete (possibly via the replay-ring flush)
+        or roll back — census conserved, every bot answering."""
+        for d in self.dispatchers:
+            if d is not None:
+                d.rebalance_pause()
+        donor = 1 if self._arena_pop(1) >= self._arena_pop(2) else 2
+        recv = 2 if donor == 1 else 1
+        from_space, to_space = self._arena(donor), self._arena(recv)
+        assert from_space and to_space, "arenas unknown"
+        mig0 = self._mig_counters()
+        census0 = self.census()
+        # The migrate chain fans over dispatchers by id hash: the space
+        # query rides hash(to_space)'s dispatcher, the per-entity blocks
+        # ride hash(eid)'s. Kill the one NOT owning the space query so
+        # queries still flow and ~half the entities' MIGRATE_REQUESTs are
+        # mid-air when the link dies (they park in the games' replay
+        # rings and must resolve after the restart).
+        owner_idx = hash_entity_id(to_space) % self.n_dispatchers
+        victim = (owner_idx + 1) % self.n_dispatchers
+        # The command itself must ride a SURVIVING dispatcher's game link
+        # (sending it through the victim would abort it in the socket
+        # buffer and nothing would ever be mid-air).
+        commander = self.dispatchers[owner_idx]
+        p = Packet()
+        p.append_entity_id(from_space)
+        p.append_entity_id(to_space)
+        p.append_uint16(recv)
+        p.append_uint16(moves)
+        now = time.monotonic()
+        commander._game(donor).dispatch(MsgType.REBALANCE_MIGRATE, p, now)
+        # Same event-loop turn: the command is in the socket buffer but no
+        # ack has come back — the kill lands inside the migrate window.
+        d = self.dispatchers[victim]
+        for proxy in list(d._conns):
+            proxy.conn.abort()
+        await d.stop()
+        self.dispatchers[victim] = None
+        gwlog.infof("multigame: dispatcher %d killed mid-migrate",
+                    victim + 1)
+        await asyncio.sleep(downtime)
+        t0 = time.monotonic()
+        nd = DispatcherService(
+            victim + 1, desired_games=2, desired_gates=1,
+            peer_heartbeat_timeout=self.peer_heartbeat_timeout,
+            rebalance=self.rebalance_cfg)
+        nd.rebalance_pause()
+        self._all_dispatchers.append(nd)
+        for _ in range(100):
+            try:
+                await nd.start(
+                    port=self.ports[victim],
+                    uds_dir=(self.run_dir if self.transport == "uds"
+                             else None))
+                break
+            except OSError:
+                await asyncio.sleep(0.05)
+        else:
+            raise AssertionError("could not rebind dispatcher port")
+        self.dispatchers[victim] = nd
+
+        # Settled = census conserved and unchanged for 3 report intervals
+        # (an in-flight migration makes the sum dip; a just-landing one
+        # changes the split).
+        span = 3.0 * self.rebalance_cfg.report_interval
+        state = {"census": None, "since": 0.0}
+
+        def settled() -> bool:
+            c = self.census()
+            t = time.monotonic()
+            if c != state["census"]:
+                state["census"], state["since"] = c, t
+            return sum(c) == self.n_bots and t - state["since"] >= span
+
+        def diag() -> str:
+            lines = [f"reports: { {g: self._report(g) for g in (1, 2)} }"]
+            for i, d in enumerate(self.dispatchers):
+                if d is None:
+                    lines.append(f"dispatcher[{i}]: None")
+                    continue
+                lines.append(
+                    f"dispatcher[{i}] id={d.dispid} games="
+                    f"{ {g: gi.connected for g, gi in d.games.items()} } "
+                    f"planner_games={d.planner.reports.games()}")
+            lines.append(self._game_log_tails())
+            return "\n".join(lines)
+        await self._wait(settled, deadline,
+                         f"census never settled (is {self.census()})",
+                         on_fail=diag)
+        rt = await self.assert_rpc_roundtrip(deadline)
+        recovery = time.monotonic() - t0
+        mig1 = self._mig_counters()
+        errors = self.bot_errors()
+        assert not errors, f"bot errors during migrate+restart: {errors[:5]}"
+        done = int(mig1["routed"] - mig0["routed"])
+        rolled = int((mig1["cancel"] - mig0["cancel"])
+                     + (mig1["bounced"] - mig0["bounced"]))
+        return {
+            "scenario": "migrate_during_dispatcher_restart",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3),
+            "census_before": list(census0),
+            "census_after": list(self.census()),
+            "migrations_done": done,
+            "migrations_rolled_back": rolled,
+            "commanded": moves,
+            "zero_loss": sum(self.census()) == self.n_bots,
+            "bot_errors": len(errors),
+        }
+
+
+async def _run_multigame(run_dir: str, n_bots: int, transport: str,
+                         with_restart_phase: bool) -> dict:
+    cluster = MultigameCluster(run_dir, n_bots=n_bots, transport=transport)
+    # start() INSIDE the try: a boot failure must still tear the cluster
+    # down — its game children are real OS processes, and two leaked
+    # games silently eating a 1-core host skew every measurement that
+    # follows (found the hard way: a failed boot leaked children that
+    # depressed the pinned floor a full tier-1 run later).
+    try:
+        await cluster.start()
+        out = await cluster.converge()
+        out["skew_initial"] = [n_bots, 0]
+        if with_restart_phase:
+            out["dispatcher_restart_phase"] = (
+                await cluster.migrate_during_dispatcher_restart())
+        out["bot_errors"] = len(cluster.bot_errors())
+        assert not cluster.bot_errors(), cluster.bot_errors()[:5]
+    finally:
+        await cluster.stop()
+    return out
+
+
+def run_multigame(run_dir: str, n_bots: int = 12, transport: str = "tcp",
+                  with_restart_phase: bool = True) -> dict:
+    """Blocking driver (bench.py --multigame / the 7th chaos scenario)."""
+    return asyncio.run(
+        _run_multigame(run_dir, n_bots, transport, with_restart_phase))

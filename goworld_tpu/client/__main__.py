@@ -47,10 +47,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="offset bot indices (stress_<i> identities) so "
                          "CONCURRENT fleets against one cluster don't "
                          "fight over the same avatars")
-    ap.add_argument("-timeout", type=float, default=5.0,
+    ap.add_argument("-timeout", type=float, default=None,
                     help="per-scenario completion budget in seconds "
                          "(retries happen within it); large fleets on "
-                         "loaded hosts need more than the reference's 5")
+                         "loaded hosts need more than the reference's 5. "
+                         "Default: [client] rpc_timeout from the ini "
+                         "(5.0 when unset) — widen the config instead of "
+                         "eating a strict-mode flake on slow rigs")
     args = ap.parse_args(argv)
     # Normalize + fail fast (same rules as the gate-side config): a bad
     # spec must die here as a usage error, not as N per-bot ValueErrors
@@ -62,6 +65,19 @@ def main(argv: list[str] | None = None) -> int:
         parse_fec(args.rudp_fec)
     except ValueError as exc:
         ap.error(str(exc))
+
+    if args.timeout is None:
+        # [client] rpc_timeout: the strict-bot budget is deployment
+        # config, not a constant — a rig whose reload window exceeds 5 s
+        # widens it HERE honestly instead of eating a strict flake.
+        args.timeout = 5.0
+        import os
+
+        if os.path.exists(args.configfile):
+            from goworld_tpu.config import read_config
+
+            read_config.set_config_file(args.configfile)
+            args.timeout = read_config.get().client.rpc_timeout
 
     gates: list[tuple[str, int]] = []
     for spec in args.gate:

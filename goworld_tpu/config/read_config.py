@@ -233,6 +233,51 @@ class EntityConfig:
 
 
 @dataclasses.dataclass
+class RebalanceConfig:
+    """Telemetry-driven live rebalancer knobs (``[rebalance]``;
+    rebalance/planner.py + rebalance/migrator.py — no reference analog:
+    GoWorld's LBC heap only places NEW entities; this moves LIVE ones)."""
+
+    # Master switch: when off, dispatchers collect load reports (the LBC
+    # heap still uses them) but never plan migrations.
+    enabled: bool = False
+    # Which dispatcher runs the planner (exactly one must drive, and
+    # dispatchers do not talk to each other; every dispatcher receives the
+    # same load reports, so any id works — pick one).
+    driver_dispatcher: int = 1
+    # Seconds between planning rounds.
+    interval: float = 1.0
+    # Seconds between per-game load reports (game-side send cadence).
+    report_interval: float = 1.0
+    # Pause planning when any connected game's report is older than this
+    # (stale telemetry must pause the rebalancer, never steer it).
+    stale_after: float = 3.0
+    # Hysteresis: plan moves only while donor.entities - receiver.entities
+    # is at least this (prevents thrash around the balanced point).
+    min_entity_delta: int = 4
+    # Cap on entities moved per planning round (convergence is staged so a
+    # plan never outruns the load reports that justify it).
+    max_moves_per_round: int = 4
+    # Game-side deadline per migration: past it the migrator cancels
+    # (CANCEL_MIGRATE) and the entity stays where it was (rolled back).
+    migrate_timeout: float = 5.0
+    # Seconds a just-moved (or just-rolled-back) entity is exempt from
+    # re-selection; doubles per consecutive rollback of the same entity.
+    cooldown: float = 5.0
+
+
+@dataclasses.dataclass
+class ClientConfig:
+    """Client/bot-side knobs (``[client]``)."""
+
+    # Strict-bot per-RPC completion budget in seconds (bot_runner.py; the
+    # reference hardcodes 5 s, ClientEntity.go:160-242). Reload windows on
+    # slow rigs can legitimately exceed 5 s — widen this honestly instead
+    # of eating a strict-mode flake.
+    rpc_timeout: float = 5.0
+
+
+@dataclasses.dataclass
 class TelemetryConfig:
     """Distributed-tracing / flight-recorder knobs (``[telemetry]``;
     defaults mirror consts.py — telemetry/tracing.py)."""
@@ -275,6 +320,8 @@ class GoWorldConfig:
     aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
     entity: EntityConfig = dataclasses.field(default_factory=EntityConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    rebalance: RebalanceConfig = dataclasses.field(default_factory=RebalanceConfig)
+    client: ClientConfig = dataclasses.field(default_factory=ClientConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
@@ -456,6 +503,23 @@ def _load(path: Optional[str]) -> GoWorldConfig:
         cfg.entity = EntityConfig(
             slab_initial=int(cp["entity"].get("slab_initial", 256)),
         )
+    if cp.has_section("rebalance"):
+        s = cp["rebalance"]
+        cfg.rebalance = RebalanceConfig(
+            enabled=s.get("enabled", "false").lower() in ("1", "true", "yes"),
+            driver_dispatcher=int(s.get("driver_dispatcher", 1)),
+            interval=float(s.get("interval", 1.0)),
+            report_interval=float(s.get("report_interval", 1.0)),
+            stale_after=float(s.get("stale_after", 3.0)),
+            min_entity_delta=int(s.get("min_entity_delta", 4)),
+            max_moves_per_round=int(s.get("max_moves_per_round", 4)),
+            migrate_timeout=float(s.get("migrate_timeout", 5.0)),
+            cooldown=float(s.get("cooldown", 5.0)),
+        )
+    if cp.has_section("client"):
+        cfg.client = ClientConfig(
+            rpc_timeout=float(cp["client"].get("rpc_timeout", 5.0)),
+        )
     if cp.has_section("telemetry"):
         s = cp["telemetry"]
         cfg.telemetry = TelemetryConfig(
@@ -626,6 +690,35 @@ def _validate(cfg: GoWorldConfig) -> None:
     if cl.sync_flush_bytes < 0:
         raise ValueError(
             "[cluster] sync_flush_bytes must be >= 0 (0 = tick-only flush)")
+    rb = cfg.rebalance
+    if rb.driver_dispatcher < 1:
+        raise ValueError("[rebalance] driver_dispatcher must be >= 1")
+    if rb.enabled and rb.driver_dispatcher not in cfg.dispatchers \
+            and cfg.dispatchers:
+        # A driver id naming no configured dispatcher means NO dispatcher
+        # ever plans — the operator believes rebalancing is on while it is
+        # silently dead. Fail loudly.
+        raise ValueError(
+            f"[rebalance] driver_dispatcher = {rb.driver_dispatcher} names "
+            f"no configured dispatcher (have {sorted(cfg.dispatchers)})")
+    if rb.interval <= 0 or rb.report_interval <= 0:
+        raise ValueError(
+            "[rebalance] interval and report_interval must be > 0 seconds")
+    if rb.stale_after < rb.report_interval:
+        # A staleness window shorter than the report cadence pauses the
+        # planner permanently between perfectly healthy reports.
+        raise ValueError(
+            "[rebalance] stale_after must be >= report_interval")
+    if rb.min_entity_delta < 1:
+        raise ValueError("[rebalance] min_entity_delta must be >= 1")
+    if rb.max_moves_per_round < 1:
+        raise ValueError("[rebalance] max_moves_per_round must be >= 1")
+    if rb.migrate_timeout <= 0:
+        raise ValueError("[rebalance] migrate_timeout must be > 0 seconds")
+    if rb.cooldown < 0:
+        raise ValueError("[rebalance] cooldown must be >= 0 seconds")
+    if cfg.client.rpc_timeout <= 0:
+        raise ValueError("[client] rpc_timeout must be > 0 seconds")
     t = cfg.telemetry
     if t.trace_sample_rate < 0:
         raise ValueError(

@@ -52,6 +52,7 @@ def run(dispid: int | None = None) -> int:
             desired_gates=cfg.deployment.desired_gates,
             peer_heartbeat_timeout=cfg.cluster.peer_heartbeat_timeout,
             sync_flush_bytes=cfg.cluster.sync_flush_bytes,
+            rebalance=cfg.rebalance,
         )
         host, port = (disp_cfg.host, disp_cfg.port) if disp_cfg else ("127.0.0.1", 0)
         # [cluster] transport = uds: serve a Unix-domain listener beside

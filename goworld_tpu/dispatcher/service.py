@@ -24,6 +24,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import os
+import struct
 import time
 from typing import Deque, Optional
 
@@ -58,6 +59,15 @@ _HOP_SECONDS = telemetry.counter(
     "game_send|dispatcher_route|gate_demux|client_write).",
     ("hop",))
 _HOP_ROUTE = _HOP_SECONDS.labels("dispatcher_route")
+# Migration routing events at the dispatcher seam: routed = REAL_MIGRATE
+# forwarded to its target game, bounced = target game dead so the payload
+# went HOME to the source game instead of dropping (the zero-loss clause),
+# cancel = CANCEL_MIGRATE unblocked an entity's stream. The multigame
+# bench reads these for its done/rolled-back headline.
+_MIGRATE_EVENTS = telemetry.counter(
+    "dispatcher_migrates_total",
+    "Migration routing events (routed|bounced|cancel) per dispatcher.",
+    ("dispid", "kind"))
 
 
 class _EntityDispatchInfo:
@@ -165,7 +175,8 @@ class DispatcherService:
 
     def __init__(self, dispid: int, desired_games: int = 1, desired_gates: int = 1,
                  peer_heartbeat_timeout: Optional[float] = None,
-                 sync_flush_bytes: Optional[int] = None) -> None:
+                 sync_flush_bytes: Optional[int] = None,
+                 rebalance=None) -> None:
         self.dispid = dispid
         self.desired_games = desired_games
         self.desired_gates = desired_gates
@@ -190,6 +201,10 @@ class DispatcherService:
         # Gives a gate's ring replay racing the game's re-handshake into a
         # restarted dispatcher a grace window instead of a drop.
         self._unrouted: dict[str, float] = {}
+        # Boot requests that arrived while NO boot-capable game had a live
+        # link (flap / rolling restart): retried each tick until the grace
+        # window lapses.
+        self._pending_boots: list[tuple[Packet, float]] = []
         self.kvreg: dict[str, str] = {}
         self.deployment_ready = False
         self._boot_rr = 0
@@ -222,6 +237,29 @@ class DispatcherService:
         d = str(dispid)
         self._sync_records_up = _SYNC_RECORDS.labels(d, "up")
         self._sync_records_down = _SYNC_RECORDS.labels(d, "down")
+        self._mig_routed = _MIGRATE_EVENTS.labels(d, "routed")
+        self._mig_bounced = _MIGRATE_EVENTS.labels(d, "bounced")
+        self._mig_cancel = _MIGRATE_EVENTS.labels(d, "cancel")
+        # Plain mirrors of the counters above: harnesses sum these across
+        # dispatcher OBJECTS (dead ones included) — the telemetry children
+        # are unregistered at stop(), so family sums go backwards across a
+        # restart.
+        self.migrates_routed = 0
+        self.migrates_bounced = 0
+        self.migrates_cancelled = 0
+        # Live rebalancer ([rebalance] ini section / RebalanceConfig):
+        # every dispatcher keeps the report table (feeds game_load_score
+        # and /healthz), the configured driver additionally PLANS.
+        from goworld_tpu.config.read_config import RebalanceConfig
+        from goworld_tpu.rebalance import RebalancePlanner
+
+        self.rebalance_cfg = rebalance or RebalanceConfig()
+        self.planner = RebalancePlanner(self.rebalance_cfg)
+        self._last_plan = 0.0
+        # Harness hook: pause/resume planning without reconstructing the
+        # service (the multigame bench measures convergence from a known
+        # t0; a paused planner still ingests reports).
+        self._rebalance_active = True
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -275,6 +313,13 @@ class DispatcherService:
             "deployment_ready": self.deployment_ready,
             "queue_depth": self._queue.qsize(),
             "entities_routed": len(self.entities),
+            "rebalance": {
+                "enabled": self.rebalance_cfg.enabled,
+                "driver": (self.rebalance_cfg.driver_dispatcher
+                           == self.dispid),
+                "last_result": self.planner.last_result,
+                "reporting_games": self.planner.reports.games(),
+            },
             "games": {
                 str(gid): {"connected": gi.connected,
                            "last_seen_age_s": age(gi.proxy)}
@@ -358,6 +403,14 @@ class DispatcherService:
         if fam is not None:
             for direction in ("up", "down"):
                 fam.remove(d, direction)
+        fam = telemetry.family("dispatcher_migrates_total")
+        if fam is not None:
+            for kind in ("routed", "bounced", "cancel"):
+                fam.remove(d, kind)
+        fam = telemetry.family("game_load_score")
+        if fam is not None:
+            for gid in self.planner.reports.games():
+                fam.remove(str(gid))
         fam = telemetry.family("cluster_peer_last_seen_seconds")
         if fam is not None:
             for gid in list(self.games):
@@ -502,7 +555,44 @@ class DispatcherService:
             self._sweep_dead_frozen_games()
             self._sweep_dead_gates()
             self._sweep_unrouted_entities()
+            self._retry_pending_boots()
             self._heartbeat_tick()
+            self._rebalance_tick()
+
+    # --- rebalance driving (rebalance/planner.py) ----------------------------
+
+    def rebalance_pause(self) -> None:
+        self._rebalance_active = False
+
+    def rebalance_resume(self) -> None:
+        self._rebalance_active = True
+
+    def _rebalance_tick(self) -> None:
+        """One planning round per [rebalance] interval on the driver
+        dispatcher: plan against live links + fresh reports, then command
+        each donor game. A move's REBALANCE_MIGRATE rides the same
+        buffered per-game dispatch as every other packet, so a game in a
+        reconnect-grace window receives it after the handshake — or never,
+        if it dies, which the planner's next round simply observes."""
+        rb = self.rebalance_cfg
+        if (not rb.enabled or self.dispid != rb.driver_dispatcher
+                or not self._rebalance_active):
+            return
+        now = self._now()
+        if now - self._last_plan < rb.interval:
+            return
+        self._last_plan = now
+        connected = {gid for gid, gi in self.games.items() if gi.connected}
+        for move in self.planner.plan(connected, now):
+            gi = self.games.get(move.from_game)
+            if gi is None or not gi.connected:
+                continue  # link dropped since planning; next round re-sees
+            p = Packet()
+            p.append_entity_id(move.from_space)
+            p.append_entity_id(move.to_space)
+            p.append_uint16(move.to_game)
+            p.append_uint16(move.count)
+            gi.dispatch(MsgType.REBALANCE_MIGRATE, p, now)
 
     # --- chaos/testing hooks -------------------------------------------------
 
@@ -566,6 +656,22 @@ class DispatcherService:
         for gameid, gi in list(self.games.items()):
             if gi.proxy is None and gi.block_until and not gi.blocked(now):
                 gi.block_until = 0.0
+                # Buffered REAL_MIGRATE payloads are entities' LAST
+                # copies: bounce each home before the buffer drops (the
+                # trailing source-gameid makes this possible without the
+                # long-gone forwarding proxy).
+                for msgtype, packet in gi.pending:
+                    if msgtype != MsgType.REAL_MIGRATE:
+                        continue
+                    eid = packet.read_entity_id()
+                    packet.set_read_pos(0)
+                    if not self._bounce_migrate_home(
+                            eid, packet,
+                            self._real_migrate_source(packet), now):
+                        gwlog.errorf(
+                            "dispatcher %d: REAL_MIGRATE of %s buffered "
+                            "for dead game %d has no live source; entity "
+                            "state dropped", self.dispid, eid, gameid)
                 gi.pending.clear()
                 self._handle_game_down(gameid)
 
@@ -582,6 +688,7 @@ class DispatcherService:
                 gt.pending.clear()
                 p = Packet()
                 p.append_uint16(gateid)
+                p.append_uint32(0)  # gone entirely: every generation is dead
                 self._broadcast_games(MsgType.NOTIFY_GATE_DISCONNECTED, p)
                 gwlog.infof(
                     "dispatcher %d: gate %d never reconnected (%d buffered "
@@ -747,6 +854,24 @@ class DispatcherService:
         entity_ids = packet.read_data()
         if not self._check_proto_version(proxy, packet, f"game {gameid}"):
             return
+        if not is_reconnect and not is_restore:
+            # A COLD-booted game (neither a surviving process re-dialing
+            # nor a freeze restore) owns no prior entities: any routing
+            # entries still homed to this gameid belong to a dead
+            # incarnation (crash + recreate inside the reconnect-grace
+            # window, before the down-sweep wiped them). Purge them now —
+            # stale routes would otherwise forward RPCs and sync records
+            # at a game that never heard of those entities.
+            stale = [eid for eid, info in self.entities.items()
+                     if info.gameid == gameid]
+            for eid in stale:
+                del self.entities[eid]
+                self._unrouted.pop(eid, None)
+            if stale:
+                gwlog.warnf(
+                    "dispatcher %d: game %d cold boot replaces a dead "
+                    "incarnation; purged %d stale entity routes",
+                    self.dispid, gameid, len(stale))
         gi = self._game(gameid)
         gi.proxy = proxy
         gi.is_banned_boot = is_ban_boot
@@ -791,15 +916,43 @@ class DispatcherService:
 
     def _handle_set_gate_id(self, proxy: GoWorldConnection, packet: Packet) -> None:
         gateid = packet.read_uint16()
+        fresh = packet.read_bool()
+        gen = packet.read_uint32()
         if not self._check_proto_version(proxy, packet, f"gate {gateid}"):
             return
+        if fresh and gateid in self.gates:
+            # A brand-new gate PROCESS replacing a registered predecessor
+            # (crash + restart inside the reconnect-grace window): the old
+            # process's client bindings are dead — no socket will ever
+            # serve those clientids again. Tell the games to detach them
+            # BEFORE registering the new proxy, and drop the buffered
+            # packets (they address clients of the dead incarnation). The
+            # broadcast names the NEW generation as valid, so a game that
+            # processes it AFTER a new-generation client already connected
+            # (cross-dispatcher ordering) cannot detach the live client. A
+            # surviving gate re-dialing after a link blip sends
+            # fresh=False and keeps its bindings + buffer.
+            old = self.gates[gateid]
+            dropped = len(old.pending)
+            old.pending.clear()
+            p = Packet()
+            p.append_uint16(gateid)
+            p.append_uint32(gen)
+            self._broadcast_games(MsgType.NOTIFY_GATE_DISCONNECTED, p)
+            gwlog.warnf(
+                "dispatcher %d: gate %d is a FRESH process (gen %d); "
+                "detached the dead predecessor's clients on all games "
+                "(%d buffered packets dropped)", self.dispid, gateid, gen,
+                dropped)
         gt = self._gate(gateid)
         gt.proxy = proxy
+        gt.block_until = 0.0
         self._proxy_gates[proxy] = gateid
         self._track_peer_gauge(f"gate{gateid}")
         gt.unblock_and_flush()  # reconnect within the grace window
         self._check_deployment_ready()
-        gwlog.infof("dispatcher %d: gate %d connected", self.dispid, gateid)
+        gwlog.infof("dispatcher %d: gate %d connected (fresh=%s)",
+                    self.dispid, gateid, fresh)
 
     def _check_deployment_ready(self) -> None:
         """Readiness barrier (DispatcherService.go:446-476)."""
@@ -830,11 +983,23 @@ class DispatcherService:
     # --- client lifecycle -----------------------------------------------------
 
     def _handle_notify_client_connected(self, proxy: GoWorldConnection, packet: Packet) -> None:
-        """Gate announced a fresh client; choose a boot game round-robin over
-        non-banned games (DispatcherService.go:545-555,663-667)."""
+        """Gate announced a fresh client; choose a boot game round-robin
+        over non-banned games (DispatcherService.go:545-555,663-667).
+
+        No game available — every boot-capable game mid-reconnect (a link
+        flap under load, a rolling restart) — used to DROP the boot
+        forever: the client sat connected with no player until it gave
+        up. Boots now buffer for the reconnect-grace window and retry
+        each tick; only a window that lapses with still no game drops
+        (with the same warn)."""
         gameid = self._choose_game_for_boot()
         if gameid == 0:
-            gwlog.warnf("dispatcher %d: no game available for boot entity", self.dispid)
+            self._pending_boots.append(
+                (packet, self._now() + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW))
+            gwlog.warnf(
+                "dispatcher %d: no game available for boot entity; "
+                "buffering %.0fs for a game (re)connect", self.dispid,
+                consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
             return
         boot_eid = Packet(packet.payload)  # peek boot eid: clientid(16)+u16+eid(16)
         boot_eid.read_client_id()
@@ -843,6 +1008,22 @@ class DispatcherService:
         info = self._entity(eid)
         info.gameid = gameid
         self._game(gameid).dispatch(MsgType.NOTIFY_CLIENT_CONNECTED, packet, self._now())
+
+    def _retry_pending_boots(self) -> None:
+        """Tick-driven retry of boots that arrived while no boot-capable
+        game had a live link (see _handle_notify_client_connected)."""
+        if not self._pending_boots:
+            return
+        now = self._now()
+        pending = self._pending_boots
+        self._pending_boots = []
+        for packet, expiry in pending:
+            if now >= expiry:
+                gwlog.warnf(
+                    "dispatcher %d: boot entity request expired with no "
+                    "game available; dropped", self.dispid)
+                continue
+            self._handle_notify_client_connected(None, packet)  # type: ignore[arg-type]
 
     def _handle_notify_client_disconnected(self, proxy: GoWorldConnection, packet: Packet) -> None:
         packet.read_client_id()
@@ -952,19 +1133,88 @@ class DispatcherService:
         p.append_uint32(nonce)
         self._ack_requester(proxy, MsgType.MIGRATE_REQUEST_ACK, p)
 
+    @staticmethod
+    def _real_migrate_source(packet: Packet) -> int:
+        """Trailing u16 source gameid of a REAL_MIGRATE payload (0 when a
+        pre-trailer build sent it) — readable without parsing the bson
+        body, so sweep-time bounces need no proxy context."""
+        payload = packet.payload
+        if len(payload) < 20:  # eid(16) + target(2) + trailer(2)
+            return 0
+        return struct.unpack_from("<H", payload, len(payload) - 2)[0]
+
     def _handle_real_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Route the packed entity to its target game — or BOUNCE IT HOME.
+
+        The packet carries the entity's entire state; the source game
+        already destroyed its copy. Forwarding into a game that is gone
+        would therefore destroy the entity's last copy — the exact loss
+        the rebalancer's zero-loss contract forbids. Three target states:
+
+        - connected / blocked (freeze or reconnect grace): route normally
+          (gi.dispatch buffers through blocks);
+        - UNKNOWN (no registration — e.g. THIS dispatcher restarted and a
+          replayed REAL_MIGRATE raced the target's re-handshake): grant
+          the target the standard reconnect-grace window and buffer; the
+          handshake flush delivers, and _sweep_dead_frozen_games bounces
+          any still-buffered payloads home if the window lapses;
+        - declared DEAD (registered, link gone, grace over): bounce home
+          now — the source game restores the entity in place (the
+          migrator counts the bounce as a rollback)."""
         eid = packet.read_entity_id()
         target_game = packet.read_uint16()
         packet.set_read_pos(0)
+        now = self._now()
         info = self._entity(eid)
+        gi = self.games.get(target_game)
+        if gi is None:
+            gi = self._game(target_game)
+            gi.block_until = now + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW
+            gwlog.warnf(
+                "dispatcher %d: REAL_MIGRATE of %s targets unknown game "
+                "%d; buffering %.0fs for its handshake", self.dispid, eid,
+                target_game, consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
+        elif not (gi.connected or gi.blocked(now)):
+            source_game = (self._gameid_of(proxy)
+                           or self._real_migrate_source(packet))
+            if self._bounce_migrate_home(eid, packet, source_game, now):
+                return
+            gwlog.errorf(
+                "dispatcher %d: REAL_MIGRATE of %s targets dead game %d "
+                "and the source link is gone; entity state dropped",
+                self.dispid, eid, target_game)
+            self.entities.pop(eid, None)
+            return
         info.gameid = target_game
-        self._game(target_game).dispatch(MsgType.REAL_MIGRATE, packet, self._now())
+        self._mig_routed.inc()
+        self.migrates_routed += 1
+        gi.dispatch(MsgType.REAL_MIGRATE, packet, now)
         self._flush_entity_pending(info)
+
+    def _bounce_migrate_home(self, eid: str, packet: Packet,
+                             source_game: int, now: float) -> bool:
+        """Redirect a REAL_MIGRATE payload back to its source game (which
+        restores the entity in place). False if the source is gone too."""
+        si = self.games.get(source_game) if source_game else None
+        if si is None or not (si.connected or si.blocked(now)):
+            return False
+        gwlog.warnf(
+            "dispatcher %d: REAL_MIGRATE of %s targets a dead game; "
+            "bouncing home to game %d", self.dispid, eid, source_game)
+        info = self._entity(eid)
+        info.gameid = source_game
+        self._mig_bounced.inc()
+        self.migrates_bounced += 1
+        si.dispatch(MsgType.REAL_MIGRATE, packet, now)
+        self._flush_entity_pending(info)
+        return True
 
     def _handle_cancel_migrate(self, proxy: GoWorldConnection, packet: Packet) -> None:
         eid = packet.read_entity_id()
         info = self.entities.get(eid)
         if info is not None:
+            self._mig_cancel.inc()
+            self.migrates_cancelled += 1
             self._flush_entity_pending(info)
 
     # --- position sync aggregation (DispatcherService.go:786-824) -------------
@@ -989,21 +1239,42 @@ class DispatcherService:
         self._sync_records_up.inc(k)
         entities = self.entities
         pending = self._pending_syncs
+        now = self._now()
         if k == 1:
             info = entities.get(data[:16].decode("ascii"))
             if info is not None and info.gameid:
-                buf = pending.setdefault(info.gameid, bytearray())
-                buf += data[:SYNC_RECORD_SIZE]
-                if self.sync_flush_bytes and len(buf) >= self.sync_flush_bytes:
-                    self._flush_pending_sync(info.gameid)
+                if info.blocked(now):
+                    # Migrate window: the route points at the game the
+                    # entity is LEAVING. Park the record with the entity's
+                    # pending queue; _flush_entity_pending delivers it to
+                    # wherever REAL_MIGRATE (or a bounce) lands it — no
+                    # record is ever delivered to a stale game.
+                    info.push_pending(
+                        MsgType.SYNC_POSITION_YAW_FROM_CLIENT,
+                        Packet(data[:SYNC_RECORD_SIZE]))
+                else:
+                    buf = pending.setdefault(info.gameid, bytearray())
+                    buf += data[:SYNC_RECORD_SIZE]
+                    if self.sync_flush_bytes and len(buf) >= self.sync_flush_bytes:
+                        self._flush_pending_sync(info.gameid)
             _HOP_ROUTE.inc(time.perf_counter() - t0)
             return
         arr = np.frombuffer(data, SYNC_DTYPE, count=k)
         uniq, inv = np.unique(arr["eid"], return_inverse=True)
         lut = np.empty(len(uniq), np.int32)
+        blocked: list[tuple[int, _EntityDispatchInfo]] = []
         for j, eb in enumerate(uniq.tolist()):
             info = entities.get(eb.decode("ascii"))
-            lut[j] = info.gameid if info is not None else 0
+            if info is None:
+                lut[j] = 0
+            elif info.gameid and info.blocked(now):
+                # Steady state never takes this branch (blocked() is one
+                # float compare per UNIQUE entity); records for migrating
+                # entities divert to the per-entity pending queue below.
+                lut[j] = 0
+                blocked.append((j, info))
+            else:
+                lut[j] = info.gameid
         gameids = lut[inv]
         for gid in np.unique(lut).tolist():
             if gid == 0:
@@ -1012,6 +1283,10 @@ class DispatcherService:
             buf += arr[gameids == gid].tobytes()
             if self.sync_flush_bytes and len(buf) >= self.sync_flush_bytes:
                 self._flush_pending_sync(gid)
+        for j, info in blocked:
+            info.push_pending(
+                MsgType.SYNC_POSITION_YAW_FROM_CLIENT,
+                Packet(arr[inv == j].tobytes()))
         _HOP_ROUTE.inc(time.perf_counter() - t0)
 
     def _flush_pending_sync(self, gameid: int) -> None:
@@ -1054,6 +1329,21 @@ class DispatcherService:
         gameid = self._gameid_of(proxy)
         if gameid:
             self._lbc.update(gameid, cpu)
+
+    def _handle_game_load_report(self, proxy: GoWorldConnection, packet: Packet) -> None:
+        """Rich load report (rebalance/report.py schema): feeds the LBC
+        choose-game heap (cpu, as GAME_LBC_INFO did), the planner's
+        report table, and the game_load_score gauge."""
+        from goworld_tpu import rebalance
+        from goworld_tpu.rebalance.report import load_score
+
+        report = packet.read_data()
+        gameid = self._gameid_of(proxy)
+        if not gameid:
+            return
+        self._lbc.update(gameid, float(report.get("cpu", 0.0)))
+        self.planner.on_report(gameid, report, self._now())
+        rebalance.LOAD_SCORE.labels(str(gameid)).set(load_score(report))
 
     def _handle_start_freeze_game(self, proxy: GoWorldConnection, packet: Packet) -> None:
         """Buffer the game's packets for the freeze window then ack
@@ -1120,7 +1410,11 @@ class DispatcherService:
     def _handle_game_down(self, gameid: int) -> None:
         """Unplanned game death: drop its routing entries, tell the others
         (DispatcherService.go:592-640)."""
+        from goworld_tpu import rebalance
+
         self._lbc.remove(gameid)
+        self.planner.on_game_down(gameid)
+        rebalance.LOAD_SCORE.remove(str(gameid))
         dead = [eid for eid, info in self.entities.items() if info.gameid == gameid]
         for eid in dead:
             del self.entities[eid]
@@ -1148,6 +1442,7 @@ class DispatcherService:
         MsgType.SYNC_POSITION_YAW_FROM_CLIENT: _handle_sync_position_yaw_from_client,
         MsgType.KVREG_REGISTER: _handle_kvreg_register,
         MsgType.GAME_LBC_INFO: _handle_game_lbc_info,
+        MsgType.GAME_LOAD_REPORT: _handle_game_load_report,
         MsgType.START_FREEZE_GAME: _handle_start_freeze_game,
         MsgType.HEARTBEAT: _handle_heartbeat,
     }

@@ -805,16 +805,23 @@ class Entity:
 
         if not self._enter_space_request_valid(spaceid, nonce):
             return
+        from goworld_tpu.entity import entity_manager
+
         _, pos, _, _ = self._enter_space_request
         self._enter_space_request = None
         data = self.get_migrate_data()
-        # Rebuild into the *target* space at the requested position.
+        # Rebuild into the *target* space at the requested position; keep
+        # the ORIGINAL space so a bounce-home (dead target game) restores
+        # the entity where it was, not into the nil space.
+        data["prev_space_id"] = data.get("space_id")
         data["space_id"] = spaceid
         data["pos"] = [pos.x, pos.y, pos.z]
         sender = dispatchercluster.select_by_entity_id(self.id)
         gwutils.run_panicless(self.on_migrate_out)
         self._destroy(is_migrate=True)
-        sender.send_real_migrate(self.id, space_gameid, data)
+        sender.send_real_migrate(
+            self.id, space_gameid, data,
+            source_game=entity_manager.runtime.gameid)
 
     def get_migrate_data(self) -> dict:
         """Everything needed to rebuild the entity elsewhere
@@ -822,7 +829,9 @@ class Entity:
         space id, sync flag."""
         client = None
         if self.client is not None:
-            client = {"clientid": self.client.clientid, "gateid": self.client.gateid}
+            client = {"clientid": self.client.clientid,
+                      "gateid": self.client.gateid,
+                      "gen": self.client.gate_gen}
         return {
             "type": self.typename,
             "attrs": self.attrs.to_dict(),
@@ -832,6 +841,11 @@ class Entity:
             "timers": self._pack_timers(),
             "space_id": self.space.id if self.space is not None else None,
             "syncing": self._syncing_from_client,
+            # A pending-but-uncollected sync flag travels with the entity:
+            # a move flagged just before migrate-out would otherwise be
+            # silently dropped with the slab slot (the clients never see
+            # the final pre-hop position). restore_entity re-arms it.
+            "sync_flag": self._sync_info_flag,
         }
 
     get_freeze_data = get_migrate_data  # freeze data ≡ migrate data (§5.4)

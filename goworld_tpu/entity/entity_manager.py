@@ -393,9 +393,17 @@ def get_client_owner(clientid: str) -> Optional[Entity]:
     return _client_owners.get(clientid)
 
 
-def on_gate_disconnected(gateid: int) -> None:
-    """Detach every client of a dead gate (EntityManager.go:145-152)."""
-    for e in [e for e in _client_owners.values() if e.client and e.client.gateid == gateid]:
+def on_gate_disconnected(gateid: int, valid_gen: int = 0) -> None:
+    """Detach the clients of a dead gate (EntityManager.go:145-152).
+
+    ``valid_gen`` != 0: the gate RESTARTED — its clients of other
+    generations are dead, but clients that already connected through the
+    new process (carrying valid_gen) stay attached. This makes the detach
+    broadcast safe under cross-dispatcher reordering: it can arrive after
+    the new gate's first clients and still only touch the dead ones."""
+    for e in [e for e in _client_owners.values()
+              if e.client and e.client.gateid == gateid
+              and (valid_gen == 0 or e.client.gate_gen != valid_gen)]:
         e.notify_client_disconnected()
 
 
@@ -500,15 +508,28 @@ def restore_entity(eid: str, data: dict, is_migrate: bool) -> Entity:
     client = data.get("client")
     if client is not None:
         # Reattach quietly: the client already has the entity mirror.
-        gc = GameClient(client["clientid"], client["gateid"], e.id)
+        gc = GameClient(client["clientid"], client["gateid"], e.id,
+                        gate_gen=client.get("gen", 0))
         e.client = gc
         on_client_attached(gc.clientid, e)
     pos = data.get("pos") or [0.0, 0.0, 0.0]
     e.position = Vector3(*pos)
     e.yaw = data.get("yaw", 0.0)
+    # Re-arm a sync flag that was pending at pack time (see
+    # get_migrate_data): the next collect delivers the position the old
+    # game never got to send.
+    flag = data.get("sync_flag", 0)
+    if flag:
+        e._sync_info_flag = flag
     spaceid = data.get("space_id")
     if spaceid:
         space = _spaces.get(spaceid)
+        if space is None:
+            # Bounce-home rollback: the payload names the TARGET space,
+            # which only exists on the (dead) target game — fall back to
+            # the space the entity was packed out of, so a rolled-back
+            # migration puts it exactly where it was.
+            space = _spaces.get(data.get("prev_space_id") or "")
         if space is not None:
             space._enter(e, e.position)
     if is_migrate:
@@ -571,5 +592,19 @@ def cleanup_for_tests() -> None:
     _client_owners.clear()
     _space_class = None
     _save_interval_override = None
+    runtime = Runtime()
+    post_mod.clear()
+
+
+def reset_world() -> None:
+    """Drop every entity, space, client binding, timer and slab slot but
+    KEEP the type registry — models a game-process crash inside one test
+    process (the chaos harness kills and recreates a GameService without
+    forking): the "new process" starts from an empty world but the same
+    registered entity classes."""
+    global runtime
+    _entities.clear()
+    _spaces.clear()
+    _client_owners.clear()
     runtime = Runtime()
     post_mod.clear()

@@ -12,12 +12,20 @@ from goworld_tpu import dispatchercluster
 
 
 class GameClient:
-    __slots__ = ("clientid", "gateid", "owner_id")
+    __slots__ = ("clientid", "gateid", "owner_id", "gate_gen")
 
-    def __init__(self, clientid: str, gateid: int, owner_id: str) -> None:
+    def __init__(self, clientid: str, gateid: int, owner_id: str,
+                 gate_gen: int = 0) -> None:
         self.clientid = clientid
         self.gateid = gateid
         self.owner_id = owner_id
+        # Generation of the gate PROCESS this client connected through
+        # (minted per gate boot, carried on NOTIFY_CLIENT_CONNECTED): a
+        # restarted gate's stale-client detach names the valid generation,
+        # so the broadcast is ordering-independent — it can never detach a
+        # client that connected through the NEW gate process, no matter
+        # which dispatcher link delivered it first. 0 = unknown (legacy).
+        self.gate_gen = gate_gen
 
     def _sender(self):
         return dispatchercluster.select_by_entity_id(self.owner_id)

@@ -111,6 +111,18 @@ class GameService:
         self._migrate_in_count = 0
         self._migrate_in_bytes = 0
         self._migrate_in_max = 0
+        # Rebalance execution (rebalance/migrator.py): drives dispatcher-
+        # commanded migrations with deadline + rollback; ticked from the
+        # main loop's entity_logic phase (zero cost while idle).
+        rbcfg = getattr(self.cfg, "rebalance", None)
+        from goworld_tpu.rebalance import RebalanceMigrator
+
+        self.migrator = RebalanceMigrator(
+            migrate_timeout=rbcfg.migrate_timeout if rbcfg else 5.0,
+            cooldown=rbcfg.cooldown if rbcfg else 5.0)
+        self._report_interval = rbcfg.report_interval if rbcfg else 1.0
+        # CPU% over the last report interval (rebalance/report.py reads it).
+        self.last_cpu_pct = 0.0
         game_cfg = self.cfg.games.get(gameid)
         self.boot_entity = game_cfg.boot_entity if game_cfg else ""
         self.position_sync_interval = (
@@ -455,6 +467,9 @@ class GameService:
             # adopted class over its entities' slab view — the vectorized
             # replacement for per-entity timers (entity/slabs.py).
             rt.slabs.run_tick_batches()
+            # Rebalance state machine: deadlines, rollbacks, bounce
+            # confirmation for in-flight commanded migrations.
+            self.migrator.tick(time.monotonic())
             tracer.mark("entity_logic")
             # NOTE on the multi-HOST (DCN) tier: the wait=False machinery
             # below is lockstep-SAFE as is. Frame-skip only DEFERS a
@@ -657,7 +672,10 @@ class GameService:
             clientid = packet.read_client_id()
             gateid = packet.read_uint16()
             boot_eid = packet.read_entity_id()
-            self._handle_client_connected(clientid, gateid, boot_eid)
+            gate_gen = (packet.read_uint32()
+                        if packet.unread_len() >= 4 else 0)
+            self._handle_client_connected(clientid, gateid, boot_eid,
+                                          gate_gen)
         elif msgtype == MsgType.NOTIFY_CLIENT_DISCONNECTED:
             clientid = packet.read_client_id()
             packet.read_entity_id()
@@ -701,6 +719,16 @@ class GameService:
             if raw_len > self._migrate_in_max:
                 self._migrate_in_max = raw_len
             entity_manager.restore_entity(eid, data, is_migrate=True)
+            # Normal arrival → start the newcomer's re-move cooldown;
+            # BOUNCE of our own pending departure (dispatcher returned it
+            # because the target game died) → roll the migration back.
+            self.migrator.on_arrived(eid, time.monotonic())
+        elif msgtype == MsgType.REBALANCE_MIGRATE:
+            from_space = packet.read_entity_id()
+            to_space = packet.read_entity_id()
+            to_game = packet.read_uint16()
+            count = packet.read_uint16()
+            self._handle_rebalance_migrate(from_space, to_space, to_game, count)
         elif msgtype == MsgType.CALL_NIL_SPACES:
             packet.read_uint16()
             method = packet.read_varstr()
@@ -716,7 +744,10 @@ class GameService:
         elif msgtype == MsgType.NOTIFY_GAME_DISCONNECTED:
             self.online_games.discard(packet.read_uint16())
         elif msgtype == MsgType.NOTIFY_GATE_DISCONNECTED:
-            entity_manager.on_gate_disconnected(packet.read_uint16())
+            gateid = packet.read_uint16()
+            valid_gen = (packet.read_uint32()
+                         if packet.unread_len() >= 4 else 0)
+            entity_manager.on_gate_disconnected(gateid, valid_gen)
         elif msgtype == MsgType.NOTIFY_DEPLOYMENT_READY:
             self._on_deployment_ready()
         elif msgtype == MsgType.KVREG_REGISTER:
@@ -728,14 +759,34 @@ class GameService:
         else:
             gwlog.warnf("game %d: unhandled msgtype %s", self.gameid, msgtype)
 
-    def _handle_client_connected(self, clientid: str, gateid: int, boot_eid: str) -> None:
+    def _handle_rebalance_migrate(self, from_space: str, to_space: str,
+                                  to_game: int, count: int) -> None:
+        """Dispatcher rebalance command: move up to ``count`` eligible
+        entities of ``from_space`` into ``to_space`` (a same-kind space on
+        ``to_game``) through the hardened migrate path. A stale command —
+        the space moved, emptied, or died since the planner's report —
+        degrades to moving fewer (or zero) entities, never to guessing."""
+        space = entity_manager.get_space(from_space)
+        if space is None or space.is_destroyed():
+            gwlog.warnf("game %d: rebalance command for unknown space %s",
+                        self.gameid, from_space)
+            return
+        moved = self.migrator.handle_command(
+            space, to_space, count, time.monotonic())
+        gwlog.infof(
+            "game %d: rebalance command — migrating %d/%d entities of "
+            "space %s to %s on game %d", self.gameid, moved, count,
+            from_space, to_space, to_game)
+
+    def _handle_client_connected(self, clientid: str, gateid: int,
+                                 boot_eid: str, gate_gen: int = 0) -> None:
         """Create the boot entity and bind the fresh client
         (GameService.go:413-422)."""
         if not self.boot_entity:
             gwlog.errorf("game %d: client connected but no boot entity configured", self.gameid)
             return
         e = entity_manager.create_entity_locally(self.boot_entity, eid=boot_eid)
-        e.set_client(GameClient(clientid, gateid, e.id))
+        e.set_client(GameClient(clientid, gateid, e.id, gate_gen=gate_gen))
 
     def _handle_create_entity_somewhere(self, typename: str, eid: str, attrs: dict) -> None:
         kind = attrs.pop("_kind", None)
@@ -831,18 +882,31 @@ class GameService:
         gwlog.infof("game %d restored %d spaces + %d entities from %s",
                     self.gameid, len(data["spaces"]), len(data["entities"]), path)
 
-    # --- load reporting (lbc/gamelbc.go:17-39) --------------------------------
+    # --- load reporting (lbc/gamelbc.go:17-39, extended per ROADMAP 1) --------
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
 
     async def _lbc_loop(self) -> None:
+        """Every [rebalance] report_interval: send the RICH load report
+        (cpu%, entities, tick p95, queue depth, per-space populations —
+        rebalance/report.py) to every dispatcher. Supersedes the
+        reference's cpu-only GAME_LBC_INFO: the dispatcher feeds the same
+        cpu number into its LBC choose-game heap AND the rebalancer's
+        planner from this one packet."""
+        from goworld_tpu.rebalance import build_load_report
+
         last_cpu = time.process_time()
         last_wall = time.monotonic()
         while True:
-            await asyncio.sleep(1.0)
+            await asyncio.sleep(self._report_interval)
             cpu, wall = time.process_time(), time.monotonic()
             pct = 100.0 * (cpu - last_cpu) / max(1e-9, wall - last_wall)
             last_cpu, last_wall = cpu, wall
+            self.last_cpu_pct = pct
+            report = build_load_report(self)
             for sender in dispatchercluster.select_all():
-                sender.send_game_lbc_info(pct)
+                sender.send_game_load_report(report)
 
 
 def run(gameid: int | None = None, restore: bool | None = None) -> int:

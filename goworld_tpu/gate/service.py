@@ -128,6 +128,15 @@ class GateService:
         self.gate_cfg: GateConfig = self.cfg.gates.get(gateid) or GateConfig()
         self.clients: dict[str, ClientProxy] = {}
         self.filter_trees: dict[str, FilterTree] = {}
+        # Dispatcher indices this instance has handshaked at least once
+        # (the "fresh process" bit of SET_GATE_ID derives from it).
+        self._handshaked: set[int] = set()
+        # Boot generation of this gate process (non-zero): clients carry
+        # it on NOTIFY_CLIENT_CONNECTED; a restart's stale-client detach
+        # broadcast names it as the valid generation (game_client.py).
+        import random as _random
+
+        self.generation: int = _random.getrandbits(32) | 1
         self.cluster: Optional[ClusterClient] = None
         self._server: Optional[asyncio.base_events.Server] = None
         self._queue: asyncio.Queue = asyncio.Queue()
@@ -290,7 +299,15 @@ class GateService:
         return ctx
 
     def _handshake(self, index: int, proxy: GoWorldConnection) -> None:
-        proxy.send_set_gate_id(self.gateid)
+        # fresh = first contact between THIS gate process and dispatcher
+        # ``index``: a brand-new gate introduces itself so the dispatcher
+        # detaches the dead predecessor's client bindings on every game
+        # (crash + restart inside the reconnect-grace window); a surviving
+        # gate re-dialing after a link blip keeps its live clients.
+        fresh = index not in self._handshaked
+        self._handshaked.add(index)
+        proxy.send_set_gate_id(self.gateid, fresh=fresh,
+                               gen=self.generation)
 
     def _on_dispatcher_disconnect(self, index: int) -> None:
         # Deliberate deviation from the reference, which EXITS the whole
@@ -453,7 +470,7 @@ class GateService:
         self.clients[cp.clientid] = cp
         boot_eid = gen_entity_id()
         self._select_by_eid(boot_eid).send_notify_client_connected(
-            cp.clientid, self.gateid, boot_eid
+            cp.clientid, self.gateid, boot_eid, gate_gen=self.generation
         )
         gwlog.debugf("gate %d: client %s connected, boot entity %s", self.gateid, cp.clientid, boot_eid)
 

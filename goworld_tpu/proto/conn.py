@@ -252,9 +252,20 @@ class GoWorldConnection:
         )
         self.send(MsgType.SET_GAME_ID_ACK, p)
 
-    def send_set_gate_id(self, gateid: int) -> None:
+    def send_set_gate_id(self, gateid: int, fresh: bool = False,
+                         gen: int = 0) -> None:
+        """``fresh`` = this is a brand-new gate process introducing itself
+        (not a surviving gate re-dialing after a link blip): the dispatcher
+        then detaches the dead predecessor's client bindings on every game
+        before registering the new proxy (stale GameClient bindings would
+        otherwise route syncs/RPCs at clientids no socket serves).
+        ``gen`` = the gate process's boot generation; the detach broadcast
+        names it as the VALID generation so a late-arriving broadcast can
+        never detach clients that connected through the new process."""
         p = Packet()
         p.append_uint16(gateid)
+        p.append_bool(fresh)
+        p.append_uint32(gen)
         p.append_uint32(PROTO_VERSION)
         self.send(MsgType.SET_GATE_ID, p)
 
@@ -272,11 +283,16 @@ class GoWorldConnection:
 
     # --- client lifecycle --------------------------------------------------
 
-    def send_notify_client_connected(self, clientid: str, gateid: int, boot_eid: str) -> None:
+    def send_notify_client_connected(self, clientid: str, gateid: int,
+                                     boot_eid: str, gate_gen: int = 0) -> None:
         p = Packet()
         p.append_client_id(clientid)
         p.append_uint16(gateid)
         p.append_entity_id(boot_eid)
+        # Gate boot generation LAST (the dispatcher's boot-eid peek reads
+        # the prefix positionally): pairs with NOTIFY_GATE_DISCONNECTED's
+        # valid-generation field (GameClient.gate_gen).
+        p.append_uint32(gate_gen)
         self.send(MsgType.NOTIFY_CLIENT_CONNECTED, p)
 
     def send_notify_client_disconnected(self, clientid: str, owner_eid: str) -> None:
@@ -377,11 +393,19 @@ class GoWorldConnection:
         p.append_uint32(nonce)
         self.send(MsgType.MIGRATE_REQUEST_ACK, p)
 
-    def send_real_migrate(self, eid: str, target_game: int, migrate_data: dict) -> None:
+    def send_real_migrate(self, eid: str, target_game: int,
+                          migrate_data: dict, source_game: int = 0) -> None:
+        """``source_game`` rides as a TRAILING u16 so the dispatcher can
+        bounce the payload home without parsing the bson body — the
+        packet is the entity's only copy, and when the target game turns
+        out dead the sender's identity may no longer be derivable from
+        the connection (a sweep-time bounce happens long after the
+        forwarding proxy is gone)."""
         p = Packet()
         p.append_entity_id(eid)
         p.append_uint16(target_game)
         p.append_data(migrate_data)
+        p.append_uint16(source_game)
         self.send(MsgType.REAL_MIGRATE, p)
 
     def send_cancel_migrate(self, eid: str) -> None:
@@ -416,9 +440,15 @@ class GoWorldConnection:
         p.append_uint16(gameid)
         self.send(MsgType.NOTIFY_GAME_DISCONNECTED, p)
 
-    def send_notify_gate_disconnected(self, gateid: int) -> None:
+    def send_notify_gate_disconnected(self, gateid: int,
+                                      valid_gen: int = 0) -> None:
+        """``valid_gen`` != 0 narrows the detach to clients of OTHER gate
+        generations (the gate process restarted: its old clients are dead
+        but its new ones — which carry valid_gen — are live). 0 = the
+        gate is gone entirely; detach every client of that gateid."""
         p = Packet()
         p.append_uint16(gateid)
+        p.append_uint32(valid_gen)
         self.send(MsgType.NOTIFY_GATE_DISCONNECTED, p)
 
     def send_notify_deployment_ready(self) -> None:
@@ -446,6 +476,28 @@ class GoWorldConnection:
         p = Packet()
         p.append_float32(cpu_percent)
         self.send(MsgType.GAME_LBC_INFO, p)
+
+    def send_game_load_report(self, report: dict) -> None:
+        """Rich per-game load report (rebalance/report.py schema): cpu%,
+        entities, tick p95, queue depth, per-space populations. Feeds the
+        LBC heap AND the dispatcher-side rebalancer."""
+        p = Packet()
+        p.append_data(report)
+        self.send(MsgType.GAME_LOAD_REPORT, p)
+
+    def send_rebalance_migrate(
+        self, from_space: str, to_space: str, to_game: int, count: int
+    ) -> None:
+        """Dispatcher→game rebalance command: the receiving (donor) game
+        selects up to ``count`` movable entities in ``from_space`` and
+        drives each through the hardened migrate path into ``to_space``
+        on ``to_game`` (rebalance/migrator.py)."""
+        p = Packet()
+        p.append_entity_id(from_space)
+        p.append_entity_id(to_space)
+        p.append_uint16(to_game)
+        p.append_uint16(count)
+        self.send(MsgType.REBALANCE_MIGRATE, p)
 
     # --- redirect range: game → client via gate ----------------------------
     # Payloads start with [u16 gateid][clientid]; the dispatcher routes on the

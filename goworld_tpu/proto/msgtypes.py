@@ -30,7 +30,13 @@ import enum
 # HEARTBEAT are byte-identical to v3, but a v3 peer would route a flagged
 # msgtype to "unhandled" and mis-read the trailer as payload bytes — fail
 # the mixed pair at the handshake instead.
-PROTO_VERSION = 4
+# v5: rebalancing + crash hygiene — SET_GATE_ID gains a ``fresh`` bool
+# BEFORE the version field (a restarted gate process announces itself so
+# the dispatcher can detach its dead predecessor's client bindings — a v4
+# dispatcher would mis-read the bool as the version's first byte), plus
+# the new GAME_LOAD_REPORT / REBALANCE_MIGRATE types a v4 peer would drop
+# as unhandled.
+PROTO_VERSION = 5
 
 # High bit of the wire msgtype: a tracing trailer follows the payload.
 # Never a routing class — masked off before any msgtype comparison.
@@ -71,6 +77,16 @@ class MsgType(enum.IntEnum):
     # links by BOTH ends, swallowed at the recv seam (never queued to
     # logic); its only effect is refreshing the peer's last-seen clock.
     HEARTBEAT = 28
+    # Rich per-game load report (no reference analog; supersedes the
+    # cpu-only GAME_LBC_INFO, which stays wired for reference parity):
+    # one bson dict per second per game — cpu%, entity count, tick-phase
+    # p95, queue depth, per-space populations — feeding both the LBC
+    # choose-game heap and the dispatcher-side rebalancer (rebalance/).
+    GAME_LOAD_REPORT = 29
+    # Dispatcher→game rebalance command: migrate up to ``count`` entities
+    # out of one space into a same-kind space on another game via the
+    # hardened cross-game migration path (rebalance/migrator.py).
+    REBALANCE_MIGRATE = 30
 
     # --- redirected to client via gate (proto.go:85-114) -------------------
     CREATE_ENTITY_ON_CLIENT = 1001

@@ -1,0 +1,80 @@
+"""Telemetry-driven live rebalancer (ROADMAP item 1; no reference analog).
+
+GoWorld's load balancing stops at PLACEMENT: the dispatcher's CPU min-heap
+(dispatcher/lbc.py) picks the least-loaded game for NEW entities, and a hot
+game stays hot until its population churns away. This package takes the
+same telemetry the engine already produces — tick-phase p95, queue depth,
+entity counts, per-space populations — and moves LIVE entities between
+games through the hardened cross-game migration path:
+
+- ``report``: the per-game load-report schema (built game-side, consumed
+  dispatcher-side) and the scalar load score.
+- ``planner``: dispatcher-side planning — pick donor/receiver games and
+  donor/receiver spaces, with hysteresis and hard pause conditions (stale
+  telemetry, a game link mid-restart) so the rebalancer degrades to DOING
+  NOTHING rather than guessing.
+- ``migrator``: game-side execution — drive each commanded entity through
+  ``enter_space``'s cross-game machinery with a per-migration deadline,
+  CANCEL_MIGRATE rollback, bounce-back detection (an entity the dispatcher
+  returned home because the target game died), and per-entity cooldown
+  with rollback backoff so a flapping target cannot thrash.
+
+Zero-loss contract (pinned by tests/test_rebalance.py and the multigame
+chaos scenarios): client RPCs and position-sync records addressed at a
+migrating entity buffer at the dispatcher for the migrate window and flush
+to wherever the entity LANDS; a REAL_MIGRATE whose target game is gone
+bounces home instead of dropping; a migration that cannot complete rolls
+back to the source game. An entity is never in zero places.
+
+CheetahGIS (PAPERS.md) is the exemplar for streaming spatial workload
+partitioning — its density-aware streaming partitioner maps here to the
+planner's per-space population view; the manycore range-query work informs
+the batched interest re-registration the AOI plane already performs after
+a move (the restored entity re-enters the target space in one hop).
+"""
+
+from __future__ import annotations
+
+from goworld_tpu import telemetry
+
+# Families register at module scope only (gwlint R5); children resolve at
+# use sites. Outcomes: done = REAL_MIGRATE left for the target game and
+# the entity did not bounce home; rolled_back = the pending request was
+# cancelled/superseded or the entity bounced home; timeout = the migrator
+# hit its per-migration deadline and cancelled (a rollback whose CAUSE is
+# the deadline — counted separately so a flapping peer is visible).
+MIGRATIONS = telemetry.counter(
+    "rebalance_migrations_total",
+    "Rebalancer-driven cross-game migrations by outcome "
+    "(done|rolled_back|timeout).",
+    ("outcome",))
+# Dispatcher-side view of each game's scalar load score (rebalance/report
+# load_score over the game's last report); NaN-free — removed when the
+# game is declared down.
+LOAD_SCORE = telemetry.gauge(
+    "game_load_score",
+    "Scalar load score per game from its last load report "
+    "(entities + weighted cpu/tick-p95/queue-depth).",
+    ("gameid",))
+# Planner activity: rounds that produced moves, and rounds paused by each
+# guard condition (visibility into "why is it not rebalancing").
+PLANS = telemetry.counter(
+    "rebalance_plans_total",
+    "Planner rounds by result (moved|balanced|paused_stale|paused_links|"
+    "paused_few).",
+    ("result",))
+
+from goworld_tpu.rebalance.migrator import RebalanceMigrator  # noqa: E402
+from goworld_tpu.rebalance.planner import Move, RebalancePlanner  # noqa: E402
+from goworld_tpu.rebalance.report import build_load_report, load_score  # noqa: E402
+
+__all__ = [
+    "MIGRATIONS",
+    "LOAD_SCORE",
+    "PLANS",
+    "Move",
+    "RebalancePlanner",
+    "RebalanceMigrator",
+    "build_load_report",
+    "load_score",
+]

@@ -1,0 +1,183 @@
+"""Game-side execution of rebalance moves: hardened cross-game migration.
+
+The dispatcher's REBALANCE_MIGRATE names (from_space, to_space, to_game,
+count); this module picks the entities and drives each through the
+existing ``enter_space`` cross-game machinery (QUERY_SPACE_GAMEID →
+MIGRATE_REQUEST → REAL_MIGRATE), adding the guarantees the organic path
+leaves to its 60 s dispatcher window:
+
+- **per-migration deadline**: a migration not done by ``migrate_timeout``
+  is cancelled (CANCEL_MIGRATE releases the dispatcher's RPC block) and
+  counted ``timeout`` — the entity stays live on this game;
+- **bounce-back detection**: if the dispatcher returned the entity home
+  because the target game died mid-REAL_MIGRATE, the reappearance inside
+  the confirmation window converts the outcome to ``rolled_back`` instead
+  of a false ``done``;
+- **cooldown with backoff**: a moved (or rolled-back) entity is exempt
+  from re-selection for ``cooldown`` seconds, doubling per consecutive
+  rollback — a flapping target game cannot make one entity ping-pong.
+
+States per tracked entity id::
+
+    pending     enter_space issued; watching for completion or deadline
+    confirming  entity gone locally (REAL_MIGRATE sent); waiting out the
+                bounce window before counting ``done``
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from goworld_tpu.utils import gwlog
+
+# Seconds an entity must stay gone before a departure counts as done: long
+# enough for a dispatcher bounce (dead target) to restore it, short enough
+# that the counter is live. Bounces ride the same link the REAL_MIGRATE
+# left on, so they arrive within an RTT of the dispatcher noticing.
+CONFIRM_GRACE = 2.0
+
+
+@dataclasses.dataclass
+class _Pending:
+    deadline: float
+    to_space: str
+    nonce_spaceid: str  # the spaceid the enter targets (validity key)
+
+
+class RebalanceMigrator:
+    def __init__(self, migrate_timeout: float = 5.0,
+                 cooldown: float = 5.0) -> None:
+        self.migrate_timeout = migrate_timeout
+        self.cooldown = cooldown
+        self._pending: dict[str, _Pending] = {}
+        self._confirming: dict[str, float] = {}
+        # eid → (exempt-until, consecutive rollbacks)
+        self._cooldowns: dict[str, tuple[float, int]] = {}
+        self.done = 0
+        self.rolled_back = 0
+        self.timeouts = 0
+
+    # --- selection -----------------------------------------------------------
+
+    def eligible(self, space, now: float) -> list:
+        """Movable entities of ``space``: live, client-facing or not, not
+        already migrating, not on cooldown. Deterministic order (by id) so
+        repeated commands act on a stable prefix."""
+        out = []
+        for e in space.entities:
+            if e.is_destroyed() or e.is_space_entity():
+                continue
+            if e.id in self._pending or e.id in self._confirming:
+                continue
+            cd = self._cooldowns.get(e.id)
+            if cd is not None and now < cd[0]:
+                continue
+            out.append(e)
+        out.sort(key=lambda e: e.id)
+        return out
+
+    # --- execution -----------------------------------------------------------
+
+    def migrate(self, entity, to_space: str, now: float) -> None:
+        """Issue one hardened migration. Reuses the entity's current
+        position — a rebalance move is an ops action, not a teleport."""
+        self._pending[entity.id] = _Pending(
+            now + self.migrate_timeout, to_space, to_space)
+        entity.enter_space(to_space, entity.position)
+
+    def handle_command(self, space, to_space: str, count: int,
+                       now: float) -> int:
+        """REBALANCE_MIGRATE entry: migrate up to ``count`` eligible
+        entities of ``space`` into ``to_space``. Returns how many were
+        issued."""
+        moved = 0
+        for e in self.eligible(space, now):
+            if moved >= count:
+                break
+            self.migrate(e, to_space, now)
+            moved += 1
+        return moved
+
+    # --- lifecycle notifications --------------------------------------------
+
+    def on_arrived(self, eid: str, now: float) -> None:
+        """An entity landed here via REAL_MIGRATE. Two meanings: a normal
+        arrival (receiver side — start its cooldown so this game doesn't
+        instantly re-donate the newcomer), or a BOUNCE of our own pending
+        departure (the dispatcher sent it home because the target game
+        died) — then the migration rolls back."""
+        if eid in self._confirming or eid in self._pending:
+            self._pending.pop(eid, None)
+            self._confirming.pop(eid, None)
+            self._fail(eid, "rolled_back", now)
+            gwlog.warnf("rebalance: %s bounced home (target game down); "
+                        "rolled back", eid)
+            return
+        self._cooldowns[eid] = (now + self.cooldown, 0)
+
+    # --- the state machine ---------------------------------------------------
+
+    def tick(self, now: float) -> None:
+        """Advance every tracked migration (called from the game loop's
+        entity_logic phase; O(tracked), zero when idle)."""
+        if not self._pending and not self._confirming:
+            return
+        from goworld_tpu.entity import entity_manager as em
+
+        for eid, p in list(self._pending.items()):
+            e = em.get_entity(eid)
+            if e is None or e.is_destroyed():
+                # REAL_MIGRATE left; hold the outcome until the bounce
+                # window passes.
+                del self._pending[eid]
+                self._confirming[eid] = now + CONFIRM_GRACE
+                continue
+            req = e._enter_space_request
+            if req is None or req[0] != p.nonce_spaceid:
+                # Cancelled (dispatcher timeout path) or superseded by an
+                # organic enter_space — either way OUR migration is over
+                # and the entity stayed.
+                del self._pending[eid]
+                self._fail(eid, "rolled_back", now)
+                continue
+            if now >= p.deadline:
+                del self._pending[eid]
+                e.cancel_enter_space()
+                self._fail(eid, "timeout", now)
+                gwlog.warnf(
+                    "rebalance: migration of %s to %s timed out after "
+                    "%.1fs; cancelled (entity stays)", eid, p.to_space,
+                    self.migrate_timeout)
+        for eid, deadline in list(self._confirming.items()):
+            if em.get_entity(eid) is not None:
+                # Reappeared outside on_arrived (e.g. restored locally):
+                # treat as a rollback all the same.
+                del self._confirming[eid]
+                self._fail(eid, "rolled_back", now)
+            elif now >= deadline:
+                del self._confirming[eid]
+                self._count("done")
+                self.done += 1
+                self._cooldowns.pop(eid, None)
+
+    def _fail(self, eid: str, outcome: str, now: float) -> None:
+        self._count(outcome)
+        if outcome == "timeout":
+            self.timeouts += 1
+        else:
+            self.rolled_back += 1
+        prev = self._cooldowns.get(eid)
+        fails = (prev[1] if prev else 0) + 1
+        # Backoff: each consecutive rollback doubles the exemption.
+        self._cooldowns[eid] = (
+            now + self.cooldown * (2 ** min(fails - 1, 6)), fails)
+
+    @staticmethod
+    def _count(outcome: str) -> None:
+        from goworld_tpu import rebalance
+
+        rebalance.MIGRATIONS.labels(outcome).inc()
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending) + len(self._confirming)

@@ -1,0 +1,158 @@
+"""Dispatcher-side rebalance planning.
+
+One planner instance lives on the driver dispatcher ([rebalance]
+driver_dispatcher). Each planning round it looks at the latest per-game
+load reports and either:
+
+- emits up to ``max_moves_per_round`` entity moves from the hottest game's
+  fattest space into a SAME-KIND space on the coldest game (moving between
+  unlike kinds would be a gameplay decision, not an ops decision), or
+- pauses, loudly classified: telemetry stale, a game link mid-restart,
+  fewer than two reporting games, or simply balanced.
+
+Anti-thrash design (the "converges, never oscillates" contract):
+
+- hysteresis: no move unless donor minus receiver entity count is at least
+  ``min_entity_delta``, and only ``delta // 2`` entities move in total —
+  the plan aims AT the midpoint, never past it;
+- report fencing: after issuing moves the planner refuses to plan the
+  same pair again until BOTH games' reports were received after the
+  issue time — a plan may never act on counts that predate its own
+  previous moves (the classic double-move oscillation);
+- the migrator's per-entity cooldown (game-side) is the third layer: even
+  a confused plan cannot bounce one entity back and forth inside the
+  cooldown window.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from goworld_tpu.rebalance.report import ReportTable, load_score
+from goworld_tpu.utils import gwlog
+
+
+@dataclasses.dataclass
+class Move:
+    """One planned transfer: ``count`` entities out of ``from_space`` on
+    ``from_game`` into ``to_space`` on ``to_game`` (the donor game picks
+    WHICH entities — the planner only sees populations)."""
+
+    from_game: int
+    to_game: int
+    from_space: str
+    to_space: str
+    count: int
+
+
+class RebalancePlanner:
+    def __init__(self, cfg) -> None:
+        self.cfg = cfg  # RebalanceConfig
+        self.reports = ReportTable()
+        # (donor, receiver) → monotonic time moves were last issued; both
+        # games must report AFTER this before the pair is planned again.
+        self._fenced: dict[tuple[int, int], float] = {}
+        self.last_result = "idle"  # /healthz visibility
+
+    # --- input ---------------------------------------------------------------
+
+    def on_report(self, gameid: int, report: dict,
+                  now: float | None = None) -> None:
+        self.reports.update(gameid, report, now)
+
+    def on_game_down(self, gameid: int) -> None:
+        self.reports.remove(gameid)
+
+    # --- planning ------------------------------------------------------------
+
+    def plan(self, connected: set[int], now: float) -> list[Move]:
+        """One planning round. ``connected`` = games with a live dispatcher
+        link RIGHT NOW; a reporting game without a link is mid-restart and
+        pauses the planner entirely (moving entities toward or away from a
+        game whose state is unknown is exactly the thrash this guard
+        exists to prevent)."""
+        from goworld_tpu import rebalance
+
+        games = self.reports.games()
+        fresh = [g for g in games if g in connected]
+        if any(g not in connected for g in games):
+            # A reporting game without a live link is mid-restart: its
+            # state is unknown, so the whole planner pauses (classified
+            # before the count check — this is the restart case, not the
+            # small-cluster case).
+            return self._pause("paused_links", rebalance.PLANS)
+        if len(fresh) < 2:
+            return self._pause("paused_few", rebalance.PLANS)
+        if any(self.reports.age(g, now) > self.cfg.stale_after
+               for g in fresh):
+            return self._pause("paused_stale", rebalance.PLANS)
+
+        scored = sorted(
+            fresh, key=lambda g: load_score(self.reports.get(g)))
+        donor, receiver = scored[-1], scored[0]
+        delta = (self.reports.entities(donor)
+                 - self.reports.entities(receiver))
+        if delta < self.cfg.min_entity_delta:
+            self.last_result = "balanced"
+            rebalance.PLANS.labels("balanced").inc()
+            return []
+        fence = self._fenced.get((donor, receiver))
+        if fence is not None and (
+            self.reports.age(donor, now) > now - fence
+            or self.reports.age(receiver, now) > now - fence
+        ):
+            # One (or both) reports predate our previous moves for this
+            # pair: acting again would double-count the same imbalance.
+            self.last_result = "fenced"
+            rebalance.PLANS.labels("balanced").inc()
+            return []
+
+        budget = min(self.cfg.max_moves_per_round, delta // 2)
+        moves = self._pick_spaces(donor, receiver, budget)
+        if not moves:
+            self.last_result = "balanced"
+            rebalance.PLANS.labels("balanced").inc()
+            return []
+        self._fenced[(donor, receiver)] = now
+        self.last_result = (
+            f"moved {sum(m.count for m in moves)} "
+            f"game{donor}->game{receiver}")
+        rebalance.PLANS.labels("moved").inc()
+        gwlog.infof(
+            "rebalance: plan %s (delta %d, scores %.1f -> %.1f)",
+            self.last_result, delta,
+            load_score(self.reports.get(donor)),
+            load_score(self.reports.get(receiver)))
+        return moves
+
+    def _pause(self, reason: str, plans) -> list[Move]:
+        self.last_result = reason
+        plans.labels(reason).inc()
+        return []
+
+    def _pick_spaces(self, donor: int, receiver: int,
+                     budget: int) -> list[Move]:
+        """Donor spaces largest-first; for each, the emptiest SAME-KIND
+        receiver space. Splits the budget across donor spaces as needed
+        (a donor whose population is spread over many spaces still
+        drains)."""
+        donor_spaces = sorted(
+            (self.reports.get(donor) or {}).get("spaces", []),
+            key=lambda s: -s[2])
+        recv_spaces = (self.reports.get(receiver) or {}).get("spaces", [])
+        by_kind: dict[int, list] = {}
+        for sid, kind, count in recv_spaces:
+            by_kind.setdefault(int(kind), []).append([sid, kind, count])
+        moves: list[Move] = []
+        for sid, kind, count in donor_spaces:
+            if budget <= 0:
+                break
+            targets = by_kind.get(int(kind))
+            if not targets or count <= 0:
+                continue
+            target = min(targets, key=lambda s: s[2])
+            n = min(budget, int(count))
+            moves.append(Move(donor, receiver, sid, target[0], n))
+            budget -= n
+            target[2] += n  # keep later picks spreading, not stacking
+        return moves

@@ -369,6 +369,11 @@ class FlightRecorder:
         self.last_slow: Optional[dict] = None
         self._last_warn = 0.0
 
+    def ticks(self) -> list:
+        """Snapshot of the ring (oldest first) — the load report's
+        tick-p95 source (rebalance/report.py)."""
+        return list(self._ticks)
+
     def record(self, t0_mono: float, total: float,
                phases: dict[str, float], **extra) -> None:
         entry = {

@@ -364,6 +364,24 @@ def test_r6_documented_keys_are_clean(tmp_path):
     assert r.ok, _messages(r)
 
 
+def test_r6_covers_rebalance_and_client_sections():
+    """ISSUE 10 satellite: the new [rebalance] and [client] sections are
+    inside R6's coverage — every key the reader consumes is documented in
+    the sample and extracted by the rule's own key scan (so future drift
+    in these sections fails the gate like any other)."""
+    import os
+
+    from goworld_tpu.analysis.rules import _sample_keys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fams, _lines = _sample_keys(root)
+    assert fams["rebalance"] >= {
+        "enabled", "driver_dispatcher", "interval", "report_interval",
+        "stale_after", "min_entity_delta", "max_moves_per_round",
+        "migrate_timeout", "cooldown"}
+    assert "rpc_timeout" in fams["client"]
+
+
 # --- suppression mechanics ---------------------------------------------------
 
 
@@ -646,6 +664,32 @@ def test_lockgraph_stress_smoke(tmp_path):
     (tick loop, sync fan-out, storage saves) the chaos scenario spends
     less time in."""
     _, report = _chaos_smoke(runtime=1.5, run_dir=str(tmp_path))
+    _assert_lock_clean(report)
+
+
+@pytest.mark.chaos
+def test_lockgraph_process_kill_smoke(tmp_path):
+    """ISSUE 10's new chaos scenarios under the lock monitor: a game
+    crash + cold recreate followed by a gate crash + client reconnect
+    wave exercise teardown/reboot interleavings (service construction
+    while old threads drain) no other smoke reaches — the engine lock
+    graph must stay acyclic with no blocking under a held lock, and both
+    scenarios' own invariants must hold. (The 7th scenario —
+    migrate-during-dispatcher-restart — runs real game subprocesses the
+    monitor cannot instrument; its parent-side dispatchers are covered
+    here and in the multigame floor gate.)"""
+    from goworld_tpu.chaos import (
+        scenario_game_kill_recreate,
+        scenario_gate_kill_reconnect,
+    )
+
+    async def both(cluster):
+        r1 = await scenario_game_kill_recreate(cluster)
+        r2 = await scenario_gate_kill_reconnect(cluster)
+        return {"bot_errors": r1["bot_errors"] + r2["bot_errors"]}
+
+    result, report = _chaos_smoke(both, run_dir=str(tmp_path))
+    assert result["bot_errors"] == 0
     _assert_lock_clean(report)
 
 

@@ -18,6 +18,8 @@ import pytest
 from goworld_tpu.chaos import (
     ChaosCluster,
     scenario_dispatcher_restart,
+    scenario_game_kill_recreate,
+    scenario_gate_kill_reconnect,
     scenario_paused_dispatcher,
     scenario_severed_link,
     scenario_storage_outage,
@@ -90,6 +92,48 @@ def test_paused_dispatcher_liveness_kill(tmp_path):
     # Detection must land near the configured deadline, not the OS's
     # multi-minute TCP timeout.
     assert r["detect_s"] < 5.0
+
+
+def test_game_kill_recreate(tmp_path):
+    """ISSUE 10: crash the game under live strict bots and recreate it
+    cold — the dispatcher purges the dead incarnation's entity routes at
+    the cold-boot handshake, clients reconnect onto fresh avatars, the
+    census returns to exactly n_bots with full AOI interest, zero strict
+    errors throughout."""
+    r = _run(scenario_game_kill_recreate, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["recovery_s"] < 20.0
+
+
+def test_gate_kill_reconnect(tmp_path):
+    """ISSUE 10: crash the gate — every client socket dies. The fresh
+    replacement's generation-scoped detach despawns the dead
+    incarnation's avatars (never the reconnecting clients' new ones, no
+    matter the broadcast ordering), and the reconnect wave lands with no
+    cross-client misroute (strict bots would flag one)."""
+    r = _run(scenario_gate_kill_reconnect, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["recovery_s"] < 20.0
+
+
+@pytest.mark.slow
+def test_migrate_during_dispatcher_restart_uds(tmp_path):
+    """The ROADMAP-named scenario on the uds transport (the tcp variant
+    runs in default tier-1 as part of the multigame floor gate): a batch
+    of commanded migrations crosses a dispatcher kill+restart — each must
+    complete (replay-ring flush) or roll back, census conserved, every
+    bot answered."""
+    from goworld_tpu.chaos.multigame import run_multigame
+
+    r = run_multigame(str(tmp_path), n_bots=12, transport="uds",
+                      with_restart_phase=True)
+    assert r["bot_errors"] == 0
+    assert r["zero_loss"] is True
+    phase = r["dispatcher_restart_phase"]
+    assert phase["zero_loss"] is True
+    assert phase["bot_errors"] == 0
+    assert (phase["migrations_done"]
+            + phase["migrations_rolled_back"]) >= 0
 
 
 def test_storage_outage_circuit(tmp_path):

@@ -70,6 +70,20 @@ SAMPLE = textwrap.dedent(
     transport = uds
     uds_dir = /tmp/gwt-test-uds
     sync_flush_bytes = 65536
+
+    [rebalance]
+    enabled = true
+    driver_dispatcher = 2
+    interval = 0.5
+    report_interval = 0.25
+    stale_after = 2.5
+    min_entity_delta = 6
+    max_moves_per_round = 3
+    migrate_timeout = 4.5
+    cooldown = 7
+
+    [client]
+    rpc_timeout = 9.5
     """
 )
 
@@ -201,6 +215,33 @@ def test_cluster_transport_and_flush_knobs(cfg):
         d.addr for _, d in sorted(cfg.dispatchers.items())]
 
 
+def test_rebalance_and_client_sections(cfg):
+    rb = cfg.rebalance
+    assert rb.enabled is True
+    assert rb.driver_dispatcher == 2
+    assert rb.interval == 0.5
+    assert rb.report_interval == 0.25
+    assert rb.stale_after == 2.5
+    assert rb.min_entity_delta == 6
+    assert rb.max_moves_per_round == 3
+    assert rb.migrate_timeout == 4.5
+    assert rb.cooldown == 7.0
+    assert cfg.client.rpc_timeout == 9.5
+
+
+def test_rebalance_defaults_when_absent(tmp_path):
+    p = tmp_path / "min.ini"
+    p.write_text("[deployment]\ndispatchers = 1\n")
+    read_config.set_config_file(str(p))
+    try:
+        cfg = read_config.get()
+        assert cfg.rebalance.enabled is False
+        assert cfg.rebalance.migrate_timeout == 5.0
+        assert cfg.client.rpc_timeout == 5.0
+    finally:
+        read_config.set_config_file(None)
+
+
 def test_cluster_knob_validation(tmp_path):
     """Nonsense resilience knobs fail loudly at load, not at 3 am."""
     for old, bad in (
@@ -210,6 +251,12 @@ def test_cluster_knob_validation(tmp_path):
         ("retry_max_interval = 20", "retry_max_interval = 0.1"),
         ("transport = uds", "transport = shm"),
         ("sync_flush_bytes = 65536", "sync_flush_bytes = -1"),
+        ("interval = 0.5", "interval = 0"),
+        ("stale_after = 2.5", "stale_after = 0.1"),
+        ("min_entity_delta = 6", "min_entity_delta = 0"),
+        ("migrate_timeout = 4.5", "migrate_timeout = 0"),
+        ("driver_dispatcher = 2", "driver_dispatcher = 9"),
+        ("rpc_timeout = 9.5", "rpc_timeout = 0"),
     ):
         assert old in SAMPLE
         p = tmp_path / "bad.ini"

@@ -1,0 +1,545 @@
+"""Rebalancer tests: planner, migrator, migration edge cases, dispatcher
+zero-loss hardening (ISSUE 10).
+
+Three layers, matching the subsystem's split:
+
+- planner units (pure): donor/receiver choice, hysteresis, pause
+  conditions (stale telemetry, link mid-restart), report fencing;
+- migrator + entity units (in-process runtime, stub dispatcher): deadline
+  → cancel, bounce → rollback, cooldown, and the migration edge cases the
+  rebalancer exercises constantly — pending sync flag, quarantined AOI
+  leave, live-timer exactness, back-to-back supersede;
+- dispatcher integration (real sockets, fake peers): sync records for a
+  blocked (migrating) entity buffer and land on the entity's NEW game,
+  REAL_MIGRATE at a dead target bounces home, load reports feed the
+  planner, and the fresh-gate generation detach touches only dead
+  generations.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from goworld_tpu.config.read_config import RebalanceConfig
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.game_client import GameClient
+from goworld_tpu.entity.slabs import SIF_SYNC_NEIGHBOR_CLIENTS, SIF_SYNC_OWN_CLIENT
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.rebalance import RebalanceMigrator, RebalancePlanner
+from goworld_tpu.rebalance.migrator import CONFIRM_GRACE
+from goworld_tpu.rebalance.report import load_score
+
+
+class RbSpace(Space):
+    pass
+
+
+class RbAvatar(Entity):
+    @classmethod
+    def describe_entity_type(cls, desc):
+        desc.set_use_aoi(True)
+        desc.define_attr("hp", "AllClients", "Persistent")
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    em.cleanup_for_tests()
+    em.register_space(RbSpace)
+    em.register_entity(RbAvatar)
+    yield
+    em.cleanup_for_tests()
+
+
+class Recorder:
+    """Captures every send_* call (the test-mode dispatcher stub)."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __getattr__(self, name):
+        if name.startswith("send_"):
+            def rec(*a, **k):
+                self.calls.append((name, a))
+            return rec
+        raise AttributeError(name)
+
+    def names(self):
+        return [n for n, _ in self.calls]
+
+
+@pytest.fixture
+def stub_cluster(monkeypatch):
+    import goworld_tpu.dispatchercluster as dc
+
+    rec = Recorder()
+    monkeypatch.setattr(dc, "select_by_entity_id", lambda eid: rec)
+    return rec
+
+
+# --- planner -----------------------------------------------------------------
+
+
+def _report(entities, spaces, cpu=0.0, p95=0.0, q=0):
+    return {"cpu": cpu, "entities": entities, "tick_p95_ms": p95,
+            "queue_depth": q, "spaces": spaces}
+
+
+def _planner(**kw):
+    return RebalancePlanner(RebalanceConfig(enabled=True, **kw))
+
+
+def test_planner_moves_hot_to_cold_same_kind():
+    p = _planner(min_entity_delta=4, max_moves_per_round=4)
+    p.on_report(1, _report(14, [["arena1".ljust(16, "0"), 1, 12]]), now=10.0)
+    p.on_report(2, _report(2, [["arena2".ljust(16, "0"), 1, 0]]), now=10.0)
+    moves = p.plan({1, 2}, 10.1)
+    assert len(moves) == 1
+    m = moves[0]
+    assert (m.from_game, m.to_game) == (1, 2)
+    assert m.from_space.startswith("arena1")
+    assert m.to_space.startswith("arena2")
+    assert m.count == 4  # min(max_moves_per_round, delta // 2)
+
+
+def test_planner_aims_at_midpoint_not_past_it():
+    p = _planner(min_entity_delta=4, max_moves_per_round=50)
+    p.on_report(1, _report(10, [["a".ljust(16, "0"), 1, 10]]), now=1.0)
+    p.on_report(2, _report(4, [["b".ljust(16, "0"), 1, 4]]), now=1.0)
+    moves = p.plan({1, 2}, 1.1)
+    assert sum(m.count for m in moves) == 3  # delta 6 → move half
+
+
+def test_planner_hysteresis_holds_balanced():
+    p = _planner(min_entity_delta=4)
+    p.on_report(1, _report(8, [["a".ljust(16, "0"), 1, 6]]), now=1.0)
+    p.on_report(2, _report(5, [["b".ljust(16, "0"), 1, 3]]), now=1.0)
+    assert p.plan({1, 2}, 1.1) == []  # delta 3 < 4
+    assert p.last_result == "balanced"
+
+
+def test_planner_pauses_on_stale_telemetry():
+    p = _planner(stale_after=3.0)
+    p.on_report(1, _report(20, [["a".ljust(16, "0"), 1, 20]]), now=0.0)
+    p.on_report(2, _report(0, [["b".ljust(16, "0"), 1, 0]]), now=4.5)
+    assert p.plan({1, 2}, 5.0) == []  # game1's report is 5 s old
+    assert p.last_result == "paused_stale"
+
+
+def test_planner_pauses_while_a_game_link_is_down():
+    p = _planner()
+    p.on_report(1, _report(20, [["a".ljust(16, "0"), 1, 20]]), now=1.0)
+    p.on_report(2, _report(0, [["b".ljust(16, "0"), 1, 0]]), now=1.0)
+    assert p.plan({1}, 1.1) == []  # game2 reported but its link is down
+    assert p.last_result == "paused_links"
+
+
+def test_planner_pauses_with_fewer_than_two_games():
+    p = _planner()
+    p.on_report(1, _report(20, [["a".ljust(16, "0"), 1, 20]]), now=1.0)
+    assert p.plan({1}, 1.1) == []
+    assert p.last_result == "paused_few"
+
+
+def test_planner_fencing_waits_for_fresh_reports():
+    """After issuing moves, the same pair is not re-planned until BOTH
+    games' reports postdate the issue — the double-move oscillation
+    guard."""
+    p = _planner(min_entity_delta=4, max_moves_per_round=2)
+    p.on_report(1, _report(14, [["a".ljust(16, "0"), 1, 12]]), now=10.0)
+    p.on_report(2, _report(2, [["b".ljust(16, "0"), 1, 0]]), now=10.0)
+    assert p.plan({1, 2}, 10.1)  # moves issued, pair fenced at 10.1
+    assert p.plan({1, 2}, 10.6) == []  # same stale counts: fenced
+    p.on_report(1, _report(12, [["a".ljust(16, "0"), 1, 10]]), now=11.0)
+    p.on_report(2, _report(4, [["b".ljust(16, "0"), 1, 2]]), now=11.0)
+    assert p.plan({1, 2}, 11.1)  # fresh reports → acts again
+
+
+def test_planner_requires_same_kind_receiver_space():
+    p = _planner(min_entity_delta=4)
+    p.on_report(1, _report(14, [["a".ljust(16, "0"), 2, 12]]), now=1.0)
+    p.on_report(2, _report(2, [["b".ljust(16, "0"), 1, 0]]), now=1.0)
+    assert p.plan({1, 2}, 1.1) == []  # kinds 2 vs 1: no pairing
+
+
+def test_planner_splits_budget_across_donor_spaces():
+    p = _planner(min_entity_delta=4, max_moves_per_round=8)
+    p.on_report(1, _report(18, [["a1".ljust(16, "0"), 1, 3],
+                                ["a2".ljust(16, "0"), 1, 13]]), now=1.0)
+    p.on_report(2, _report(2, [["b".ljust(16, "0"), 1, 0]]), now=1.0)
+    moves = p.plan({1, 2}, 1.1)
+    assert sum(m.count for m in moves) == 8
+    # Largest donor space drains first.
+    assert moves[0].from_space.startswith("a2")
+
+
+def test_load_score_weighs_compute_beyond_population():
+    flat = _report(10, [], cpu=0.0, p95=0.0, q=0)
+    hot = _report(10, [], cpu=80.0, p95=40.0, q=50)
+    assert load_score(hot) > load_score(flat)
+
+
+# --- migrator ---------------------------------------------------------------
+
+
+def test_migrator_eligible_skips_pending_cooldown_and_spaces():
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    b = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    c = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(cooldown=5.0)
+    m._pending[a.id] = object()  # already migrating
+    m._cooldowns[b.id] = (100.0, 1)  # cooling down at now=50
+    got = m.eligible(space, now=50.0)
+    assert got == [c] or got == sorted([c], key=lambda e: e.id)
+    # Cooldown expired → eligible again.
+    assert set(m.eligible(space, now=101.0)) == {b, c}
+
+
+def test_migrator_deadline_cancels_and_counts_timeout(stub_cluster):
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(migrate_timeout=2.0, cooldown=1.0)
+    m.migrate(a, "R" * 16, now=100.0)
+    assert a._enter_space_request is not None
+    m.tick(101.0)
+    assert m.in_flight == 1  # still inside the window
+    m.tick(102.5)
+    assert m.timeouts == 1
+    assert a._enter_space_request is None  # cancelled
+    assert "send_cancel_migrate" in stub_cluster.names()
+    assert not a.is_destroyed()  # the entity STAYED (rolled back)
+    # Rollback backoff: the entity is on cooldown now.
+    assert m.eligible(space, now=102.6) == []
+
+
+def test_migrator_confirms_done_after_grace(stub_cluster):
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    eid = a.id
+    m = RebalanceMigrator(migrate_timeout=5.0)
+    m.migrate(a, "R" * 16, now=10.0)
+    nonce = a._enter_space_request[3]
+    # Dispatcher acks arrive; the entity packs and leaves.
+    a.on_query_space_gameid_ack("R" * 16, 2, nonce)
+    a.on_migrate_request_ack("R" * 16, 2, nonce)
+    assert a.is_destroyed()
+    m.tick(10.5)
+    assert eid in m._confirming and m.done == 0
+    m.tick(10.6 + CONFIRM_GRACE)
+    assert m.done == 1 and m.in_flight == 0
+
+
+def test_migrator_bounce_back_rolls_back(stub_cluster):
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    eid = a.id
+    m = RebalanceMigrator(migrate_timeout=5.0)
+    m.migrate(a, "R" * 16, now=10.0)
+    nonce = a._enter_space_request[3]
+    data_before = a.get_migrate_data()
+    a.on_query_space_gameid_ack("R" * 16, 2, nonce)
+    a.on_migrate_request_ack("R" * 16, 2, nonce)
+    assert a.is_destroyed()
+    m.tick(10.5)  # → confirming
+    # Target game was dead: the dispatcher bounced the payload home and
+    # the game restored it (REAL_MIGRATE handler calls on_arrived).
+    data_before["space_id"] = space.id
+    em.restore_entity(eid, data_before, is_migrate=True)
+    m.on_arrived(eid, 11.0)
+    assert m.rolled_back == 1 and m.done == 0 and m.in_flight == 0
+    assert em.get_entity(eid) is not None
+    # ... and it is exempt from immediate re-selection.
+    assert em.get_entity(eid) not in m.eligible(space, now=11.1)
+
+
+def test_migrator_arrival_cooldown_for_newcomers():
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(cooldown=5.0)
+    m.on_arrived(a.id, now=10.0)  # normal receiver-side arrival
+    assert m.eligible(space, now=12.0) == []
+    assert m.eligible(space, now=16.0) == [a]
+
+
+# --- migration edge cases (the satellite checklist) --------------------------
+
+
+def test_migrate_carries_pending_sync_flag():
+    """A position change flagged but not yet collected at migrate-out must
+    re-arm on the target game — otherwise the clients never see the final
+    pre-hop position."""
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    eid = a.id
+    a.set_position(Vector3(5.0, 0.0, 7.0))
+    flag = a._sync_info_flag
+    assert flag & (SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS)
+    data = a.get_migrate_data()
+    assert data["sync_flag"] == flag
+    a._destroy(is_migrate=True)
+    restored = em.restore_entity(eid, data, is_migrate=True)
+    assert restored._sync_info_flag == flag
+    assert restored.position.x == pytest.approx(5.0)
+
+
+def test_migrate_while_aoi_leave_quarantined():
+    """Migrate-out while a batched AOI step still owes the slot its leave
+    events: the slot must quarantine (mapping intact for the in-flight
+    leave), the restored entity must get a DIFFERENT slot, and recycling
+    must free the old one — no aliasing, no lost leave."""
+    class FakeAOI:
+        _meta_dirty = False
+
+    slabs = em.runtime.slabs
+    slabs.aoi_service = FakeAOI()
+    try:
+        space = em.create_space_locally(1)
+        a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+        eid, old_slot = a.id, a._slot
+        data = a.get_migrate_data()
+        a._destroy(is_migrate=True)
+        # Slot quarantined, mapping survives for the in-flight leave.
+        assert old_slot in slabs._quarantine
+        assert slabs.entities[old_slot] is a
+        restored = em.restore_entity(eid, data, is_migrate=True)
+        assert restored._slot != old_slot
+        # The engine step that observed the deactivation now hands the
+        # quarantine back; recycling frees the old slot for reuse.
+        q = slabs.take_quarantine()
+        assert old_slot in q
+        slabs.recycle(q)
+        assert slabs.entities[old_slot] is None
+    finally:
+        slabs.aoi_service = None
+
+
+def test_timer_remaining_time_exact_cross_game(monkeypatch):
+    """entity.py:388-390 claims packed remaining time is always exact
+    (repeating timers are one-shot chains): pin it across a migrate
+    round-trip — the restored timer's deadline must be now + exactly the
+    remaining time at pack, and the interval must survive."""
+    fake_now = [1000.0]
+    monkeypatch.setattr(em.runtime.__class__, "now",
+                        lambda self: fake_now[0])
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    eid = a.id
+    a.add_callback(10.0, "some_method")
+    a.add_timer(4.0, "other_method", "arg")
+    fake_now[0] += 3.5
+    data = a.get_migrate_data()
+    packed = sorted(data["timers"])
+    assert packed[0][0] == pytest.approx(0.5)   # 4.0 interval - 3.5
+    assert packed[0][1] == pytest.approx(4.0)   # repeat interval survives
+    assert packed[1][0] == pytest.approx(6.5)   # 10.0 one-shot - 3.5
+    assert packed[1][1] == 0.0
+    a._destroy(is_migrate=True)
+    fake_now[0] += 2.0  # wire latency: remaining is relative, not absolute
+    restored = em.restore_entity(eid, data, is_migrate=True)
+    deadlines = sorted(t[4] for t in restored._timers.values())
+    assert deadlines[0] == pytest.approx(fake_now[0] + 0.5)
+    assert deadlines[1] == pytest.approx(fake_now[0] + 6.5)
+
+
+def test_back_to_back_migrate_supersedes_cleanly(stub_cluster, monkeypatch):
+    """entity.py:698-767: a second enter_space while one is pending wins
+    — the first is cancelled (dispatcher block released), its late acks
+    are dead (nonce), and the second completes normally."""
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    s1, s2 = "S1".ljust(16, "0"), "S2".ljust(16, "0")
+    a.enter_space(s1, Vector3(1, 0, 0))
+    nonce1 = a._enter_space_request[3]
+    a.enter_space(s2, Vector3(2, 0, 0))
+    nonce2 = a._enter_space_request[3]
+    assert nonce2 != nonce1
+    assert "send_cancel_migrate" in stub_cluster.names()
+    # Late acks of the superseded request are ignored outright.
+    a.on_query_space_gameid_ack(s1, 2, nonce1)
+    a.on_migrate_request_ack(s1, 2, nonce1)
+    assert not a.is_destroyed()
+    assert a._enter_space_request[0] == s2
+    # The live request migrates normally.
+    a.on_query_space_gameid_ack(s2, 2, nonce2)
+    a.on_migrate_request_ack(s2, 2, nonce2)
+    assert a.is_destroyed()
+    assert stub_cluster.names().count("send_real_migrate") == 1
+
+
+def test_gate_generation_detach_spares_new_generation():
+    """on_gate_disconnected with a valid generation detaches ONLY the dead
+    generations' clients — the ordering-independence the fresh-gate
+    broadcast relies on."""
+    a = em.create_entity_locally("RbAvatar")
+    b = em.create_entity_locally("RbAvatar")
+    a.client = GameClient("c" * 16, 1, a.id, gate_gen=5)
+    em.on_client_attached(a.client.clientid, a)
+    b.client = GameClient("d" * 16, 1, b.id, gate_gen=7)
+    em.on_client_attached(b.client.clientid, b)
+    em.on_gate_disconnected(1, valid_gen=7)
+    assert a.client is None       # old generation: detached
+    assert b.client is not None   # new generation: untouched
+    em.on_gate_disconnected(1, valid_gen=0)
+    assert b.client is None       # gate fully gone: everyone detaches
+
+
+# --- dispatcher integration (real sockets, fake peers) -----------------------
+
+
+class FakePeer:
+    def __init__(self):
+        self.received = []
+        self.event = asyncio.Event()
+
+    def on_packet(self, index, msgtype, packet):
+        self.received.append((msgtype, packet))
+        self.event.set()
+
+    async def expect(self, msgtype, timeout=5.0):
+        deadline = asyncio.get_running_loop().time() + timeout
+        while True:
+            for i, (mt, pkt) in enumerate(self.received):
+                if mt == msgtype:
+                    del self.received[i]
+                    return pkt
+            remaining = deadline - asyncio.get_running_loop().time()
+            assert remaining > 0, f"timed out waiting for {msgtype}"
+            self.event.clear()
+            try:
+                await asyncio.wait_for(self.event.wait(), remaining)
+            except asyncio.TimeoutError:
+                pass
+
+
+def _disp_cluster():
+    from goworld_tpu.dispatcher import DispatcherService
+    from goworld_tpu.dispatchercluster.cluster import ClusterClient
+
+    async def build(desired_games=2):
+        disp = DispatcherService(1, desired_games=desired_games,
+                                 desired_gates=0)
+        await disp.start()
+        addr = ("127.0.0.1", disp.port)
+        peers, clusters = [], []
+        for gid in (1, 2):
+            peer = FakePeer()
+
+            def handshake(index, proxy, gid=gid):
+                proxy.send_set_game_id(gid, False, False, False, [])
+
+            c = ClusterClient([addr], handshake, peer.on_packet)
+            c.start()
+            await c.wait_connected()
+            peers.append(peer)
+            clusters.append(c)
+        while not all(gi.connected for gi in disp.games.values()) \
+                or len(disp.games) < 2:
+            await asyncio.sleep(0.01)
+        await asyncio.sleep(0.05)
+        return disp, clusters, peers
+
+    return build
+
+
+def test_dispatcher_buffers_sync_records_for_migrating_entity():
+    """The zero-loss sync clause: records for a BLOCKED (mid-migrate)
+    entity must never reach the stale game — they park with the entity's
+    pending queue and flush to wherever REAL_MIGRATE lands it."""
+    from goworld_tpu.proto.conn import pack_sync_record
+    from goworld_tpu.proto.msgtypes import MsgType
+
+    async def run():
+        disp, (c1, c2), (game1, game2) = await _disp_cluster()()
+        eid = "E".ljust(16, "0")
+        other = "F".ljust(16, "0")
+        c1.select(0).send_notify_create_entity(eid)
+        c1.select(0).send_notify_create_entity(other)
+        await asyncio.sleep(0.05)
+        # Enter the migrate window: the dispatcher blocks eid's stream.
+        c1.select(0).send_migrate_request(eid, "S" * 16, 2, 1)
+        await game1.expect(MsgType.MIGRATE_REQUEST_ACK)
+        # A batch carrying BOTH entities: other's record must flow to
+        # game1, eid's must NOT (it buffers with the entity).
+        records = (pack_sync_record(eid, 1.0, 0.0, 1.0, 0.0)
+                   + pack_sync_record(other, 2.0, 0.0, 2.0, 0.0))
+        c1.select(0).send_sync_position_yaw_from_client(records)
+        pkt = await game1.expect(MsgType.SYNC_POSITION_YAW_FROM_CLIENT)
+        assert pkt.payload[:16].decode("ascii") == other
+        assert len(pkt.payload) == 32  # ONLY other's record came through
+        # REAL_MIGRATE lands the entity on game2 — the buffered record
+        # must follow it there, never touching game1 again.
+        c1.select(0).send_real_migrate(eid, 2, {"type": "RbAvatar"})
+        await game2.expect(MsgType.REAL_MIGRATE)
+        pkt = await game2.expect(MsgType.SYNC_POSITION_YAW_FROM_CLIENT)
+        assert pkt.payload[:16].decode("ascii") == eid
+        for c in (c1, c2):
+            await c.stop()
+        await disp.stop()
+
+    asyncio.run(run())
+
+
+def test_dispatcher_bounces_real_migrate_to_dead_target():
+    """REAL_MIGRATE carrying the entity's last copy at a DECLARED-DEAD
+    game must bounce home (source game restores it) instead of dropping;
+    at an UNKNOWN game (e.g. a freshly restarted dispatcher racing the
+    target's re-handshake) it must BUFFER for the grace window, not
+    bounce — the target is probably alive and about to handshake."""
+    from goworld_tpu.dispatcher.service import _GameInfo
+    from goworld_tpu.proto.msgtypes import MsgType
+
+    async def run():
+        disp, (c1, c2), (game1, game2) = await _disp_cluster()()
+        eid = "E".ljust(16, "0")
+        c1.select(0).send_notify_create_entity(eid)
+        await asyncio.sleep(0.05)
+        # Game 7 is REGISTERED but its link is gone past the grace window
+        # — declared dead.
+        disp.games[7] = _GameInfo(7)
+        c1.select(0).send_real_migrate(eid, 7, {"type": "RbAvatar"},
+                                       source_game=1)
+        pkt = await game1.expect(MsgType.REAL_MIGRATE)  # bounced HOME
+        assert pkt.read_entity_id() == eid
+        assert disp.migrates_bounced == 1
+        assert disp.entities[eid].gameid == 1  # route points home again
+        # Game 8 is UNKNOWN: the payload must buffer behind a fresh grace
+        # window (a restarted dispatcher must not mistake a
+        # not-yet-handshaked game for a dead one).
+        c1.select(0).send_real_migrate(eid, 8, {"type": "RbAvatar"},
+                                       source_game=1)
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while not disp.games.get(8) or not disp.games[8].pending:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert disp.games[8].blocked(disp._now())
+        assert disp.migrates_bounced == 1  # did NOT bounce
+        for c in (c1, c2):
+            await c.stop()
+        await disp.stop()
+
+    asyncio.run(run())
+
+
+def test_dispatcher_load_report_feeds_planner_and_lbc():
+    async def run():
+        disp, (c1, c2), (game1, game2) = await _disp_cluster()()
+        c1.select(0).send_game_load_report(
+            _report(10, [["a".ljust(16, "0"), 1, 8]], cpu=55.0))
+        c2.select(0).send_game_load_report(
+            _report(2, [["b".ljust(16, "0"), 1, 0]], cpu=5.0))
+        deadline = asyncio.get_running_loop().time() + 5.0
+        while len(disp.planner.reports.games()) < 2:
+            assert asyncio.get_running_loop().time() < deadline
+            await asyncio.sleep(0.01)
+        assert disp.planner.reports.entities(1) == 10
+        # LBC heap fed from the same report: chooses the cool game.
+        assert disp._lbc.choose() == 2
+        for c in (c1, c2):
+            await c.stop()
+        await disp.stop()
+
+    asyncio.run(run())

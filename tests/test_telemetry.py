@@ -476,6 +476,38 @@ def test_fanout_floor_gate():
     assert abs(sum(result["hop_shares"].values()) - 1.0) < 0.02
 
 
+def test_multigame_floor_gate():
+    """The live-rebalance floor (ISSUE 10): 2 real game subprocesses with
+    a fully skewed initial placement must converge to balanced at no less
+    than the committed rebalance throughput, with ZERO entity loss and
+    ZERO strict-bot errors — and the same cluster must then survive the
+    migrate-during-dispatcher-restart phase (commanded migrations either
+    complete via the replay-ring flush or roll back; census conserved).
+    The throughput number is timing-quantized (planning rounds + report
+    cycles), hence the wide committed tolerance; the hard assertions
+    below carry the correctness load."""
+    floor_spec = json.loads(
+        (_REPO / "BENCH_FLOOR.json").read_text())["multigame"]
+    bench = _load_bench()
+    result = bench.bench_multigame()
+    assert result["config"] == bench.MULTIGAME_CONFIG
+    assert result["bot_errors"] == 0, result
+    assert result["zero_loss"] is True, result
+    assert result["census"][0] + result["census"][1] == \
+        bench.MULTIGAME_CONFIG["bots"]
+    phase = result["dispatcher_restart_phase"]
+    assert phase["zero_loss"] is True, phase
+    assert phase["bot_errors"] == 0, phase
+    floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
+    assert result["value"] >= floor, (
+        f"multigame-floor regression: {result['value']:.2f} entities/s < "
+        f"{floor:.2f} (floor {floor_spec['floor']} - "
+        f"{floor_spec['tolerance']:.0%} tolerance). "
+        f"convergence_s={result['convergence_s']}. "
+        f"See BENCH_FLOOR.json how_to_read."
+    )
+
+
 def test_fanout_multi_floor_gate():
     """The multi-gate fan-out floor variant (ISSUE 6): 2 gates x 104 bots
     — the same pipeline with the per-gate split of every hop exercised

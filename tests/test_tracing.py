@@ -197,7 +197,9 @@ def test_recv_seam_strips_trailer_ignored_compatible():
 def test_proto_version_bumped_for_trailer():
     from goworld_tpu.proto.msgtypes import MSGTYPE_TRACE_FLAG, PROTO_VERSION
 
-    assert PROTO_VERSION == 4
+    # v4 added the trailer; later protocol work may bump further (v5:
+    # rebalancing + gate generations) but can never go back below it.
+    assert PROTO_VERSION >= 4
     # The flag bit must sit above every routing class (gate↔client 2001+).
     assert MSGTYPE_TRACE_FLAG > 2001
 
@@ -323,8 +325,10 @@ def test_trace_flight_healthz_endpoints():
                 _fetch, srv.port, "/healthz")
             health = json.loads(body)
             assert status == 200
+            from goworld_tpu.proto.msgtypes import PROTO_VERSION
+
             assert health["kind"] == "dispatcher" and health["id"] == 9
-            assert health["proto_version"] == 4
+            assert health["proto_version"] == PROTO_VERSION
             assert "games" in health and "uptime_s" in health
 
             status, body = await asyncio.to_thread(
@@ -536,11 +540,17 @@ def test_trace_overhead_off_within_fanout_floor():
     """Tracing must be FREE when off: the fanout floor (the real packet
     path, where the trace branch and trailer logic live) measured with
     trace_sample_rate=0 must stay within the committed BENCH_FLOOR.json
-    tolerance — no re-baseline permitted for tracing (ISSUE 5)."""
+    tolerance — no re-baseline permitted for tracing (ISSUE 5).
+
+    Measured in a FRESH subprocess (same churn-isolation reasoning as the
+    pinned gate): this test runs late in tier-1, and an interpreter that
+    has churned the whole suite measures the in-process loop 10-30% slow
+    against a floor set on a fresh process — a coin flip that says
+    nothing about tracing."""
     floor_spec = json.loads(
         (_REPO / "BENCH_FLOOR.json").read_text())["fanout"]
     bench = _load_bench()
-    result = bench.bench_fanout(trace_sample_rate=0)
+    result = bench._fanout_tier1_env(trace_sample_rate=0)
     floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
     assert result["value"] >= floor, (
         f"tracing-off fanout regression: {result['value']:.0f} records/s < "
