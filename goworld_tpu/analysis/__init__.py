@@ -4,9 +4,11 @@ Two halves, one goal — turn the hand-written invariants the test oracles
 keep re-discovering into machine-checked properties:
 
 - ``gwlint`` (core.py + rules.py): an AST rule engine run over the whole
-  package by tier-1 (``tools/gwlint.py`` locally).  Six engine-specific
+  package by tier-1 (``tools/gwlint.py`` locally).  Seven engine-specific
   rules — jit hygiene, hot-path shape, parse bounds, lock discipline,
-  telemetry hygiene, config-key drift — plus a symbol-reachability pass
+  telemetry hygiene, config-key drift, and wire-proto conformance
+  against the declarative schema in proto/schema.py (R7, with a pinned
+  schema digest per PROTO_VERSION) — plus a symbol-reachability pass
   for dead code.  Violations are suppressed only through the committed
   ``gwlint_baseline.toml`` (every entry carries a justification) or an
   inline ``# gwlint: ok RN reason`` pragma, so the gate starts green and
@@ -16,6 +18,13 @@ keep re-discovering into machine-checked properties:
   cross-thread acquisition-order graph at runtime (the dynamic
   complement to rule R4), asserted acyclic — and free of blocking calls
   under a held lock — by tier-1 over the chaos and stress smokes.
+- ``modelcheck``: an explicit-state model checker for the cluster
+  protocol — the dispatcher/game/gate state machines (migrate target
+  states, grace windows, sync parking, boot buffering, gate-binding
+  generations) explored exhaustively over bounded interleavings of
+  delivery, crash, cold restart and grace expiry, asserting the PR-9
+  zero-loss invariants; failing interleavings print as readable message
+  traces.  The model is the spec future protocol PRs extend first.
 """
 
 from goworld_tpu.analysis.core import (

@@ -27,7 +27,7 @@ import tokenize
 from typing import Iterable, Optional
 
 #: Rules shipped with the engine (rules.py registers one checker per id).
-RULE_IDS = ("R1", "R2", "R3", "R4", "R5", "R6")
+RULE_IDS = ("R1", "R2", "R3", "R4", "R5", "R6", "R7")
 
 _PRAGMA_RE = re.compile(r"#\s*gwlint:\s*ok\s+(R\d)\b[\s:,\u2014-]*(.*)")
 

@@ -1,0 +1,807 @@
+"""Explicit-state model checker for the cluster protocol.
+
+A compact Python model of the dispatcher<->game<->gate state machines —
+client-binding generations, migrate target states (connected / blocked /
+UNKNOWN / declared-DEAD), reconnect-grace windows, pending-sync parking,
+buffered boots — explored EXHAUSTIVELY over bounded interleavings of
+message delivery, process crash / cold restart, and grace expiry.  The
+transition rules mirror the shipped code path by path (each cites its
+``file:line``), so the model is the SPEC: the next protocol PR extends
+the model first and lands against these invariants instead of against
+production.
+
+Invariants (the PR-9 zero-loss contract, asserted in every reached state
+and at every quiescent terminal state):
+
+- **I1 no lost / duplicate entity** — an entity has exactly one live
+  copy across games, in-flight ``REAL_MIGRATE`` payloads, and dispatcher
+  grace buffers; a copy count of zero is legal only after the process
+  HOSTING the copy (or holding it on a dying socket) crashed.
+- **I2 no stale sync delivery** — a position-sync record is never
+  delivered to a game that does not host its entity (parking + FIFO
+  flush-behind-``REAL_MIGRATE`` is what guarantees it).
+- **I3 no stuck terminal** — when no action remains, the entity lives on
+  a live game (unless crash-lost), nothing sits in a buffer forever, and
+  every boot request was served unless its only game stayed dead.
+- **I4 generation-scoped detach** — a gate-restart detach broadcast
+  never removes a binding of the valid (new) generation, under any
+  cross-dispatcher delivery order.
+
+Scope honesty: the exploration is BOUNDED (budgets below) and the model
+abstracts time into nondeterministic grace-expiry events — it proves the
+protocol LOGIC under every interleaving within the bounds, not liveness
+under real clocks, and not payload encoding (gwlint R7 owns layout).
+
+``python -m goworld_tpu.analysis.modelcheck`` runs the tier-1 configs
+and reports deterministic state counts (tools/lint.sh wires it in).
+
+Seeded mutants (``mutants=`` on a config) flip one protocol rule each;
+tests/test_modelcheck.py proves every one is caught — the checker has
+teeth, not just green lights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Iterable, NamedTuple, Optional
+
+Msg = tuple[str, ...]
+Chan = tuple[Msg, ...]
+
+#: Known mutant switches (test_modelcheck pins each one caught).
+MUTANTS = (
+    "no_bounce",          # dead-target REAL_MIGRATE dropped, not bounced home
+    "no_purge_cold_boot",  # cold handshake keeps the dead incarnation's routes
+    "infinite_grace",     # reconnect-grace windows never expire
+    "no_sync_parking",    # syncs for a blocked (migrating) entity route anyway
+    "skip_gen_check",     # gate-restart detach ignores the valid generation
+    "drop_boot_no_game",  # boot with no connected game dropped, not buffered
+)
+
+
+# --- framework ---------------------------------------------------------------
+
+
+class Step(NamedTuple):
+    label: str
+    state: "State"
+    violations: tuple[str, ...] = ()
+
+
+State = tuple  # models return hashable NamedTuples (subtypes of tuple)
+
+
+class Model:
+    """Interface an explorable protocol model implements."""
+
+    name = "model"
+
+    def initial(self) -> State:
+        raise NotImplementedError
+
+    def actions(self, s: State) -> list[Step]:
+        raise NotImplementedError
+
+    def state_invariants(self, s: State) -> tuple[str, ...]:
+        return ()
+
+    def terminal_violations(self, s: State) -> tuple[str, ...]:
+        return ()
+
+
+@dataclasses.dataclass
+class Counterexample:
+    message: str
+    trace: tuple[str, ...]
+
+    def render(self) -> str:
+        lines = [f"violation: {self.message}", "  trace:"]
+        lines += [f"    {i + 1:2d}. {step}"
+                  for i, step in enumerate(self.trace)]
+        return "\n".join(lines)
+
+
+@dataclasses.dataclass
+class CheckResult:
+    model: str
+    states: int
+    transitions: int
+    terminals: int
+    violations: list[Counterexample]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self) -> str:
+        head = (f"{self.model}: {self.states} states, "
+                f"{self.transitions} transitions, {self.terminals} "
+                f"terminal state(s), {len(self.violations)} violation(s)")
+        return "\n".join([head] + [v.render() for v in self.violations])
+
+
+def explore(model: Model, max_states: int = 1_000_000,
+            max_counterexamples: int = 8) -> CheckResult:
+    """Exhaustive BFS over the model's reachable states.  Deterministic:
+    identical models explore identical state counts in identical order
+    (actions are returned in rule order; the frontier is FIFO)."""
+    init = model.initial()
+    parents: dict[State, Optional[tuple[State, str]]] = {init: None}
+    frontier: deque[State] = deque([init])
+    violations: list[Counterexample] = []
+    transitions = 0
+    terminals = 0
+
+    def trace_to(s: State, last: Optional[str] = None) -> tuple[str, ...]:
+        labels: list[str] = [] if last is None else [last]
+        cur: Optional[tuple[State, str]] = parents[s]
+        while cur is not None:
+            labels.append(cur[1])
+            cur = parents[cur[0]]
+        return tuple(reversed(labels))
+
+    def report(msg: str, s: State, last: Optional[str] = None) -> None:
+        if len(violations) < max_counterexamples:
+            violations.append(Counterexample(msg, trace_to(s, last)))
+
+    for msg in model.state_invariants(init):
+        report(msg, init)
+    while frontier:
+        if len(parents) > max_states:
+            raise RuntimeError(
+                f"{model.name}: state space exceeded {max_states} — "
+                f"tighten the config bounds")
+        s = frontier.popleft()
+        steps = model.actions(s)
+        if not steps:
+            terminals += 1
+            for msg in model.terminal_violations(s):
+                report(msg, s)
+            continue
+        for label, nxt, viols in steps:
+            transitions += 1
+            for msg in viols:
+                report(msg, s, label)
+            if nxt not in parents:
+                parents[nxt] = (s, label)
+                frontier.append(nxt)
+                for msg in model.state_invariants(nxt):
+                    report(msg, nxt)
+    return CheckResult(model.name, len(parents), transitions, terminals,
+                       violations)
+
+
+# --- the migrate + crash model ----------------------------------------------
+#
+# One entity "E" on game 1, one dispatcher, one migration toward game 2.
+# Game indices are 0-based internally, 1-based in labels.  Each rule
+# cites the code it mirrors.
+
+LINK_CONN = "conn"
+LINK_GRACE = "grace"
+LINK_UNREG = "unreg"
+LINK_DEAD = "dead"
+
+M_MREQ = ("MIGRATE_REQUEST",)
+M_MACK = ("MIGRATE_REQUEST_ACK",)
+M_RMIG = ("REAL_MIGRATE",)
+M_SYNC = ("SYNC_POSITION",)
+M_CANCEL = ("CANCEL_MIGRATE",)
+M_CREATE = ("NOTIFY_CREATE_ENTITY",)
+M_HSHAKE_COLD = ("SET_GAME_ID", "cold")
+
+
+class MigState(NamedTuple):
+    g_alive: tuple[bool, bool]
+    g_has_e: tuple[bool, bool]
+    g1_migrate: str       # idle | requested | sent | cancelled | closed
+    links: tuple[str, str]
+    route: int            # 0 unrouted, 1, 2
+    blocked: bool         # dispatcher migrate window for E
+    parked: Chan          # per-entity pending queue (parked syncs)
+    gpending: tuple[Chan, Chan]   # per-game grace buffers
+    to_g: tuple[Chan, Chan]       # dispatcher -> game FIFOs
+    from_g: tuple[Chan, Chan]     # game -> dispatcher FIFOs
+    crashes_left: int
+    restarts_left: int
+    syncs_left: int
+    cancels_left: int
+    migrates_left: int
+    crash_lost: bool
+
+
+def _put(chans: tuple[Chan, Chan], i: int, *msgs: Msg
+         ) -> tuple[Chan, Chan]:
+    out = list(chans)
+    out[i] = out[i] + tuple(msgs)
+    return (out[0], out[1])
+
+
+def _pop(chans: tuple[Chan, Chan], i: int) -> tuple[Msg, tuple[Chan, Chan]]:
+    out = list(chans)
+    head, out[i] = out[i][0], out[i][1:]
+    return head, (out[0], out[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class MigConfig:
+    name: str = "migrate_crash"
+    crashes: int = 1          # crash budget for game 2 (the target)
+    restarts: int = 1         # cold-restart budget for game 2
+    syncs: int = 1            # position-sync records injected at D
+    cancels: int = 1          # migrator deadline-cancel budget
+    migrates: int = 1
+    target_unregistered: bool = False  # UNKNOWN-target start (replayed
+    #                                    RMIG racing a re-handshake)
+    mutants: frozenset[str] = frozenset()
+
+
+class MigrateCrashModel(Model):
+    """dispatcher/service.py + rebalance/migrator.py + entity manager
+    notify flow, reduced to E's fate under every interleaving."""
+
+    def __init__(self, cfg: MigConfig) -> None:
+        bad = cfg.mutants - set(MUTANTS)
+        if bad:
+            raise ValueError(f"unknown mutants {sorted(bad)}")
+        self.cfg = cfg
+        self.name = cfg.name
+
+    def initial(self) -> MigState:
+        cfg = self.cfg
+        return MigState(
+            g_alive=(True, True),
+            g_has_e=(True, False),
+            g1_migrate="idle",
+            links=(LINK_CONN,
+                   LINK_UNREG if cfg.target_unregistered else LINK_CONN),
+            route=1,
+            blocked=False,
+            parked=(),
+            gpending=((), ()),
+            to_g=((), ()),
+            from_g=((), ()),
+            crashes_left=cfg.crashes,
+            restarts_left=cfg.restarts,
+            syncs_left=cfg.syncs,
+            cancels_left=cfg.cancels,
+            migrates_left=cfg.migrates,
+            crash_lost=False,
+        )
+
+    # -- shared sub-rules ---------------------------------------------------
+
+    def _deliver_to_game(self, s: MigState, gi: int, msg: Msg
+                         ) -> MigState:
+        """_GameInfo.dispatch (dispatcher/service.py:116-122): connected
+        sends, a grace/unreg window buffers, a dead game drops."""
+        link = s.links[gi]
+        if link == LINK_CONN:
+            return s._replace(to_g=_put(s.to_g, gi, msg))
+        if link in (LINK_GRACE, LINK_UNREG):
+            return s._replace(gpending=_put(s.gpending, gi, msg))
+        return s  # dead: drop (syncs/acks only ever reach here)
+
+    def _flush_parked(self, s: MigState, gi: int) -> MigState:
+        """_flush_entity_pending (dispatcher/service.py:774-779): parked
+        packets follow E to wherever it routed, AFTER the REAL_MIGRATE on
+        the same FIFO."""
+        out = s
+        for msg in s.parked:
+            out = self._deliver_to_game(out, gi, msg)
+        return out._replace(parked=(), blocked=False)
+
+    # -- actions ------------------------------------------------------------
+
+    def actions(self, st: State) -> list[Step]:
+        assert isinstance(st, MigState)
+        s = st
+        cfg = self.cfg
+        steps: list[Step] = []
+
+        # migrator issues the move (rebalance/migrator.py:81-99 ->
+        # entity.enter_space -> MIGRATE_REQUEST, entity.py:750-765)
+        if (s.migrates_left and s.g1_migrate == "idle" and s.g_alive[0]
+                and s.g_has_e[0]):
+            steps.append(Step(
+                "game1: send MIGRATE_REQUEST(E)",
+                s._replace(g1_migrate="requested",
+                           migrates_left=s.migrates_left - 1,
+                           from_g=_put(s.from_g, 0, M_MREQ))))
+
+        # migrator deadline fires (rebalance/migrator.py:143-150 ->
+        # cancel_enter_space -> CANCEL_MIGRATE; the entity stays)
+        if s.cancels_left and s.g1_migrate == "requested":
+            steps.append(Step(
+                "game1: migrate deadline -> CANCEL_MIGRATE(E)",
+                s._replace(g1_migrate="cancelled",
+                           cancels_left=s.cancels_left - 1,
+                           from_g=_put(s.from_g, 0, M_CANCEL))))
+
+        # a gate-side sync record reaches the dispatcher
+        # (dispatcher/service.py:1222-1290)
+        if s.syncs_left:
+            nxt = s._replace(syncs_left=s.syncs_left - 1)
+            if s.blocked and "no_sync_parking" not in cfg.mutants:
+                # park with the entity's pending queue (:1246-1254)
+                nxt = nxt._replace(parked=nxt.parked + (M_SYNC,))
+            elif s.route == 0:
+                # unrouted grace buffer (:757-767)
+                nxt = nxt._replace(parked=nxt.parked + (M_SYNC,))
+            else:
+                nxt = self._deliver_to_game(nxt, s.route - 1, M_SYNC)
+            steps.append(Step("gate: SYNC(E) reaches dispatcher", nxt))
+
+        # deliver game -> dispatcher
+        for gi in (0, 1):
+            if not s.from_g[gi]:
+                continue
+            msg, from_g = _pop(s.from_g, gi)
+            base = s._replace(from_g=from_g)
+            steps.append(self._dispatcher_handle(base, gi, msg))
+
+        # deliver dispatcher -> game
+        for gi in (0, 1):
+            if not s.to_g[gi]:
+                continue
+            msg, to_g = _pop(s.to_g, gi)
+            base = s._replace(to_g=to_g)
+            steps.append(self._game_handle(base, gi, msg))
+
+        # crash game 2 (the migrate target)
+        if s.crashes_left and s.g_alive[1]:
+            lost = s.g_has_e[1] or any(
+                m == M_RMIG for m in s.to_g[1])  # on a dying socket
+            nxt = s._replace(
+                g_alive=(s.g_alive[0], False),
+                g_has_e=(s.g_has_e[0], False),
+                crashes_left=s.crashes_left - 1,
+                to_g=(s.to_g[0], ()),
+                from_g=(s.from_g[0], ()),
+                links=(s.links[0],
+                       LINK_GRACE if s.links[1] == LINK_CONN
+                       else s.links[1]),
+                crash_lost=s.crash_lost or lost)
+            steps.append(Step("game2: CRASH", nxt))
+
+        # cold restart of game 2 (fresh process, empty entity set)
+        if s.restarts_left and not s.g_alive[1]:
+            steps.append(Step(
+                "game2: cold restart -> SET_GAME_ID(cold)",
+                s._replace(g_alive=(s.g_alive[0], True),
+                           restarts_left=s.restarts_left - 1,
+                           from_g=_put(s.from_g, 1, M_HSHAKE_COLD))))
+
+        # an unregistered-but-alive target finally handshakes
+        # (the replayed-RMIG-races-rehandshake scenario, PR 9)
+        if (s.g_alive[1] and s.links[1] == LINK_UNREG
+                and M_HSHAKE_COLD not in s.from_g[1]):
+            steps.append(Step(
+                "game2: handshake SET_GAME_ID(cold)",
+                s._replace(from_g=_put(s.from_g, 1, M_HSHAKE_COLD))))
+
+        # reconnect-grace expiry on game 2 — the sweep fires on wall
+        # clock whether or not the process is back up, including the
+        # alive-but-slow-to-handshake UNKNOWN-target window
+        # (_sweep_dead_frozen_games:649-676 + _handle_game_down:1410-1424)
+        if s.links[1] == LINK_GRACE and \
+                "infinite_grace" not in cfg.mutants:
+            steps.append(self._expire_game2(s))
+
+        # unrouted-entity sweep drops parked packets for an entity no
+        # game claimed (_sweep_unrouted_entities:698-715).  The window is
+        # long (seconds) against an in-flight NOTIFY_CREATE (one RTT), so
+        # the time-free model does not race the sweep against a CREATE
+        # already on the wire.
+        if (s.route == 0 and s.parked and not s.blocked
+                and not any(M_CREATE in c for c in s.from_g)):
+            steps.append(Step(
+                "dispatcher: unrouted sweep drops parked packets",
+                s._replace(parked=())))
+
+        return steps
+
+    def _dispatcher_handle(self, s: MigState, gi: int, msg: Msg) -> Step:
+        g = f"game{gi + 1}"
+        cfg = self.cfg
+        viols: tuple[str, ...] = ()
+        if msg == M_MREQ:
+            # block E's stream, ack through the buffered path
+            # (_handle_migrate_request:1122-1134)
+            nxt = self._deliver_to_game(
+                s._replace(blocked=True), 0, M_MACK)
+            return Step(f"dispatcher: {g} MIGRATE_REQUEST -> block E, "
+                        f"ack", nxt)
+        if msg == M_CANCEL:
+            # unblock + flush parked to E's current route
+            # (_handle_cancel_migrate:1212-1218)
+            nxt = s
+            if s.route:
+                nxt = self._flush_parked(s, s.route - 1)
+            nxt = nxt._replace(blocked=False)
+            return Step(f"dispatcher: {g} CANCEL_MIGRATE -> unblock E",
+                        nxt)
+        if msg == M_CREATE:
+            # route E here, flush parked (_handle_notify_create_entity)
+            nxt = self._flush_parked(s._replace(route=gi + 1), gi)
+            return Step(f"dispatcher: {g} NOTIFY_CREATE -> route E", nxt)
+        if msg == M_RMIG:
+            return self._route_real_migrate(s)
+        if msg == M_HSHAKE_COLD:
+            # cold boot: purge the dead incarnation's routes, then flush
+            # the grace buffer to the fresh process
+            # (_handle_set_game_id:857-874 purge, 910 unblock_and_flush)
+            nxt = s
+            if nxt.route == gi + 1 and \
+                    "no_purge_cold_boot" not in cfg.mutants:
+                nxt = nxt._replace(route=0)
+            links = list(nxt.links)
+            links[gi] = LINK_CONN
+            gp = list(nxt.gpending)
+            flushed = gp[gi]
+            gp[gi] = ()
+            nxt = nxt._replace(
+                links=(links[0], links[1]),
+                gpending=(gp[0], gp[1]),
+                to_g=_put(nxt.to_g, gi, *flushed))
+            return Step(f"dispatcher: {g} cold handshake -> purge stale "
+                        f"routes, flush {len(flushed)} buffered", nxt,
+                        viols)
+        raise AssertionError(f"unmodeled dispatcher message {msg}")
+
+    def _route_real_migrate(self, s: MigState) -> Step:
+        """_handle_real_migrate (dispatcher/service.py:1146-1192): route,
+        buffer behind a grace window, or bounce the payload HOME — never
+        drop the entity's last copy."""
+        cfg = self.cfg
+        tlink = s.links[1]
+        if tlink == LINK_UNREG:
+            # unknown target: grant the standard reconnect-grace window
+            # and buffer (:1169-1176)
+            nxt = s._replace(
+                links=(s.links[0], LINK_GRACE), route=2,
+                gpending=_put(s.gpending, 1, M_RMIG))
+            nxt = self._flush_parked(nxt, 1)
+            return Step("dispatcher: REAL_MIGRATE(E) -> unknown game2, "
+                        "buffer behind grace window", nxt)
+        if tlink in (LINK_CONN, LINK_GRACE):
+            nxt = self._deliver_to_game(s._replace(route=2), 1, M_RMIG)
+            nxt = self._flush_parked(nxt, 1)
+            return Step("dispatcher: REAL_MIGRATE(E) -> route to game2",
+                        nxt)
+        # declared dead: bounce home (:1177-1192)
+        if "no_bounce" in cfg.mutants:
+            nxt = s._replace(route=0, blocked=False, parked=())
+            return Step("dispatcher: REAL_MIGRATE(E) -> target dead, "
+                        "payload DROPPED [mutant]", nxt,
+                        ("entity E's last copy dropped at the "
+                         "dispatcher (dead target, no bounce)",))
+        if s.links[0] in (LINK_CONN, LINK_GRACE):
+            nxt = self._deliver_to_game(s._replace(route=1), 0, M_RMIG)
+            nxt = self._flush_parked(nxt, 0)
+            return Step("dispatcher: REAL_MIGRATE(E) -> target dead, "
+                        "bounce HOME to game1", nxt)
+        # both ends gone: only reachable with a game-1 crash in budget
+        nxt = s._replace(route=0, blocked=False, parked=(),
+                         crash_lost=True)
+        return Step("dispatcher: REAL_MIGRATE(E) -> both ends crashed; "
+                    "state dropped", nxt)
+
+    def _expire_game2(self, s: MigState) -> Step:
+        """Grace lapse: bounce buffered REAL_MIGRATEs home, drop the
+        rest, declare the game down (purging its routes)."""
+        nxt = s
+        viols: list[str] = []
+        for msg in s.gpending[1]:
+            if msg != M_RMIG:
+                continue  # parked syncs etc. drop with the window
+            if "no_bounce" in self.cfg.mutants:
+                viols.append("entity E's last copy dropped at grace "
+                             "expiry (no bounce)")
+                nxt = nxt._replace(route=0, blocked=False, parked=())
+            elif nxt.links[0] in (LINK_CONN, LINK_GRACE):
+                nxt = self._deliver_to_game(
+                    nxt._replace(route=1), 0, M_RMIG)
+                nxt = self._flush_parked(nxt, 0)
+            else:
+                nxt = nxt._replace(route=0, crash_lost=True)
+        nxt = nxt._replace(gpending=(nxt.gpending[0], ()),
+                           links=(nxt.links[0], LINK_DEAD))
+        if nxt.route == 2:  # _handle_game_down purges dead routes
+            nxt = nxt._replace(route=0)
+        return Step("dispatcher: game2 grace window expires -> declared "
+                    "dead", nxt, tuple(viols))
+
+    def _game_handle(self, s: MigState, gi: int, msg: Msg) -> Step:
+        g = f"game{gi + 1}"
+        if msg == M_MACK:
+            # entity.py:803-847: pack state, send REAL_MIGRATE, destroy
+            # the local copy.  A cancelled request ignores the stale ack.
+            if gi == 0 and s.g1_migrate == "requested":
+                nxt = s._replace(
+                    g_has_e=(False, s.g_has_e[1]), g1_migrate="sent",
+                    from_g=_put(s.from_g, 0, M_RMIG))
+                return Step(f"{g}: MIGRATE_REQUEST_ACK -> send "
+                            f"REAL_MIGRATE(E), drop local copy", nxt)
+            return Step(f"{g}: stale MIGRATE_REQUEST_ACK ignored", s)
+        if msg == M_RMIG:
+            # game/service.py:712-725 restore_entity + the entity
+            # manager's NOTIFY_CREATE_ENTITY (entity_manager.py:503)
+            has = list(s.g_has_e)
+            has[gi] = True
+            mig = "closed" if gi == 0 else s.g1_migrate
+            nxt = s._replace(g_has_e=(has[0], has[1]), g1_migrate=mig,
+                             from_g=_put(s.from_g, gi, M_CREATE))
+            kind = "bounced home" if gi == 0 else "arrives"
+            return Step(f"{g}: REAL_MIGRATE(E) {kind} -> restore, "
+                        f"NOTIFY_CREATE", nxt)
+        if msg == M_SYNC:
+            # The PR-9 parking clause: a record must never reach a game
+            # OTHER than the one holding E's live copy.  A record for an
+            # entity with no live copy anywhere (crash-lost) is dropped
+            # by ``get_entity -> None`` (game/service.py:667-670) — a
+            # legal drop, not a mis-route.
+            viols2: tuple[str, ...] = ()
+            if not s.g_has_e[gi] and self._copies(s) >= 1:
+                viols2 = (f"sync record for E delivered to {g} while E's "
+                          f"live copy is elsewhere (stale-game delivery)",)
+            return Step(f"{g}: SYNC(E) delivered", s, viols2)
+        raise AssertionError(f"unmodeled game message {msg}")
+
+    # -- invariants ---------------------------------------------------------
+
+    def _copies(self, s: MigState) -> int:
+        chans: Iterable[Chan] = (*s.to_g, *s.from_g, *s.gpending)
+        in_flight = sum(1 for c in chans for m in c if m == M_RMIG)
+        return int(s.g_has_e[0]) + int(s.g_has_e[1]) + in_flight
+
+    def state_invariants(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, MigState)
+        s = st
+        out: list[str] = []
+        copies = self._copies(s)
+        if copies > 1:
+            out.append(f"entity E duplicated: {copies} live copies")
+        if copies == 0 and not s.crash_lost:
+            out.append("entity E vanished with no crash to blame")
+        return tuple(out)
+
+    def terminal_violations(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, MigState)
+        s = st
+        out: list[str] = []
+        hosted_alive = any(s.g_has_e[i] and s.g_alive[i] for i in (0, 1))
+        if not hosted_alive and not s.crash_lost:
+            out.append("terminal state: E is not hosted by any live game")
+        if s.route and not s.g_has_e[s.route - 1]:
+            # Route hygiene: the entity table must never keep an entry
+            # pointing at a game that does not host the entity — the
+            # cold-boot purge (_handle_set_game_id:857-874) and the
+            # game-down sweep (_handle_game_down:1410-1424) exist
+            # precisely to keep this true.
+            out.append(f"terminal state: stale routing-table entry — E "
+                       f"routed to game{s.route} which does not host it")
+        if any(M_RMIG in gp for gp in s.gpending):
+            out.append("terminal state: REAL_MIGRATE(E) stuck in a "
+                       "dispatcher buffer forever")
+        if s.blocked and all(s.g_alive):
+            out.append("terminal state: E's stream blocked forever with "
+                       "both games alive")
+        return tuple(out)
+
+
+# --- the gate-generation model ----------------------------------------------
+
+
+class GateGenState(NamedTuple):
+    bindings: frozenset[tuple[str, int]]  # (clientid, gate generation)
+    detach_chan: Chan   # dispatcher A -> game (the restart broadcast)
+    connect_chan: Chan  # dispatcher B -> game (the new client's boot)
+    c2_bound: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class GateGenConfig:
+    name: str = "gate_generation"
+    valid_gen: int = 2
+    mutants: frozenset[str] = frozenset()
+
+
+class GateGenerationModel(Model):
+    """A gate process restarts: its detach broadcast (naming the new
+    generation as valid) races the new generation's first client boot on
+    a DIFFERENT dispatcher link — the PR 9 cross-dispatcher ordering.
+    Mirrors entity/game_client.py gate_gen + entity_manager
+    .on_gate_disconnected(gateid, valid_gen)."""
+
+    def __init__(self, cfg: GateGenConfig) -> None:
+        self.cfg = cfg
+        self.name = cfg.name
+
+    def initial(self) -> GateGenState:
+        return GateGenState(
+            bindings=frozenset({("c1", 1)}),
+            detach_chan=(("NOTIFY_GATE_DISCONNECTED",
+                          str(self.cfg.valid_gen)),),
+            connect_chan=(("NOTIFY_CLIENT_CONNECTED", "c2",
+                           str(self.cfg.valid_gen)),),
+            c2_bound=False,
+        )
+
+    def actions(self, st: State) -> list[Step]:
+        assert isinstance(st, GateGenState)
+        s = st
+        steps: list[Step] = []
+        if s.detach_chan:
+            msg, rest = s.detach_chan[0], s.detach_chan[1:]
+            valid = int(msg[1])
+            viols: list[str] = []
+            if "skip_gen_check" in self.cfg.mutants:
+                dropped = s.bindings
+            else:
+                dropped = frozenset(b for b in s.bindings
+                                    if b[1] != valid)
+            for cid, gen in dropped:
+                if gen == valid:
+                    viols.append(
+                        f"detach broadcast removed live binding "
+                        f"({cid}, gen {gen}) of the VALID generation")
+            steps.append(Step(
+                f"game: detach gate bindings (valid gen {valid})",
+                s._replace(bindings=s.bindings - dropped,
+                           detach_chan=rest),
+                tuple(viols)))
+        if s.connect_chan:
+            msg, rest = s.connect_chan[0], s.connect_chan[1:]
+            cid, gen = msg[1], int(msg[2])
+            steps.append(Step(
+                f"game: bind client {cid} (gen {gen})",
+                s._replace(bindings=s.bindings | {(cid, gen)},
+                           connect_chan=rest, c2_bound=True)))
+        return steps
+
+    def terminal_violations(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, GateGenState)
+        s = st
+        out: list[str] = []
+        if ("c1", 1) in s.bindings:
+            out.append("dead-generation binding (c1, gen 1) survived "
+                       "the restart detach")
+        if s.c2_bound and ("c2", self.cfg.valid_gen) not in s.bindings:
+            out.append("valid-generation binding (c2) was detached")
+        return tuple(out)
+
+
+# --- the boot-during-link-flap model -----------------------------------------
+
+
+class BootState(NamedTuple):
+    link: str   # conn | grace | dead
+    boot: str   # pending | buffered | served | dropped
+    reconnects_left: int
+
+
+@dataclasses.dataclass(frozen=True)
+class BootConfig:
+    name: str = "boot_flap"
+    reconnects: int = 1
+    mutants: frozenset[str] = frozenset()
+
+
+class BootFlapModel(Model):
+    """A client boot request arrives while every boot-capable game is
+    mid-reconnect (dispatcher/service.py:985-1026): the request buffers
+    for the grace window and retries each tick; only a window that
+    lapses with no game drops it."""
+
+    def __init__(self, cfg: BootConfig) -> None:
+        self.cfg = cfg
+        self.name = cfg.name
+
+    def initial(self) -> BootState:
+        return BootState(link=LINK_GRACE, boot="pending",
+                         reconnects_left=self.cfg.reconnects)
+
+    def actions(self, st: State) -> list[Step]:
+        assert isinstance(st, BootState)
+        s = st
+        steps: list[Step] = []
+        if s.boot == "pending":
+            if s.link == LINK_CONN:
+                steps.append(Step("dispatcher: boot served immediately",
+                                  s._replace(boot="served")))
+            elif "drop_boot_no_game" in self.cfg.mutants:
+                steps.append(Step(
+                    "dispatcher: no game -> boot DROPPED [mutant]",
+                    s._replace(boot="dropped")))
+            else:
+                steps.append(Step(
+                    "dispatcher: no game -> buffer boot for the grace "
+                    "window (:995-1003)",
+                    s._replace(boot="buffered")))
+        if s.link == LINK_GRACE and s.reconnects_left:
+            steps.append(Step(
+                "game: reconnects within the grace window",
+                s._replace(link=LINK_CONN,
+                           reconnects_left=s.reconnects_left - 1)))
+        if s.link == LINK_GRACE:
+            steps.append(Step("dispatcher: grace window expires",
+                              s._replace(link=LINK_DEAD)))
+        if s.boot == "buffered" and s.link == LINK_CONN:
+            steps.append(Step(
+                "dispatcher: tick retry serves the buffered boot "
+                "(:1012-1026)", s._replace(boot="served")))
+        if s.boot == "buffered" and s.link == LINK_DEAD:
+            steps.append(Step(
+                "dispatcher: boot window lapsed with no game; dropped",
+                s._replace(boot="dropped")))
+        return steps
+
+    def terminal_violations(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, BootState)
+        s = st
+        if s.boot == "dropped" and s.link == LINK_CONN:
+            return ("boot request dropped even though a game "
+                    "reconnected — every boot must eventually be served",)
+        if s.boot not in ("served", "dropped"):
+            return (f"terminal state with boot still {s.boot!r}",)
+        return ()
+
+
+# --- entry points ------------------------------------------------------------
+
+
+def tier1_configs() -> list[Model]:
+    """The bounded configurations tier-1 explores exhaustively."""
+    return [
+        MigrateCrashModel(MigConfig()),
+        MigrateCrashModel(MigConfig(name="migrate_unknown_target",
+                                    target_unregistered=True)),
+        # the crashed target never comes back: grace expiry MUST bounce
+        # the payload home (this is the config that exposes a widened
+        # grace window — see the infinite_grace mutant)
+        MigrateCrashModel(MigConfig(name="migrate_no_return",
+                                    restarts=0)),
+        GateGenerationModel(GateGenConfig()),
+        BootFlapModel(BootConfig()),
+    ]
+
+
+def deep_configs() -> list[Model]:
+    """Wider bounds for the slow suite: more crash/restart/sync budget
+    around the same machine."""
+    return [
+        MigrateCrashModel(MigConfig(
+            name="migrate_crash_deep", crashes=2, restarts=2, syncs=2,
+            cancels=1)),
+        MigrateCrashModel(MigConfig(
+            name="migrate_unknown_deep", target_unregistered=True,
+            crashes=1, restarts=2, syncs=2)),
+    ]
+
+
+def check_all(models: Iterable[Model],
+              max_states: int = 1_000_000) -> list[CheckResult]:
+    return [explore(m, max_states=max_states) for m in models]
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="exhaustive cluster-protocol model checker")
+    ap.add_argument("--deep", action="store_true",
+                    help="also run the slow-suite configurations")
+    args = ap.parse_args(argv)
+    models = tier1_configs() + (deep_configs() if args.deep else [])
+    rc = 0
+    for result in check_all(models):
+        print(result.render())
+        if not result.ok:
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

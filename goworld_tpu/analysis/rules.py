@@ -29,6 +29,15 @@ review line, never a silent pass.  The rules:
   ``config/read_config.py`` exists in ``goworld.ini.sample`` and vice
   versa (numbered sections fold into their family; ``start_nodes_N``
   matches the prefix reader).
+- **R7 proto conformance** — whole-program wire-schema agreement: every
+  pack site (ordered ``append_*`` calls on a locally built Packet sent
+  with a ``MsgType.X`` literal) and every handler-side unpack site
+  (ordered ``read_*`` calls in ``dispatcher/``, ``gate/``, ``game/``,
+  ``rebalance/``, attributed per msgtype via handler tables and
+  ``msgtype == MsgType.X`` branches) must match the declared field
+  sequence in ``proto/schema.py``; the schema digest must match the
+  ``SCHEMA_HISTORY`` pin for the current ``PROTO_VERSION`` — a layout
+  edit without a version bump fails here, not in production.
 """
 
 from __future__ import annotations
@@ -1010,6 +1019,706 @@ def check_r6(modules: list[ParsedModule], root: str) -> list[Violation]:
     return out
 
 
+# --- R7: proto conformance ---------------------------------------------------
+#
+# The schema table (proto/schema.py) is re-read from the AST of the tree
+# being linted — never imported — so fixture trees lint exactly like the
+# real one.  Only the canonical digest FORMAT comes from the engine
+# (schema.digest_of), keeping the runtime digest and the lint digest
+# structurally identical by construction.
+
+_SCHEMA_PATH = "goworld_tpu/proto/schema.py"
+_MSGTYPES_PATH = "goworld_tpu/proto/msgtypes.py"
+#: where handler-side reads are attributed and checked
+_R7_UNPACK_PREFIXES = ("goworld_tpu/dispatcher/", "goworld_tpu/gate/",
+                       "goworld_tpu/game/", "goworld_tpu/rebalance/")
+#: pseudo-msgtype for ``is_gate_redirect(msgtype)`` branches: reads must
+#: stay within the [u16 gateid][cid clientid] routing prefix
+_REDIRECT_ANY = "<redirect-range>"
+
+
+class _SchemaEntry:
+    __slots__ = ("name", "value", "kinds", "raw", "gate_appended", "line")
+
+    def __init__(self, name: str, value: int, kinds: tuple[str, ...],
+                 raw: Optional[str], gate_appended: int, line: int) -> None:
+        self.name = name
+        self.value = value
+        self.kinds = kinds
+        self.raw = raw
+        self.gate_appended = gate_appended
+        self.line = line
+
+
+class _SchemaTable:
+    def __init__(self) -> None:
+        self.version: Optional[int] = None
+        self.trailer: int = 17
+        self.history: dict[int, str] = {}
+        self.history_line = 1
+        self.types: dict[str, int] = {}  # MsgType member name -> value
+        self.entries: dict[str, _SchemaEntry] = {}
+        self.redirect_min = 1001
+        self.redirect_max = 1499
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return node.value
+    return None
+
+
+def _field_tuple(node: ast.AST) -> Optional[tuple[str, str]]:
+    if (isinstance(node, ast.Tuple) and len(node.elts) == 2
+            and all(isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in node.elts)):
+        return (node.elts[0].value, node.elts[1].value)
+    return None
+
+
+def _msgtype_name(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "MsgType"):
+        return node.attr
+    return None
+
+
+def _parse_schema_table(modules: list[ParsedModule]
+                        ) -> Optional[tuple[_SchemaTable, ParsedModule]]:
+    """Extract the schema table + version constants from the linted tree's
+    own proto/schema.py and proto/msgtypes.py ASTs.  Returns None when the
+    tree has no schema module (fixture trees exercising other rules)."""
+    schema_mod = next((m for m in modules if m.path == _SCHEMA_PATH), None)
+    types_mod = next((m for m in modules if m.path == _MSGTYPES_PATH), None)
+    if schema_mod is None or types_mod is None:
+        return None
+    table = _SchemaTable()
+
+    for stmt in types_mod.tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name):
+            name, val = stmt.targets[0].id, _const_int(stmt.value)
+            if val is None:
+                continue
+            if name == "PROTO_VERSION":
+                table.version = val
+            elif name == "REDIRECT_MIN":
+                table.redirect_min = val
+            elif name == "REDIRECT_MAX":
+                table.redirect_max = val
+        elif isinstance(stmt, ast.ClassDef) and stmt.name == "MsgType":
+            for sub in stmt.body:
+                if isinstance(sub, ast.Assign) and len(sub.targets) == 1 \
+                        and isinstance(sub.targets[0], ast.Name):
+                    val = _const_int(sub.value)
+                    if val is not None:
+                        table.types[sub.targets[0].id] = val
+
+    prefix: tuple[tuple[str, str], ...] = ()
+    for stmt in schema_mod.tree.body:
+        targets: list[ast.expr] = []
+        value: Optional[ast.expr] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = list(stmt.targets), stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if not targets or value is None:
+            continue
+        tname = targets[0].id if isinstance(targets[0], ast.Name) else ""
+        if tname == "TRACE_TRAILER_BYTES":
+            v = _const_int(value)
+            if v is not None:
+                table.trailer = v
+        elif tname == "REDIRECT_PREFIX" and isinstance(value, ast.Tuple):
+            fields = [_field_tuple(e) for e in value.elts]
+            if all(f is not None for f in fields):
+                prefix = tuple(f for f in fields if f is not None)
+        elif tname == "SCHEMA_HISTORY" and isinstance(value, ast.Dict):
+            table.history_line = stmt.lineno
+            for k, v2 in zip(value.keys, value.values):
+                kv = _const_int(k) if k is not None else None
+                if kv is not None and isinstance(v2, ast.Constant) and \
+                        isinstance(v2.value, str):
+                    table.history[kv] = v2.value
+        elif tname == "SCHEMAS" and isinstance(value, ast.Tuple):
+            for call in value.elts:
+                if not isinstance(call, ast.Call):
+                    continue
+                fn = dotted(call.func) or ""
+                if fn.split(".")[-1] not in ("schema", "_redirect"):
+                    continue
+                if not call.args:
+                    continue
+                msg = _msgtype_name(call.args[0])
+                if msg is None:
+                    continue
+                fields = [f for a in call.args[1:]
+                          if (f := _field_tuple(a)) is not None]
+                if fn.split(".")[-1] == "_redirect":
+                    fields = list(prefix) + fields
+                raw: Optional[str] = None
+                gate_appended = 0
+                for kw in call.keywords:
+                    if kw.arg == "raw" and isinstance(kw.value, ast.Constant) \
+                            and isinstance(kw.value.value, str):
+                        raw = kw.value.value
+                    elif kw.arg == "gate_appended":
+                        gate_appended = _const_int(kw.value) or 0
+                table.entries[msg] = _SchemaEntry(
+                    msg, table.types.get(msg, 0),
+                    tuple(k for _n, k in fields), raw, gate_appended,
+                    call.lineno)
+    return table, schema_mod
+
+
+# -- statement-order traversal ------------------------------------------------
+#
+# R7's sequence checks linearize a function body: statements in source
+# order, each contributing only its OWN expressions (``_shallow_nodes``),
+# with compound statements recursed separately — so a read inside a loop
+# or try-block is counted exactly once, in position.
+
+
+def _stmts_in_order(body: list[ast.stmt]) -> Iterator[ast.stmt]:
+    """Yield statements in source order, descending into compound bodies
+    (If/For/While/With/Try) but never into nested def/class bodies."""
+    for stmt in body:
+        yield stmt
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list) and sub and isinstance(
+                    sub[0], ast.stmt):
+                yield from _stmts_in_order(sub)
+        for h in getattr(stmt, "handlers", []):
+            yield from _stmts_in_order(h.body)
+
+
+def _shallow_nodes(stmt: ast.stmt) -> Iterator[ast.AST]:
+    """Every expression node belonging directly to ``stmt`` — child
+    statements excluded (they are yielded by _stmts_in_order on their
+    own turn, so nothing is visited twice)."""
+    todo: list[ast.AST] = []
+    for _field, value in ast.iter_fields(stmt):
+        if isinstance(value, list):
+            todo.extend(v for v in value
+                        if isinstance(v, ast.AST)
+                        and not isinstance(v, (ast.stmt, ast.excepthandler)))
+        elif isinstance(value, ast.AST):
+            todo.append(value)
+    while todo:
+        node = todo.pop()
+        yield node
+        todo.extend(c for c in ast.iter_child_nodes(node)
+                    if not isinstance(c, ast.stmt))
+
+
+def _append_chains(stmt: ast.stmt) -> list[tuple[str, list[str]]]:
+    """(base var, [append kinds in eval order]) for every append chain in
+    one statement's own expressions.  ``#raw`` marks append_bytes (a
+    raw-region write)."""
+    from goworld_tpu.proto.schema import APPEND_TO_KIND
+
+    def is_append(n: ast.AST) -> bool:
+        return (isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr.startswith("append_"))
+
+    calls = [n for n in _shallow_nodes(stmt) if is_append(n)]
+    # a call that appears as another append's receiver is an inner chain
+    # link; the remaining calls are chain ROOTS (outermost links)
+    inner = {id(c.func.value) for c in calls  # type: ignore[union-attr]
+             if is_append(c.func.value)}  # type: ignore[union-attr]
+    out: list[tuple[str, list[str]]] = []
+    for root in sorted((c for c in calls if id(c) not in inner),
+                       key=lambda c: (c.lineno, c.col_offset)):
+        chain: list[ast.Call] = []
+        cur: ast.AST = root
+        while is_append(cur):
+            chain.append(cur)  # type: ignore[arg-type]
+            cur = cur.func.value  # type: ignore[union-attr]
+        base = dotted(cur)
+        if base is None:
+            continue
+        kinds = [APPEND_TO_KIND.get(
+            c.func.attr, "#raw" if c.func.attr == "append_bytes"
+            else f"?{c.func.attr}")
+            for c in reversed(chain)]  # eval order: innermost first
+        out.append((base, kinds))
+    return out
+
+
+def _packet_helpers(mod: ParsedModule) -> dict[str, list[str]]:
+    """Defs that build one Packet, append fixed kinds, and return it —
+    resolvable as pack-prefix seeds (conn.py ``_client_packet``)."""
+    out: dict[str, list[str]] = {}
+    for _scope, fn in walk_scoped(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        var: Optional[str] = None
+        kinds: list[str] = []
+        returned = False
+        for stmt in _stmts_in_order(fn.body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    (dotted(stmt.value.func) or "").split(".")[-1] == \
+                    "Packet" and not stmt.value.args:
+                var = stmt.targets[0].id
+            for base, ks in _append_chains(stmt):
+                if base == var:
+                    kinds.extend(ks)
+            if isinstance(stmt, ast.Return) and var is not None and \
+                    isinstance(stmt.value, ast.Name) and \
+                    stmt.value.id == var:
+                returned = True
+        if var is not None and returned:
+            out[fn.name] = kinds
+    return out
+
+
+class _PackSite:
+    __slots__ = ("msg", "kinds", "raw", "line")
+
+    def __init__(self, msg: str, kinds: Optional[list[str]], raw: bool,
+                 line: int) -> None:
+        self.msg = msg
+        self.kinds = kinds
+        self.raw = raw
+        self.line = line
+
+
+def _pack_sites(mod: ParsedModule,
+                helpers: dict[str, list[str]]) -> list[_PackSite]:
+    sites: list[_PackSite] = []
+    for _scope, fn in walk_scoped(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        tracked: dict[str, Optional[list[str]]] = {}  # None = raw-built
+        for stmt in _stmts_in_order(fn.body):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                    isinstance(stmt.targets[0], ast.Name) and \
+                    isinstance(stmt.value, ast.Call):
+                tgt = stmt.targets[0].id
+                callee = (dotted(stmt.value.func) or "").split(".")[-1]
+                if callee == "Packet":
+                    tracked[tgt] = [] if not stmt.value.args else None
+                elif callee in helpers:
+                    tracked[tgt] = list(helpers[callee])
+            for base, ks in _append_chains(stmt):
+                cur = tracked.get(base)
+                if cur is not None:
+                    cur.extend(ks)
+            for node in _shallow_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = next((m for a in node.args
+                            if (m := _msgtype_name(a)) is not None), None)
+                if msg is None:
+                    continue
+                attr = node.func.attr if isinstance(
+                    node.func, ast.Attribute) else ""
+                packet_arg: Optional[ast.expr] = None
+                for a in node.args:
+                    if _msgtype_name(a) is not None:
+                        continue
+                    if isinstance(a, ast.Name) and a.id in tracked:
+                        packet_arg = a
+                        break
+                    if isinstance(a, ast.Call) and (
+                            dotted(a.func) or "").split(".")[-1] == "Packet":
+                        packet_arg = a
+                        break
+                if packet_arg is None:
+                    if attr == "send_packet_raw":
+                        sites.append(_PackSite(msg, None, True, node.lineno))
+                    continue  # forwarding a received packet: not a pack site
+                if isinstance(packet_arg, ast.Name):
+                    kinds = tracked[packet_arg.id]
+                    sites.append(_PackSite(
+                        msg, list(kinds) if kinds is not None else None,
+                        kinds is None, node.lineno))
+                else:  # inline Packet(...) construction
+                    if packet_arg.args:
+                        sites.append(_PackSite(msg, None, True, node.lineno))
+                    else:
+                        sites.append(_PackSite(msg, [], False, node.lineno))
+    return sites
+
+
+# -- unpack-side extraction ---------------------------------------------------
+
+
+def _handler_tables(mod: ParsedModule) -> dict[str, str]:
+    """{method qualname: msgtype name} from class-level ``_HANDLERS``
+    (or any ``*_HANDLERS``) dict literals mapping MsgType.X to methods."""
+    out: dict[str, str] = {}
+    for scope, node in walk_scoped(mod.tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id.endswith("_HANDLERS")
+                and isinstance(node.value, ast.Dict)):
+            continue
+        for k, v in zip(node.value.keys, node.value.values):
+            msg = _msgtype_name(k) if k is not None else None
+            vname = dotted(v) if v is not None else None
+            if msg and vname:
+                tail = vname.split(".")[-1]
+                qual = f"{scope}.{tail}" if scope else tail
+                out[qual] = msg
+    return out
+
+
+def _branch_test_msg(test: ast.expr) -> Optional[str]:
+    """``msgtype == MsgType.X`` -> "X"; ``is_gate_redirect(msgtype)`` ->
+    the redirect pseudo-type; anything else -> None."""
+    if (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.Eq)):
+        for side in (test.left, test.comparators[0]):
+            msg = _msgtype_name(side)
+            if msg is not None:
+                return msg
+    if isinstance(test, ast.Call):
+        d = (dotted(test.func) or "").split(".")[-1]
+        if d == "is_gate_redirect":
+            return _REDIRECT_ANY
+    return None
+
+
+#: read item: (tag, msgtype-or-"" , varkey, kind).  kind "#rest" =
+#: read_rest, "#bytes" = read_bytes, "#reset" = set_read_pos(0).
+_ReadItem = tuple[str, str, str, str]
+
+
+def _read_kind(node: ast.AST, packet_vars: set[str]) -> Optional[
+        tuple[str, str]]:
+    """(var, kind) when ``node`` is a cursor operation on a tracked
+    packet var; kinds ``#rest``/``#bytes``/``#reset`` are markers."""
+    from goworld_tpu.proto.schema import READ_TO_KIND
+
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)):
+        return None
+    base = dotted(node.func.value)
+    if base not in packet_vars:
+        return None
+    attr = node.func.attr
+    if attr in READ_TO_KIND:
+        return (base, READ_TO_KIND[attr])
+    if attr == "read_rest":
+        return (base, "#rest")
+    if attr == "read_bytes":
+        return (base, "#bytes")
+    if attr == "set_read_pos":
+        return (base, "#reset")
+    return None
+
+
+def _linear_reads(fn: ast.AST, packet_params: set[str],
+                  module_defs: dict[str, ast.AST],
+                  depth: int = 0) -> Optional[list[tuple[str, str]]]:
+    """Branch-free read sequence [(varkey, kind)] of a helper, inlining
+    one further level of same-module calls.  None when the helper
+    branches on msgtype (it is then checked standalone, not inlined)."""
+    out: list[tuple[str, str]] = []
+    vars_ = set(packet_params)
+    for stmt in _stmts_in_order(fn.body):
+        if isinstance(stmt, ast.If) and _branch_test_msg(stmt.test):
+            return None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and \
+                isinstance(stmt.targets[0], ast.Name) and \
+                isinstance(stmt.value, ast.Call) and \
+                (dotted(stmt.value.func) or "").split(".")[-1] == \
+                "Packet" and any(
+                    isinstance(n, ast.Name) and n.id in vars_
+                    for n in ast.walk(stmt.value)):
+            vars_.add(stmt.targets[0].id)
+        for node in _shallow_nodes(stmt):
+            got = _read_kind(node, vars_)
+            if got is not None:
+                out.append(got)
+                continue
+            if depth == 0 and isinstance(node, ast.Call):
+                out.extend(_maybe_inline(node, vars_, module_defs, depth))
+    return out
+
+
+def _maybe_inline(node: ast.Call, packet_vars: set[str],
+                  module_defs: dict[str, ast.AST],
+                  depth: int) -> list[tuple[str, str]]:
+    """Reads a same-module helper performs on a packet passed to it,
+    re-keyed onto the caller's variable."""
+    tail = (dotted(node.func) or "").split(".")[-1]
+    target = module_defs.get(tail)
+    if target is None:
+        return []
+    pos = next((i for i, a in enumerate(node.args)
+                if isinstance(a, ast.Name) and a.id in packet_vars), None)
+    if pos is None:
+        return []
+    arg = node.args[pos]
+    assert isinstance(arg, ast.Name)
+    params = [a.arg for a in _all_args(target)]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    if pos >= len(params):
+        return []
+    sub = _linear_reads(target, {params[pos]}, module_defs, depth + 1)
+    if sub is None:
+        return []
+    return [(arg.id, kind) for _var, kind in sub]
+
+
+def _unpack_sequences(mod: ParsedModule) -> list[tuple[str, str, int,
+                                                       list[list[str]]]]:
+    """Per checked function: (msgtype name, symbol, line, read segments).
+
+    A function contributes when it appears in a ``*_HANDLERS`` table (its
+    whole body reads that one msgtype) and/or contains ``msgtype ==
+    MsgType.X`` branches (reads inside the branch attribute to X; reads
+    outside attribute to every msgtype the function handles).  Segments
+    split on ``set_read_pos(0)`` and on peek-vars built via
+    ``Packet(packet.payload)``; each is prefix-checked from offset 0."""
+    tables = _handler_tables(mod)
+    module_defs: dict[str, ast.AST] = {}
+    for _scope, node in walk_scoped(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_defs.setdefault(node.name, node)
+
+    results: list[tuple[str, str, int, list[list[str]]]] = []
+    for scope, fn in walk_scoped(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        qual = f"{scope}.{fn.name}" if scope else fn.name
+        table_msg = tables.get(qual)
+        packet_params = {a.arg for a in _all_args(fn)
+                         if a.arg in ("packet", "pkt")}
+        if not packet_params:
+            continue
+
+        items: list[_ReadItem] = []
+        vars_ = set(packet_params)
+        branch_msgs: list[str] = []
+
+        def emit(node: ast.AST, branch: str) -> None:
+            got = _read_kind(node, vars_)
+            if got is not None:
+                items.append((branch, "", got[0], got[1]))
+                return
+            if isinstance(node, ast.Call):
+                for var, kind in _maybe_inline(node, vars_,
+                                               module_defs, 0):
+                    items.append((branch, "", var, kind))
+
+        def collect(body: list[ast.stmt], branch: str) -> None:
+            for stmt in body:
+                if isinstance(stmt, ast.If):
+                    msg = _branch_test_msg(stmt.test)
+                    if msg is not None:
+                        if msg not in branch_msgs:
+                            branch_msgs.append(msg)
+                        collect(stmt.body, msg)
+                        collect(stmt.orelse, branch)
+                        continue
+                    # non-msgtype If: reads in the TEST run on this path
+                    for node in ast.walk(stmt.test):
+                        emit(node, branch)
+                    collect(stmt.body, branch)
+                    collect(stmt.orelse, branch)
+                    continue
+                if isinstance(stmt, ast.Assign) and \
+                        len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name) and \
+                        isinstance(stmt.value, ast.Call) and \
+                        (dotted(stmt.value.func) or ""
+                         ).split(".")[-1] == "Packet" and any(
+                            isinstance(n, ast.Name) and n.id in vars_
+                            for n in ast.walk(stmt.value)):
+                    vars_.add(stmt.targets[0].id)
+                for node in _shallow_nodes(stmt):
+                    emit(node, branch)
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if isinstance(sub, list) and sub and isinstance(
+                            sub[0], ast.stmt):
+                        collect(sub, branch)
+                for h in getattr(stmt, "handlers", []):
+                    collect(h.body, branch)
+
+        collect(fn.body, "")
+
+        targets = list(branch_msgs)
+        if table_msg is not None and table_msg not in targets:
+            targets.insert(0, table_msg)
+        if not targets:
+            continue
+        for msg in targets:
+            segments: list[list[str]] = []
+            seg_of: dict[str, list[str]] = {}
+            for branch, _x, var, kind in items:
+                if branch not in ("", msg):
+                    continue
+                if kind == "#reset":
+                    seg_of.pop(var, None)
+                    continue
+                seg = seg_of.get(var)
+                if seg is None:
+                    seg = seg_of[var] = []
+                    segments.append(seg)
+                seg.append(kind)
+            results.append((msg, qual, fn.lineno, segments))
+    return results
+
+
+def check_r7(modules: list[ParsedModule], root: str) -> list[Violation]:
+    from goworld_tpu.proto import schema as engine_schema
+
+    parsed = _parse_schema_table(modules)
+    if parsed is None:
+        return []
+    table, schema_mod = parsed
+    out: list[Violation] = []
+
+    # 1. every MsgType member has a schema
+    for name, value in sorted(table.types.items()):
+        if name not in table.entries:
+            out.append(schema_mod.violation(
+                "R7", 1,
+                f"MsgType.{name} ({value}) has no wire schema — declare "
+                f"its payload layout in proto/schema.py"))
+
+    # 2. digest pin: the layout table must match SCHEMA_HISTORY for the
+    # CURRENT version — layout edits land as (bump, new digest) pairs.
+    if table.version is not None:
+        digest = engine_schema.digest_of(
+            table.version,
+            [(e.name, e.value, e.kinds, e.raw)
+             for e in table.entries.values()],
+            table.trailer)
+        pinned = table.history.get(table.version)
+        if pinned is None:
+            out.append(schema_mod.violation(
+                "R7", table.history_line,
+                f"SCHEMA_HISTORY has no digest for PROTO_VERSION "
+                f"{table.version} — append the pair (and keep earlier "
+                f"entries)"))
+        elif pinned != digest:
+            out.append(schema_mod.violation(
+                "R7", table.history_line,
+                f"wire-schema digest {digest} does not match the pinned "
+                f"{pinned} for PROTO_VERSION {table.version} — a payload "
+                f"layout changed: bump PROTO_VERSION in proto/msgtypes.py "
+                f"and append the new (version, digest) pair to "
+                f"SCHEMA_HISTORY"))
+
+    # 3. pack sites across the whole package
+    packed: set[str] = set()
+    for mod in modules:
+        if mod.path == _SCHEMA_PATH:
+            continue
+        helpers = _packet_helpers(mod)
+        for site in _pack_sites(mod, helpers):
+            sch = table.entries.get(site.msg)
+            if sch is None:
+                if site.msg in table.types:
+                    out.append(mod.violation(
+                        "R7", site.line,
+                        f"packs MsgType.{site.msg} which has no wire "
+                        f"schema in proto/schema.py"))
+                continue
+            packed.add(site.msg)
+            if site.raw:
+                if sch.raw is None and sch.kinds:
+                    out.append(mod.violation(
+                        "R7", site.line,
+                        f"MsgType.{site.msg} is sent as a raw payload but "
+                        f"its schema declares fields {sch.kinds} — build "
+                        f"it with the typed appends or declare a raw "
+                        f"region"))
+                continue
+            kinds = list(site.kinds or [])
+            expect = list(sch.kinds)
+            if kinds and kinds[-1] == "#raw":
+                if sch.raw is None:
+                    out.append(mod.violation(
+                        "R7", site.line,
+                        f"MsgType.{site.msg}: trailing append_bytes but "
+                        f"the schema declares no raw region"))
+                    continue
+                kinds = kinds[:-1]
+            ok = kinds == expect or (
+                sch.gate_appended
+                and kinds == expect[:len(expect) - sch.gate_appended])
+            if not ok:
+                out.append(mod.violation(
+                    "R7", site.line,
+                    f"MsgType.{site.msg} packed as {kinds} but the wire "
+                    f"schema declares {expect} — sender/receiver drift; "
+                    f"fix the site or update proto/schema.py (and bump "
+                    f"PROTO_VERSION)"))
+
+    # 4. schema coverage: a declared layout nobody packs is drift too
+    for name, e in sorted(table.entries.items()):
+        if name not in packed and name in table.types:
+            out.append(schema_mod.violation(
+                "R7", e.line,
+                f"MsgType.{name} has a declared schema but no pack site "
+                f"anywhere in the package — dead layout or a sender the "
+                f"extractor cannot see (baseline with a reason if so)"))
+
+    # 5. handler-side reads in dispatcher/gate/game/rebalance
+    redirect_prefix = ["u16", "cid"]
+    for mod in modules:
+        if not mod.path.startswith(_R7_UNPACK_PREFIXES):
+            continue
+        for msg, qual, line, segments in _unpack_sequences(mod):
+            if msg == _REDIRECT_ANY:
+                expect, raw = redirect_prefix, "redirect-payload"
+            else:
+                sch = table.entries.get(msg)
+                if sch is None:
+                    if msg in table.types:
+                        out.append(mod.violation(
+                            "R7", line,
+                            f"handles MsgType.{msg} which has no wire "
+                            f"schema in proto/schema.py"))
+                    continue
+                expect, raw = list(sch.kinds), sch.raw
+            for seg in segments:
+                err = _match_read_segment(seg, expect, raw)
+                if err:
+                    out.append(mod.violation(
+                        "R7", line,
+                        f"{qual} reads MsgType.{msg} as {seg} but the "
+                        f"wire schema declares {expect}"
+                        f"{' + raw ' + raw if raw else ''} — {err}"))
+    return out
+
+
+def _match_read_segment(seg: list[str], expect: list[str],
+                        raw: Optional[str]) -> Optional[str]:
+    """A read segment must consume declared fields in order from offset 0
+    (stopping early is fine; ``read_rest`` swallows the remainder)."""
+    i = 0
+    for kind in seg:
+        if kind == "#rest":
+            return None
+        if i >= len(expect):
+            if raw and kind == "#bytes":
+                continue
+            return (f"position {i} reads past the declared layout")
+        if kind == "#bytes":
+            return (f"position {i}: fixed read_bytes over a structured "
+                    f"field {expect[i]!r}")
+        if kind != expect[i]:
+            return (f"position {i} expects {expect[i]!r}, handler reads "
+                    f"{kind!r}")
+        i += 1
+    return None
+
+
 CHECKERS = {
     "R1": check_r1,
     "R2": check_r2,
@@ -1017,4 +1726,5 @@ CHECKERS = {
     "R4": check_r4,
     "R5": check_r5,
     "R6": check_r6,
+    "R7": check_r7,
 }

@@ -852,6 +852,14 @@ class DispatcherService:
         is_restore = packet.read_bool()
         is_ban_boot = packet.read_bool()
         entity_ids = packet.read_data()
+        if not isinstance(entity_ids, list) or not all(
+                isinstance(e, str) for e in entity_ids):
+            # Parser contract (gwlint R3 / the schema fuzz): hostile or
+            # corrupt payloads raise ValueError, never leak a TypeError
+            # out of the reconciliation loop below.
+            raise ValueError(
+                f"SET_GAME_ID from game {gameid}: entity list is "
+                f"{type(entity_ids).__name__}, expected list[str]")
         if not self._check_proto_version(proxy, packet, f"game {gameid}"):
             return
         if not is_reconnect and not is_restore:
@@ -1335,9 +1343,11 @@ class DispatcherService:
         choose-game heap (cpu, as GAME_LBC_INFO did), the planner's
         report table, and the game_load_score gauge."""
         from goworld_tpu import rebalance
-        from goworld_tpu.rebalance.report import load_score
+        from goworld_tpu.rebalance.report import coerce_report, load_score
 
-        report = packet.read_data()
+        # coerce_report validates shape + numeric fields (ValueError on
+        # anything malformed — the wire-parser contract).
+        report = coerce_report(packet.read_data())
         gameid = self._gameid_of(proxy)
         if not gameid:
             return

@@ -714,6 +714,10 @@ class GameService:
             packet.read_uint16()
             raw_len = packet.unread_len()
             data = packet.read_data()
+            if not isinstance(data, dict):
+                raise ValueError(
+                    f"REAL_MIGRATE body for {eid} is "
+                    f"{type(data).__name__}, expected dict")
             self._migrate_in_count += 1
             self._migrate_in_bytes += raw_len
             if raw_len > self._migrate_in_max:
@@ -738,6 +742,10 @@ class GameService:
                 ns.on_call_from_remote(method, args, None)
         elif msgtype == MsgType.SET_GAME_ID_ACK:
             ack = packet.read_data()
+            if not isinstance(ack, dict):
+                raise ValueError(
+                    f"SET_GAME_ID_ACK body is {type(ack).__name__}, "
+                    f"expected dict")
             self._handle_set_game_id_ack(ack)
         elif msgtype == MsgType.NOTIFY_GAME_CONNECTED:
             self.online_games.add(packet.read_uint16())

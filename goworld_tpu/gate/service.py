@@ -598,6 +598,10 @@ class GateService:
             return  # client already gone; drop quietly (reference behavior)
         rest = packet.read_rest()
         if msgtype == MsgType.CREATE_ENTITY_ON_CLIENT:
+            if len(rest) < 17:  # bool is_player + eid(16), proto/schema.py
+                raise ValueError(
+                    f"CREATE_ENTITY_ON_CLIENT payload truncated "
+                    f"({len(rest)} bytes after the redirect prefix)")
             is_player = rest[0] != 0
             if is_player:
                 cp.owner_eid = rest[1:17].decode("ascii")

@@ -27,6 +27,16 @@ _F32 = struct.Struct("<f")
 _F64 = struct.Struct("<d")
 
 
+class PacketReadError(ValueError, IndexError):
+    """A read past the end of a packet payload (truncated/hostile frame).
+
+    Subclasses BOTH ValueError (the parser contract every wire module
+    follows — gwlint R3, and the schema fuzz in tests/test_modelcheck.py:
+    short or mutated buffers raise ValueError, never a bare struct.error
+    or IndexError) and IndexError (what this seam raised historically, so
+    existing catchers keep working)."""
+
+
 class Packet:
     """Append-only write + cursor read packet payload.
 
@@ -153,7 +163,7 @@ class Packet:
 
     def _take(self, n: int) -> memoryview:
         if self._rpos + n > len(self._buf):
-            raise IndexError("packet read overflow")
+            raise PacketReadError("packet read overflow")
         mv = memoryview(self._buf)[self._rpos : self._rpos + n]
         self._rpos += n
         return mv
@@ -198,7 +208,17 @@ class Packet:
         return self.read_entity_id()
 
     def read_data(self):
-        return msgpack.unpackb(self.read_varbytes(), raw=False)
+        blob = self.read_varbytes()
+        try:
+            return msgpack.unpackb(blob, raw=False)
+        except ValueError:
+            raise
+        except Exception as exc:
+            # msgpack's truncation/garbage errors are mostly ValueError
+            # subclasses already; normalize the stragglers (OutOfData,
+            # BufferFull derive from bare UnpackException) so every wire
+            # parser keeps the raise-ValueError contract.
+            raise PacketReadError(f"malformed msgpack payload: {exc}") from exc
 
     def read_args(self) -> list:
         n = self.read_uint16()

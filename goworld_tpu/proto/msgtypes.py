@@ -20,6 +20,13 @@ import enum
 # change (e.g. the round-3 migrate-nonce addition) so a mixed-version
 # dispatcher/game pair — mid rolling upgrade, or a dispatcher not restarted
 # during `reload` — fails loudly at connect instead of mis-framing packets.
+#
+# The AUTHORITATIVE payload layouts live in proto/schema.py (one field
+# sequence per MsgType), checked against every pack/unpack site by gwlint
+# R7 — which also pins a digest of the whole table per version
+# (SCHEMA_HISTORY), so forgetting this bump on a layout change fails the
+# lint instead of a production rollout.  The per-version notes below stay
+# as the human changelog of WHY each bump happened.
 # v3: cluster-link HEARTBEAT + liveness kills — a v2 peer would neither
 # send heartbeats nor expect them, so a v3 end would kill its (healthy)
 # idle links; fail the mixed pair at the handshake instead.

@@ -46,6 +46,43 @@ def build_load_report(game_service) -> dict:
     }
 
 
+def coerce_report(report: object) -> dict:
+    """Validate a wire-received load report (dispatcher seam).  Raises
+    ValueError — never TypeError — on any malformed shape, so a corrupt
+    or hostile GAME_LOAD_REPORT keeps the raise-ValueError parser
+    contract (gwlint R3 / the schema fuzz in tests/test_modelcheck.py).
+    Returns the report with the numeric keys coerced to float/int."""
+    if not isinstance(report, dict):
+        raise ValueError(
+            f"load report is {type(report).__name__}, expected dict")
+    out = dict(report)
+    try:
+        for key in ("cpu", "tick_p95_ms"):
+            out[key] = float(report.get(key, 0.0))
+        for key in ("entities", "queue_depth"):
+            out[key] = int(report.get(key, 0))
+    except (TypeError, ValueError) as exc:
+        raise ValueError(f"malformed load report field: {exc}") from exc
+    spaces = out.get("spaces", [])
+    if not isinstance(spaces, list):
+        raise ValueError("load report 'spaces' is not a list")
+    rows = []
+    for row in spaces:
+        # a malformed row would otherwise TypeError inside the planner's
+        # unpack (`for sid, kind, count in ...`) — in the dispatcher TICK
+        # loop, where an escape kills the task, not just one packet
+        if not isinstance(row, (list, tuple)) or len(row) != 3:
+            raise ValueError(f"load report space row malformed: {row!r}")
+        sid, kind, count = row
+        try:
+            rows.append([str(sid), int(kind), int(count)])
+        except (TypeError, ValueError) as exc:
+            raise ValueError(
+                f"load report space row malformed: {exc}") from exc
+    out["spaces"] = rows
+    return out
+
+
 def load_score(report: dict) -> float:
     """Scalar load score. Entity count is the backbone (it is exact and
     moves exactly when the rebalancer acts); cpu, tick-p95 and queue depth

@@ -382,6 +382,243 @@ def test_r6_covers_rebalance_and_client_sections():
     assert "rpc_timeout" in fams["client"]
 
 
+# --- R7: proto conformance ---------------------------------------------------
+
+
+def _r7_fixture(tmp_path, *, sender: str | None = None,
+                handler: str | None = None,
+                schema_body: str | None = None,
+                history_digest: str | None = None) -> core.LintResult:
+    """A minimal tree R7 can lint: msgtypes + schema + one sender + one
+    handler module, with the digest computed the same way the engine
+    does unless overridden."""
+    from goworld_tpu.proto.schema import digest_of
+
+    msgtypes = (
+        "PROTO_VERSION = 9\n"
+        "REDIRECT_MIN = 1001\n"
+        "REDIRECT_MAX = 1499\n"
+        "class MsgType:\n"
+        "    PING = 1\n"
+        "    PONG = 2\n"
+    )
+    if schema_body is None:
+        schema_body = (
+            'SCHEMAS = (\n'
+            '    schema(MsgType.PING, ("eid", "eid"), ("nonce", "u32")),\n'
+            '    schema(MsgType.PONG, ("nonce", "u32")),\n'
+            ')\n')
+        entries = [("PING", 1, ("eid", "u32"), None),
+                   ("PONG", 2, ("u32",), None)]
+    else:
+        entries = None
+    if history_digest is None:
+        history_digest = digest_of(9, entries) if entries else "feedface"
+    schema_src = (
+        "TRACE_TRAILER_BYTES = 17\n"
+        'REDIRECT_PREFIX = (("gateid", "u16"), ("clientid", "cid"))\n'
+        + schema_body
+        + f'SCHEMA_HISTORY = {{9: "{history_digest}"}}\n')
+    if sender is None:
+        sender = (
+            "from goworld_tpu.netutil.packet import Packet\n"
+            "from goworld_tpu.proto.msgtypes import MsgType\n"
+            "def send_ping(conn, eid, nonce):\n"
+            "    p = Packet()\n"
+            "    p.append_entity_id(eid)\n"
+            "    p.append_uint32(nonce)\n"
+            "    conn.send(MsgType.PING, p)\n"
+            "def send_pong(conn, nonce):\n"
+            "    p = Packet()\n"
+            "    p.append_uint32(nonce)\n"
+            "    conn.send(MsgType.PONG, p)\n")
+    if handler is None:
+        handler = (
+            "from goworld_tpu.proto.msgtypes import MsgType\n"
+            "class Svc:\n"
+            "    def _handle_ping(self, proxy, packet):\n"
+            "        eid = packet.read_entity_id()\n"
+            "        nonce = packet.read_uint32()\n"
+            "    _HANDLERS = {MsgType.PING: _handle_ping}\n")
+    return _lint_snippet(
+        tmp_path, "goworld_tpu/proto/schema.py", schema_src, ("R7",),
+        extra={
+            "goworld_tpu/proto/msgtypes.py": msgtypes,
+            "goworld_tpu/net.py": sender,
+            "goworld_tpu/dispatcher/svc.py": handler,
+        })
+
+
+def test_r7_clean_fixture_tree(tmp_path):
+    r = _r7_fixture(tmp_path)
+    assert r.ok, _messages(r)
+
+
+def test_r7_flags_pack_site_field_drop(tmp_path):
+    sender = (
+        "from goworld_tpu.netutil.packet import Packet\n"
+        "from goworld_tpu.proto.msgtypes import MsgType\n"
+        "def send_ping(conn, eid, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_entity_id(eid)\n"   # nonce append dropped
+        "    conn.send(MsgType.PING, p)\n"
+        "def send_pong(conn, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_uint32(nonce)\n"
+        "    conn.send(MsgType.PONG, p)\n")
+    r = _r7_fixture(tmp_path, sender=sender)
+    msgs = "\n".join(_messages(r))
+    assert "MsgType.PING packed as ['eid']" in msgs, msgs
+
+
+def test_r7_flags_handler_read_order(tmp_path):
+    handler = (
+        "from goworld_tpu.proto.msgtypes import MsgType\n"
+        "class Svc:\n"
+        "    def _handle_ping(self, proxy, packet):\n"
+        "        nonce = packet.read_uint32()\n"  # fields swapped
+        "        eid = packet.read_entity_id()\n"
+        "    _HANDLERS = {MsgType.PING: _handle_ping}\n")
+    r = _r7_fixture(tmp_path, handler=handler)
+    msgs = "\n".join(_messages(r))
+    assert "position 0 expects 'eid'" in msgs, msgs
+
+
+def test_r7_flags_digest_drift_and_missing_schema(tmp_path):
+    # same layout, wrong pinned digest: the bump-forgotten failure mode
+    r = _r7_fixture(tmp_path, history_digest="0123456789abcdef")
+    msgs = "\n".join(_messages(r))
+    assert "does not match the pinned" in msgs, msgs
+    assert "bump PROTO_VERSION" in msgs, msgs
+    # a type with no declared layout at all
+    r2 = _r7_fixture(tmp_path / "b", schema_body=(
+        'SCHEMAS = (\n'
+        '    schema(MsgType.PING, ("eid", "eid"), ("nonce", "u32")),\n'
+        ')\n'))
+    msgs2 = "\n".join(_messages(r2))
+    assert "MsgType.PONG" in msgs2 and "no wire schema" in msgs2, msgs2
+
+
+def test_r7_inline_pragma_suppresses_with_reason(tmp_path):
+    sender = (
+        "from goworld_tpu.netutil.packet import Packet\n"
+        "from goworld_tpu.proto.msgtypes import MsgType\n"
+        "def send_ping(conn, eid, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_entity_id(eid)\n"
+        "    conn.send(MsgType.PING, p)"
+        "  # gwlint: ok R7 fixture — trailing nonce appended downstream\n"
+        "def send_pong(conn, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_uint32(nonce)\n"
+        "    conn.send(MsgType.PONG, p)\n")
+    r = _r7_fixture(tmp_path, sender=sender)
+    assert r.ok, _messages(r)
+    assert len(r.suppressed) == 1
+
+
+def test_r7_baseline_suppression_with_reason(tmp_path):
+    """R7 findings ride the same symbol-keyed baseline + stale-entry
+    ratchet as every other rule (the ISSUE 11 suppression-audit
+    satellite)."""
+    sender = (
+        "from goworld_tpu.netutil.packet import Packet\n"
+        "from goworld_tpu.proto.msgtypes import MsgType\n"
+        "def send_ping(conn, eid, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_entity_id(eid)\n"
+        "    conn.send(MsgType.PING, p)\n"
+        "def send_pong(conn, nonce):\n"
+        "    p = Packet()\n"
+        "    p.append_uint32(nonce)\n"
+        "    conn.send(MsgType.PONG, p)\n")
+    _r7_fixture(tmp_path, sender=sender)  # writes the tree
+    bl = tmp_path / "baseline.toml"
+    bl.write_text(
+        '[[suppress]]\nrule = "R7"\npath = "goworld_tpu/net.py"\n'
+        'symbol = "send_ping"\n'
+        'reason = "fixture: nonce is appended by a downstream proxy"\n')
+    r = core.run_lint(str(tmp_path), baseline_path=str(bl), rules=("R7",))
+    assert r.ok, _messages(r)
+    assert len(r.suppressed) == 1 and not r.stale_baseline
+
+
+# --- R7 + model checker mutation harness on the REAL tree --------------------
+#
+# Seeded protocol mutants over the committed sources prove the gates
+# have teeth: each mutant must be caught by R7 (layout drift) — the
+# model-checker mutants live in tests/test_modelcheck.py.
+
+
+def _mutated_package(tmp_path, path: str, old: str, new: str):
+    """The real package's parsed modules with ONE source mutation applied
+    (via a real ParsedModule so pragmas/scopes behave identically)."""
+    mods = core.parse_package(REPO_ROOT)
+    i = next(i for i, m in enumerate(mods) if m.path == path)
+    src = mods[i].source.replace(old, new)
+    assert src != mods[i].source, f"mutation did not apply to {path}"
+    dst = tmp_path / path
+    dst.parent.mkdir(parents=True, exist_ok=True)
+    dst.write_text(src)
+    mods[i] = core.ParsedModule(str(tmp_path), str(dst))
+    assert mods[i].path == path
+    return mods
+
+
+def _r7(mods):
+    from goworld_tpu.analysis.rules import check_r7
+
+    return check_r7(mods, REPO_ROOT)
+
+
+def test_mutant_dropped_pack_field_caught(tmp_path):
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/proto/conn.py",
+        "        p.append_uint16(space_gameid)\n"
+        "        p.append_uint32(nonce)\n"
+        "        self.send(MsgType.MIGRATE_REQUEST, p)",
+        "        p.append_uint16(space_gameid)\n"
+        "        self.send(MsgType.MIGRATE_REQUEST, p)")
+    assert any("MIGRATE_REQUEST packed as" in v.message
+               for v in _r7(mods))
+
+
+def test_mutant_reordered_handshake_fields_caught(tmp_path):
+    """Re-introducing the v5 footgun backwards (gen before fresh) is
+    exactly the drift the SET_GATE_ID comment used to guard by prose."""
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/proto/conn.py",
+        "        p.append_uint16(gateid)\n"
+        "        p.append_bool(fresh)\n"
+        "        p.append_uint32(gen)",
+        "        p.append_uint16(gateid)\n"
+        "        p.append_uint32(gen)\n"
+        "        p.append_bool(fresh)")
+    assert any("SET_GATE_ID packed as" in v.message for v in _r7(mods))
+
+
+def test_mutant_layout_edit_without_version_bump_caught(tmp_path):
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/proto/schema.py",
+        'schema(MsgType.CANCEL_MIGRATE, ("eid", "eid")),',
+        'schema(MsgType.CANCEL_MIGRATE, ("eid", "eid"), ("why", "u8")),')
+    assert any("does not match the pinned" in v.message
+               for v in _r7(mods))
+
+
+def test_mutant_handler_skips_field_caught(tmp_path):
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/game/service.py",
+        "            eid = packet.read_entity_id()\n"
+        "            packet.read_uint16()\n"
+        "            raw_len = packet.unread_len()",
+        "            packet.read_uint16()\n"
+        "            eid = packet.read_entity_id()\n"
+        "            raw_len = packet.unread_len()")
+    assert any("REAL_MIGRATE" in v.message and "position 0" in v.message
+               for v in _r7(mods))
+
+
 # --- suppression mechanics ---------------------------------------------------
 
 

@@ -1,0 +1,314 @@
+"""Wire-schema conformance + cluster-protocol model checker (ISSUE 11).
+
+Three layers:
+
+- ``proto/schema.py`` unit surface: example-packet round-trips, the
+  digest pin, the documented v4/v5 ``SET_GATE_ID`` mis-framing scenario.
+- Schema-driven truncation / bit-flip / hostile-shape fuzz of every
+  dispatcher-handled MsgType through the REAL dispatcher handlers: the
+  parser contract is ValueError-or-nothing, never struct.error or a bare
+  IndexError/TypeError.
+- ``analysis/modelcheck.py``: the bounded migrate+crash / gate-generation
+  / boot-flap configurations explore exhaustively with zero invariant
+  violations on HEAD, deterministic state counts, and every seeded
+  protocol mutant caught with a readable counterexample trace.
+
+Run just these with ``pytest -m analysis tests/test_modelcheck.py``.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+
+import pytest
+
+from goworld_tpu.analysis.modelcheck import (
+    MUTANTS,
+    BootConfig,
+    BootFlapModel,
+    GateGenConfig,
+    GateGenerationModel,
+    MigConfig,
+    MigrateCrashModel,
+    deep_configs,
+    explore,
+    tier1_configs,
+)
+from goworld_tpu.dispatcher.service import DispatcherService
+from goworld_tpu.netutil.packet import Packet, PacketReadError
+from goworld_tpu.proto import schema
+from goworld_tpu.proto.msgtypes import PROTO_VERSION, MsgType
+
+pytestmark = pytest.mark.analysis
+
+
+# --- schema unit surface -----------------------------------------------------
+
+
+def test_every_msgtype_has_schema_and_roundtrips():
+    for t in MsgType:
+        s = schema.SCHEMAS_BY_TYPE[int(t)]
+        p = Packet(schema.example_packet(int(t)).payload)
+        fields = schema.read_fields(p, int(t))
+        assert set(n for n, _k in s.fields) <= set(fields)
+        assert p.unread_len() == 0, f"{t.name}: example leaves tail bytes"
+
+
+def test_digest_pinned_for_current_proto_version():
+    """The committed SCHEMA_HISTORY entry for the CURRENT PROTO_VERSION
+    must equal the digest of the declared table — the same check gwlint
+    R7 enforces statically, pinned here at runtime too."""
+    assert PROTO_VERSION in schema.SCHEMA_HISTORY
+    assert schema.SCHEMA_HISTORY[PROTO_VERSION] == schema.schema_digest()
+
+
+def test_trace_trailer_constant_matches_tracing():
+    from goworld_tpu.telemetry.tracing import TRAILER_SIZE
+
+    assert schema.TRACE_TRAILER_BYTES == TRAILER_SIZE
+
+
+def test_redirect_schemas_carry_routing_prefix():
+    from goworld_tpu.proto.msgtypes import REDIRECT_MAX, REDIRECT_MIN
+
+    for t in MsgType:
+        if REDIRECT_MIN <= int(t) <= REDIRECT_MAX:
+            s = schema.SCHEMAS_BY_TYPE[int(t)]
+            assert s.fields[:2] == schema.REDIRECT_PREFIX, t.name
+
+
+def test_truncated_read_fields_raise_value_error():
+    for t in (MsgType.SET_GAME_ID, MsgType.REAL_MIGRATE,
+              MsgType.NOTIFY_CLIENT_CONNECTED, MsgType.KVREG_REGISTER):
+        payload = schema.example_packet(int(t)).payload
+        for cut in range(len(payload)):
+            p = Packet(payload[:cut])
+            with pytest.raises(ValueError):
+                schema.read_fields(p, int(t))
+                raise ValueError("full read unexpectedly succeeded")
+
+
+def test_packet_read_error_is_value_and_index_error():
+    """The truncation seam keeps BOTH contracts: the wire-parser rule
+    (ValueError) and the historical IndexError for existing catchers."""
+    assert issubclass(PacketReadError, ValueError)
+    assert issubclass(PacketReadError, IndexError)
+    p = Packet(b"\x01")
+    with pytest.raises(ValueError):
+        p.read_uint32()
+
+
+def test_v4_v5_set_gate_id_mixed_pair_misframes():
+    """The documented footgun (proto/msgtypes.py:33-39): v5 SET_GATE_ID
+    inserts ``fresh``+``gen`` BEFORE the version trailer, so a v4 reader
+    — layout [u16 gateid][u32 version] — parses the bool as the version's
+    first byte and sees garbage.  The handshake guard is what saves the
+    mixed pair; the schema digest pin is what forces the bump that arms
+    the guard."""
+    p = schema.example_packet(int(MsgType.SET_GATE_ID))
+    v5 = Packet(p.payload)
+    # v4 reader: gateid then (what it believes is) the version
+    v5.read_uint16()
+    v4_seen_version = v5.read_uint32()
+    # fresh=True (0x01) + the low 3 bytes of gen — NOT any real version
+    assert v4_seen_version != PROTO_VERSION
+    assert v4_seen_version != 4
+    # ... and the v5 reader, following the schema, recovers it exactly
+    fields = schema.read_fields(Packet(p.payload), int(MsgType.SET_GATE_ID))
+    assert fields["proto_version"] == PROTO_VERSION
+
+
+# --- schema-driven dispatcher fuzz -------------------------------------------
+
+
+class _FakeConn:
+    def __init__(self):
+        self.closed = False
+        self.sent_packets = 0
+
+    def send_packet(self, msgtype, packet):
+        self.sent_packets += 1
+
+    def flush(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+class _FakeProxy:
+    """Just enough GoWorldConnection surface for the handlers."""
+
+    trace_wire = False
+
+    def __init__(self):
+        self.conn = _FakeConn()
+
+    @property
+    def closed(self):
+        return self.conn.closed
+
+    def send(self, msgtype, packet):
+        self.conn.send_packet(msgtype, packet)
+
+    def close(self):
+        self.conn.close()
+
+    def __getattr__(self, name):
+        if name.startswith("send_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+def _drive(msgtype: int, payload: bytes) -> None:
+    """One fuzz shot through the real dispatcher ``_handle``, from a
+    registered game peer (so post-handshake paths run too).  Anything but
+    a clean return or ValueError is a parser-contract failure."""
+    svc = DispatcherService(1)
+    proxy = _FakeProxy()
+    svc._proxy_games[proxy] = 3
+    svc._game(3).proxy = proxy
+    try:
+        svc._handle(proxy, msgtype, Packet(payload))
+    except ValueError:
+        pass
+
+
+_HOSTILE_BODIES = [5, "str", [1, 2], {"k": "v"}, None, [None],
+                   {"cpu": "x"}, {"spaces": 5}, {"spaces": [[1]]},
+                   {"spaces": [[{}, "a", None]]}]
+
+
+@pytest.mark.parametrize("t", list(MsgType), ids=lambda t: t.name)
+def test_dispatcher_payload_fuzz(t):
+    """Truncation at every byte + deterministic bit flips + wrong-shape
+    msgpack bodies for every MsgType the dispatcher can receive: short /
+    hostile buffers raise ValueError, never struct.error, IndexError, or
+    TypeError (the ISSUE 11 fuzz satellite; the SET_GAME_ID entity-list
+    and GAME_LOAD_REPORT shape guards were added because THIS found them
+    wanting)."""
+    s = schema.SCHEMAS_BY_TYPE[int(t)]
+    base = schema.example_packet(int(t)).payload
+    for cut in range(len(base)):
+        _drive(int(t), base[:cut])
+    for i in range(len(base)):
+        for b in (0xFF, 0x00, 0x80):
+            _drive(int(t), base[:i] + bytes([b]) + base[i + 1:])
+    for fname, kind in s.fields:
+        if kind not in ("data", "args"):
+            continue
+        for alt in _HOSTILE_BODIES:
+            p = Packet()
+            for name2, kind2 in s.fields:
+                if name2 == fname and kind2 == "data":
+                    p.append_data(alt)
+                elif name2 == fname:
+                    p.append_args(alt if isinstance(alt, (list, tuple))
+                                  else (alt,))
+                else:
+                    v = schema._FIELD_EXAMPLES.get(
+                        (int(t), name2), schema._KIND_EXAMPLES[kind2])
+                    getattr(p, schema.KIND_APPEND[kind2])(v)
+            _drive(int(t), p.payload)
+
+
+def test_load_report_coercion_rejects_malformed_rows():
+    from goworld_tpu.rebalance.report import coerce_report
+
+    ok = coerce_report({"cpu": 1, "entities": 2, "spaces": [["s", 1, 3]]})
+    assert ok["cpu"] == 1.0 and ok["spaces"] == [["s", 1, 3]]
+    for bad in (7, {"cpu": {}}, {"spaces": 3}, {"spaces": [[1]]},
+                {"spaces": [["s", "kind", 1]]}):
+        with pytest.raises(ValueError):
+            coerce_report(bad)
+
+
+# --- the model checker on HEAD ----------------------------------------------
+
+#: Deterministic exhaustive-exploration sizes for the tier-1 configs.
+#: A model edit that changes reachable-state counts MUST update these —
+#: that is the point: shrinkage means the exploration lost coverage.
+EXPECTED_STATES = {
+    "migrate_crash": 255,
+    "migrate_unknown_target": 440,
+    "migrate_no_return": 117,
+    "gate_generation": 4,
+    "boot_flap": 8,
+}
+
+
+def test_tier1_configs_hold_invariants_exhaustively():
+    for model in tier1_configs():
+        r = explore(model)
+        assert r.ok, "\n" + r.render()
+        assert r.states == EXPECTED_STATES[r.model], (
+            f"{r.model}: explored {r.states} states, expected "
+            f"{EXPECTED_STATES[r.model]} — a model edit changed the "
+            f"reachable space; re-verify and update the pin")
+        assert r.terminals > 0
+
+
+def test_exploration_is_deterministic():
+    a = explore(MigrateCrashModel(MigConfig()))
+    b = explore(MigrateCrashModel(MigConfig()))
+    assert (a.states, a.transitions, a.terminals) == \
+           (b.states, b.transitions, b.terminals)
+
+
+@pytest.mark.slow
+def test_deep_configs_hold_invariants():
+    for model in deep_configs():
+        r = explore(model)
+        assert r.ok, "\n" + r.render()
+        assert r.states > 900  # strictly wider than the tier-1 bounds
+
+
+# --- seeded protocol mutants: the checker has teeth --------------------------
+
+_MUTANT_MODELS = {
+    "no_bounce": lambda m: MigrateCrashModel(MigConfig(mutants=m)),
+    "no_purge_cold_boot": lambda m: MigrateCrashModel(MigConfig(mutants=m)),
+    # a widened-to-infinity grace window only bites when the crashed
+    # target never returns — the migrate_no_return bounds
+    "infinite_grace": lambda m: MigrateCrashModel(
+        MigConfig(name="migrate_no_return", restarts=0, mutants=m)),
+    "no_sync_parking": lambda m: MigrateCrashModel(MigConfig(mutants=m)),
+    "skip_gen_check": lambda m: GateGenerationModel(GateGenConfig(mutants=m)),
+    "drop_boot_no_game": lambda m: BootFlapModel(BootConfig(mutants=m)),
+}
+
+
+@pytest.mark.parametrize("mutant", list(MUTANTS))
+def test_model_checker_catches_mutant(mutant):
+    model = _MUTANT_MODELS[mutant](frozenset({mutant}))
+    r = explore(model)
+    assert not r.ok, f"mutant {mutant} slipped past the model checker"
+    # counterexamples must read as message sequences, not state dumps
+    ce = r.violations[0]
+    assert ce.trace, ce.render()
+    assert all(isinstance(step, str) and step for step in ce.trace)
+    assert "violation:" in ce.render()
+
+
+def test_mutant_caught_in_unknown_target_config_too():
+    r = explore(MigrateCrashModel(MigConfig(
+        name="migrate_unknown_target", target_unregistered=True,
+        mutants=frozenset({"no_bounce"}))))
+    assert not r.ok
+
+
+def test_unknown_mutant_rejected():
+    with pytest.raises(ValueError, match="unknown mutants"):
+        MigrateCrashModel(MigConfig(mutants=frozenset({"typo"})))
+
+
+def test_modelcheck_cli_smoke():
+    """tools/lint.sh runs this exact entry point; it must exit 0 on HEAD
+    and print one deterministic state-count line per config."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "goworld_tpu.analysis.modelcheck"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    for name, states in EXPECTED_STATES.items():
+        assert f"{name}: {states} states" in proc.stdout, proc.stdout

@@ -1,12 +1,13 @@
 #!/usr/bin/env python
 """gwlint — engine-aware static analysis over goworld_tpu/.
 
-Runs the six AST rules (R1 jit-hygiene, R2 hot-path shape, R3
+Runs the seven AST rules (R1 jit-hygiene, R2 hot-path shape, R3
 parse-bounds, R4 lock discipline, R5 telemetry hygiene, R6 config-key
-drift) against the whole package and reports anything not suppressed by
-the committed baseline (``gwlint_baseline.toml``) or an inline
-``# gwlint: ok RN reason`` pragma.  Exit code 1 on unsuppressed
-violations — the same check tier-1 runs (tests/test_analysis.py).
+drift, R7 proto-conformance + wire-schema digest pin) against the whole
+package and reports anything not suppressed by the committed baseline
+(``gwlint_baseline.toml``) or an inline ``# gwlint: ok RN reason``
+pragma.  Exit code 1 on unsuppressed violations — the same check tier-1
+runs (tests/test_analysis.py).
 
 Usage:
     python tools/gwlint.py                      # lint, apply baseline
@@ -43,7 +44,7 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-baseline", action="store_true",
                     help="report raw findings, ignoring the baseline")
     ap.add_argument("--rules", default="",
-                    help="comma-separated subset (default: all six)")
+                    help="comma-separated subset (default: all seven)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="write every current finding to the baseline "
                          "with a TRIAGE placeholder reason")

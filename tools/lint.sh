@@ -1,12 +1,17 @@
 #!/usr/bin/env bash
-# One-shot static-analysis entry point (ISSUE 9): exactly what tier-1
-# gates, runnable locally before a commit.
-#   1. gwlint — six engine rules over goworld_tpu/ under the committed
-#      baseline (tools/gwlint.py)
-#   2. typed-core gate — mypy over proto/, common/, telemetry/metrics.py
-#      (skipped with a notice when mypy is not installed)
-#   3. the analysis pytest marker — rule fixtures, baseline mechanics,
-#      lockgraph units and cluster smokes
+# One-shot static-analysis entry point (ISSUE 9 + 11): exactly what
+# tier-1 gates, runnable locally before a commit.
+#   1. gwlint — seven engine rules over goworld_tpu/ under the committed
+#      baseline (tools/gwlint.py), R7 proto-conformance + schema-digest
+#      pin included
+#   2. cluster-protocol model checker — the bounded tier-1 configs
+#      explored exhaustively (goworld_tpu/analysis/modelcheck.py)
+#   3. typed-core gate — mypy over proto/, common/, telemetry/metrics.py,
+#      analysis/modelcheck.py (skipped with a notice when mypy is not
+#      installed)
+#   4. the analysis pytest marker — rule fixtures, baseline mechanics,
+#      lockgraph units and cluster smokes, schema fuzz, model-checker
+#      mutants
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
@@ -14,6 +19,9 @@ rc=0
 
 echo "== gwlint =="
 python tools/gwlint.py || rc=1
+
+echo "== protocol model check =="
+python -m goworld_tpu.analysis.modelcheck || rc=1
 
 echo "== typed core (mypy) =="
 if python -c "import mypy" 2>/dev/null; then
