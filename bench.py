@@ -708,12 +708,15 @@ def bench_fanout(trace_sample_rate: int | None = None,
             # a single vectorized write (replacing the per-entity
             # set_position loop the bench used to run as a side task), so
             # the measured fan-out includes the slab-backed behavior path.
-            _accum = 0.0
-            _phase = 0
+            # Movement state (cadence accumulator + jitter phase) lives in
+            # declared Column attrs (entity/columns.py), so the committed
+            # fan-out floors also ride the columnar-attr read/write path.
 
             @classmethod
             def describe_entity_type(cls, desc):
                 desc.set_use_aoi(True, c["aoi_distance"])
+                desc.define_attr("accum", "Column")
+                desc.define_attr("phase", "Column")
 
             def on_client_connected(self):
                 arena = holder["arena"]
@@ -731,20 +734,26 @@ def bench_fanout(trace_sample_rate: int | None = None,
 
             @classmethod
             def on_tick_batch(cls, view):
-                cls._accum += view.dt
-                if cls._accum < c["sync_interval"]:
+                import numpy as _np
+
+                # Every avatar shares the same dt, so the per-entity gate
+                # fires for all simultaneously — identical cadence to the
+                # old class-level accumulator, but the state is columnar.
+                accum = view.col("accum") + view.dt
+                if accum.max(initial=0.0) < c["sync_interval"]:
+                    view.set_col("accum", accum)
                     return
                 # Carry the residual (capped) so a loop iteration landing
                 # late doesn't stretch the average movement cadence.
-                cls._accum = min(cls._accum - c["sync_interval"],
-                                 c["sync_interval"])
-                cls._phase ^= 1
+                view.set_col(
+                    "accum",
+                    _np.minimum(accum - c["sync_interval"],
+                                c["sync_interval"]))
+                phase = 1.0 - view.col("phase")
+                view.set_col("phase", phase)
                 # Avatars jitter half a unit in place on odd phases,
                 # never leaving the shared AOI neighborhood.
-                import numpy as _np
-
-                x = _np.floor(view.x) + (0.5 if cls._phase else 0.0)
-                view.set_position_yaw(x=x)
+                view.set_position_yaw(x=_np.floor(view.x) + 0.5 * phase)
 
         class Bot:
             def __init__(self) -> None:
@@ -1433,10 +1442,128 @@ def update_floor(allow_lower: bool = False) -> int:
     return 0
 
 
+def bench_fused() -> dict:
+    """``bench.py --fused``: the fused-tick demonstration (ISSUE 12).
+
+    An embedded game runtime (no sockets) with N columnar avatars on the
+    batched AOI backend, driven through the production tick path twice —
+    [aoi] fuse_logic off, then on — measuring the HOST cost of the
+    entity_logic phase (run_tick_batches wall time) per tick. Fused, the
+    per-class hook never runs (its jit is never traced) and the logic
+    rides the engine launch, so the host entity_logic time collapses to
+    approximately zero while trajectories stay exact (the tier-1 oracle
+    in tests/test_columns.py pins exactness; this reports the numbers).
+    Informational, not a committed floor — the gating regression test is
+    tests/test_columns.py::test_fused_service_one_launch_trace_counts."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from goworld_tpu.entity import entity_manager as em
+    from goworld_tpu.entity.columns import columnar_tick
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.entity.vector import Vector3
+    from goworld_tpu.ops import NeighborParams
+
+    n = int(os.environ.get("BENCH_FUSED_N", "1024"))
+    steps = int(os.environ.get("BENCH_FUSED_STEPS", "60"))
+    out: dict = {}
+
+    def run(fuse: bool) -> dict:
+        em.cleanup_for_tests()
+
+        def drift(x, y, z, yaw, dt, vx, vz):
+            return x + vx * dt, y, z + vz * dt, yaw + 10.0 * dt, vx, vz
+
+        class FusedSpace(Space):
+            def on_space_created(self):
+                if self.kind == 1:
+                    self.enable_aoi(100.0)
+
+        class FusedAvatar(Entity):
+            on_tick_batch = columnar_tick(drift, ("vx", "vz"))
+
+            @classmethod
+            def describe_entity_type(cls, desc):
+                desc.set_use_aoi(True, 100.0)
+                desc.define_attr("vx", "Column")
+                desc.define_attr("vz", "Column")
+
+        em.register_space(FusedSpace)
+        em.register_entity(FusedAvatar)
+        rt = em.runtime
+        rt.aoi_backend = "batched"
+        rt.aoi_params = NeighborParams(
+            capacity=max(256, ((n + 256 + 255) // 256) * 256),
+            cell_size=100.0, grid_x=32, grid_z=32, space_slots=1,
+            cell_capacity=64, max_events=32768)
+        rt.aoi_fuse_logic = fuse
+        space = em.create_space_locally(1)
+        rng = np.random.default_rng(0)
+        for i in range(n):
+            e = em.create_entity_locally(
+                "FusedAvatar", space=space,
+                pos=Vector3(float(rng.uniform(0, 3200)), 0.0,
+                            float(rng.uniform(0, 3200))))
+            e.attrs["vx"] = float(rng.normal(0, 3.0))
+            e.attrs["vz"] = float(rng.normal(0, 3.0))
+        svc = rt.aoi_service
+        for _ in range(3):  # warm: compiles + enter storm
+            rt.slabs.run_tick_batches()
+            svc.tick()
+        logic_s = 0.0
+        aoi_s = 0.0
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            rt.slabs.run_tick_batches()
+            t1 = time.perf_counter()
+            svc.tick()
+            # Attribute the step's device time to the AOI phase before
+            # the next logic phase runs: the backend's execution stream is
+            # shared, so without this the unfused hook's (tiny) jit call
+            # queues behind the in-flight AOI launch and run_tick_batches
+            # would absorb the whole step time — inflating the collapse
+            # ratio with queueing, not logic cost.
+            pend = svc._pending
+            if pend is not None:
+                pend[0].wait_device()
+            t2 = time.perf_counter()
+            logic_s += t1 - t0
+            aoi_s += t2 - t1
+        hook = FusedAvatar.on_tick_batch.__func__
+        r = {
+            "entity_logic_host_us_per_tick": round(logic_s / steps * 1e6, 1),
+            "aoi_phase_us_per_tick": round(aoi_s / steps * 1e6, 1),
+            "hook_jit_traces": hook.jit_cache_size(),
+        }
+        em.cleanup_for_tests()
+        return r
+
+    unfused = run(False)
+    fused = run(True)
+    collapse = (unfused["entity_logic_host_us_per_tick"]
+                / max(fused["entity_logic_host_us_per_tick"], 0.01))
+    out = {
+        "metric": "fused_entity_logic_collapse",
+        "value": round(collapse, 1),
+        "unit": "x (host entity_logic us, unfused/fused)",
+        "entities": n,
+        "steps": steps,
+        "unfused": unfused,
+        "fused": fused,
+        # fused ticks must never trace (or run) the per-class hook jit.
+        "fused_hook_never_traced": fused["hook_jit_traces"] == 0,
+        "platform": "cpu",
+    }
+    return out
+
+
 def main() -> int:
     if "--update-floor" in sys.argv[1:]:
         return update_floor(allow_lower="--allow-lower" in sys.argv[1:])
     for flag, fn, metric, unit in (
+        ("--fused", bench_fused,
+         "fused_entity_logic_collapse", "x"),
         ("--pinned-floor", bench_pinned_floor,
          "pinned_floor_updates_per_sec", "entity-updates/sec"),
         ("--sharded", bench_sharded,

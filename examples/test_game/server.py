@@ -118,7 +118,11 @@ class Avatar(Entity):
         desc.define_attr("enteringNilSpace")
         desc.define_attr("testCallAllN")
         desc.define_attr("complexAttr", "Client")
-        desc.define_attr("pingCount")
+        # Columnar attr (entity/columns.py): stored in a slab column,
+        # read/written through the same attrs surface — the cross-game
+        # migration e2e (tests/test_migration.py) pins that it continues
+        # across the hop, and the CLI reload pins freeze→restore.
+        desc.define_attr("pingCount", "Column", dtype="int32")
 
     def on_attrs_ready(self):
         a = self.attrs

@@ -452,6 +452,16 @@ HOT_PATHS: dict[str, set[str]] = {
     },
     "goworld_tpu/ops/neighbor.py": {
         "neighbor_step", "build_tables", "diff_events",
+        # Fused entity-logic launch ([aoi] fuse_logic): these bodies must
+        # stay loop-free — the trace-time program unroll lives in
+        # _apply_fused_logic, outside the guarded set by design.
+        "_step_packed_fused_jnp", "_step_packed_fused_pallas",
+    },
+    "goworld_tpu/parallel/spatial.py": {
+        "_spatial_step_fused_impl",
+    },
+    "goworld_tpu/parallel/mesh.py": {
+        "_sharded_step_fused",
     },
 }
 

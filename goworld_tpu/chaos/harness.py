@@ -71,11 +71,17 @@ class ChaosSpace(Space):
 
 
 class ChaosAvatar(Entity):
-    """Boot avatar: joins the shared arena and echoes Ping→Pong."""
+    """Boot avatar: joins the shared arena and echoes Ping→Pong.
+
+    ``pings`` is a Column attr (entity/columns.py): every scenario's RPC
+    traffic reads/writes a slab column through the attrs surface, so the
+    chaos catalog exercises columnar attrs across crashes, restarts and
+    reconnect waves for free."""
 
     @classmethod
     def describe_entity_type(cls, desc):
         desc.set_use_aoi(True, AOI_DISTANCE)
+        desc.define_attr("pings", "Column", dtype="int32")
 
     def on_client_connected(self):
         arena = _Holder.arena
@@ -89,6 +95,7 @@ class ChaosAvatar(Entity):
         self.set_client_syncing(True)
 
     def Ping_Client(self, n):
+        self.attrs["pings"] = self.attrs.get_int("pings") + 1
         self.call_client("Pong", n)
 
     def on_client_disconnected(self):
@@ -473,6 +480,13 @@ async def scenario_dispatcher_restart(
     assert not errors, f"bot errors during dispatcher restart: {errors[:5]}"
     assert drops == 0, f"{drops} packets dropped (ring overflow?)"
     assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    # Column attrs rode the outage: every avatar's ping counter (a slab
+    # column behind the attrs surface) recorded the mid-outage ping too.
+    from goworld_tpu.entity import entity_manager as em
+
+    for e in em.entities().values():
+        if e.typename == "ChaosAvatar":
+            assert e.attrs.get_int("pings") >= 2, "column attr lost pings"
     _RECOVERY.labels("dispatcher_restart", cluster.transport).set(recovery)
     return {"scenario": "dispatcher_restart", "recovery_s": round(recovery, 3),
             "post_roundtrip_s": round(rt, 3), "dropped": drops,

@@ -218,6 +218,14 @@ class AOIConfig:
     # hardcoded bound was 30 s — VERDICT r5 weak #5). Ignored unless
     # delivery = sync.
     sync_wait_budget: float = 0.5
+    # Fuse per-class columnar tick programs (entity/columns.columnar_tick
+    # / vmapped_position_tick) INTO the batched engine's step launch:
+    # steady-state ticks then run move + entity logic + neighbor interest
+    # as ONE device launch, logic riding the AOI cadence with its outputs
+    # written back at the next dispatch. Classes with hand-written
+    # on_tick_batch bodies — and the entity-sharded/multihost engine
+    # tiers — automatically stay host-side. Ignored by xzlist.
+    fuse_logic: bool = False
 
 
 @dataclasses.dataclass
@@ -487,6 +495,8 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             multihost_processes=int(s.get("multihost_processes", 0)),
             delivery=s.get("delivery", "pipelined").strip().lower(),
             sync_wait_budget=float(s.get("sync_wait_budget", 0.5)),
+            fuse_logic=s.get("fuse_logic", "false").strip().lower()
+            in ("1", "true", "yes"),
         )
     if cp.has_section("cluster"):
         s = cp["cluster"]

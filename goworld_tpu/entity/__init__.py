@@ -5,6 +5,7 @@ Reference parity: ``engine/entity`` (SURVEY.md §2.1, §2.6).
 """
 
 from goworld_tpu.entity.attrs import MapAttr, ListAttr
+from goworld_tpu.entity.columns import ColumnSpec, columnar_tick
 from goworld_tpu.entity.entity import Entity
 from goworld_tpu.entity.slabs import (
     EntitySlabs,
@@ -42,6 +43,8 @@ from goworld_tpu.entity.entity_manager import (
 __all__ = [
     "MapAttr",
     "ListAttr",
+    "ColumnSpec",
+    "columnar_tick",
     "Entity",
     "EntitySlabs",
     "SlabTickView",

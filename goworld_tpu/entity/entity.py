@@ -24,6 +24,7 @@ from goworld_tpu.entity.attrs import (
     MAP_DEL,
     MapAttr,
 )
+from goworld_tpu.entity.columns import ColumnSpec
 from goworld_tpu.entity.game_client import GameClient
 # sync-info flags (Entity.go sifSyncOwnClient / sifSyncNeighborClients) —
 # defined beside the columnar flag slab they index, re-exported here.
@@ -49,14 +50,23 @@ class EntityTypeDesc:
         self.client_attrs: set[str] = set()
         self.all_clients_attrs: set[str] = set()
         self.persistent_attrs: set[str] = set()
+        # Declared Column attrs (entity/columns.py): numeric attrs whose
+        # storage is a slab column instead of the per-entity dict.
+        self.column_attrs: dict[str, "ColumnSpec"] = {}
 
     def set_use_aoi(self, use: bool, distance: float = 100.0) -> None:
         self.use_aoi = use
         self.aoi_distance = distance
 
-    def define_attr(self, name: str, *flags: str) -> None:
-        """Flags: "Client", "AllClients", "Persistent" (attr.go:5-10).
-        AllClients implies Client."""
+    def define_attr(self, name: str, *flags: str,
+                    dtype: str = "float32", default: float = 0.0) -> None:
+        """Flags: "Client", "AllClients", "Persistent" (attr.go:5-10;
+        AllClients implies Client) plus "Column" (entity/columns.py): a
+        numeric attr stored in a process-wide slab column — reads/writes
+        through ``entity.attrs`` proxy to the column, per-class batched
+        tick hooks (``columnar_tick``) vectorize over it, and with
+        ``[aoi] fuse_logic`` the batched AOI step updates it on-device.
+        ``dtype``/``default`` apply to Column attrs only."""
         for f in flags:
             if f == "Client":
                 self.client_attrs.add(name)
@@ -65,6 +75,9 @@ class EntityTypeDesc:
                 self.all_clients_attrs.add(name)
             elif f == "Persistent":
                 self.persistent_attrs.add(name)
+            elif f == "Column":
+                self.column_attrs[name] = ColumnSpec(
+                    name, dtype=dtype, default=default)
             else:
                 raise ValueError(f"unknown attr flag {f!r}")
 
@@ -139,6 +152,9 @@ class Entity:
         s = self._slabs
         s.xz[i] = (pos.x, pos.z)
         s.y[i] = pos.y
+        # Host write fence: an in-flight fused tick must not clobber this
+        # (entity/slabs.py fused_dirty; aoi/batched.py _consume_fused).
+        s.fused_dirty[i] = True
 
     @property
     def yaw(self) -> float:
@@ -155,6 +171,7 @@ class Entity:
             self._final_pos_yaw = (x, y, z, value)
             return
         self._slabs.yaw[i] = value
+        self._slabs.fused_dirty[i] = True
 
     @property
     def client(self) -> Optional[GameClient]:
@@ -208,6 +225,12 @@ class Entity:
         self._final_pos_yaw = (
             float(s.xz[i, 0]), float(s.y[i]), float(s.xz[i, 1]),
             float(s.yaw[i]))
+        # Column-backed attr roots snapshot their cells the same way the
+        # final position is snapshotted, so post-destroy reads stay valid
+        # after the slot is recycled (entity/columns.py).
+        snap = getattr(self.attrs, "_snapshot_columns", None)
+        if snap is not None:
+            snap()
         self._slot = -1
         s.release(i, self)
 

@@ -19,7 +19,7 @@ from typing import Optional, Type
 
 from goworld_tpu import consts, dispatchercluster, telemetry
 from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
-from goworld_tpu.entity.attrs import MapAttr
+from goworld_tpu.entity.columns import make_attr_root
 from goworld_tpu.entity.entity import (
     Entity,
     EntityTypeDesc,
@@ -69,6 +69,10 @@ class Runtime:
         # service calls init_multihost before any jax use).
         self.aoi_multihost: bool = False
         self.aoi_delivery: str = "pipelined"  # [aoi] delivery: pipelined | sync
+        # [aoi] fuse_logic: compile per-class columnar tick programs INTO
+        # the batched engine's step launch (entity/columns.py; one device
+        # launch per steady-state tick).
+        self.aoi_fuse_logic: bool = False
         # [aoi] sync_wait_budget: sync-mode stall ceiling before degrading
         # to deferred delivery (batched.py SYNC_WAIT_BUDGET rationale).
         self.aoi_sync_wait_budget: float = 0.5
@@ -96,6 +100,7 @@ class Runtime:
                 params, mesh_shards=self.aoi_mesh_shards,
                 multihost=self.aoi_multihost,
                 shard_mode=self.aoi_shard_mode,
+                fuse_logic=self.aoi_fuse_logic,
             )
             self.aoi_service.delivery = self.aoi_delivery
             self.aoi_service.sync_wait_budget = self.aoi_sync_wait_budget
@@ -203,7 +208,9 @@ def _new_entity(
     e.id = eid or gen_entity_id()
     if e.id in _entities:
         raise ValueError(f"entity id {e.id} already exists")
-    root = MapAttr()
+    # Column-declaring types get a column-backed root (entity/columns.py):
+    # Column keys proxy to the slab columns, everything else stays dict.
+    root = make_attr_root(desc, e)
     e._bind_attrs(root)
     if attrs:
         root.assign(attrs)
@@ -484,7 +491,10 @@ def restore_entity(eid: str, data: dict, is_migrate: bool) -> Entity:
     e.id = eid
     if e.id in _entities:
         raise ValueError(f"restore: entity {eid} already exists")
-    root = MapAttr()
+    # Column attrs travel inside data["attrs"] as plain scalars (they are
+    # merged into to_dict by the column-backed root); assign() routes them
+    # straight back into the slab columns of the fresh slot.
+    root = make_attr_root(desc, e)
     e._bind_attrs(root)
     root.assign(data["attrs"])
     if isinstance(e, Space):

@@ -44,6 +44,7 @@ from typing import Optional
 import numpy as np
 
 from goworld_tpu import telemetry
+from goworld_tpu.entity.columns import ColumnSpec, columnar_tick
 from goworld_tpu.utils import gwutils
 
 # sync-info flags (Entity.go sifSyncOwnClient / sifSyncNeighborClients).
@@ -140,6 +141,23 @@ class SlabTickView:
     def yaw(self) -> np.ndarray:
         return self._slabs.yaw[self._slots]
 
+    def col(self, name: str) -> np.ndarray:
+        """Gathered copy of a declared Column attr for this view's rows
+        (entity/columns.py); mutate freely, write back via set_col."""
+        return self._slabs.columns[name][self._slots]
+
+    def set_col(self, name: str, values) -> None:
+        """Write a Column attr for every row of the view. No sync flags —
+        Column attrs stream per-entity via attrs.set(), not via the batch
+        path (columns.py module docstring). Rows whose entity was
+        destroyed mid-batch are quarantined slots; the stale write is
+        harmless (defaults are rewritten at re-allocation)."""
+        s = self._slabs
+        s.columns[name][self._slots] = values
+        # Host-side hook writes win over an in-flight fused tick's
+        # writeback (aoi/batched.py _consume_fused).
+        s.fused_dirty[self._slots] = True
+
     def set_position_yaw(self, x=None, y=None, z=None, yaw=None) -> None:
         s = self._slabs
         slots = self._slots
@@ -166,6 +184,9 @@ class SlabTickView:
         if yaw is not None:
             s.yaw[slots] = yaw
         s.flags[slots] |= SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS
+        # Host hook wrote positions: an in-flight fused tick's writeback
+        # must not clobber them (aoi/batched.py _consume_fused).
+        s.fused_dirty[slots] = True
         # Non-columnar AOI backends (xzlist) keep per-entity structures;
         # the batched manager reads positions from the slab directly
         # (positions_in_slabs) and needs no per-entity notification.
@@ -188,63 +209,12 @@ def vmapped_position_tick(fn):
     """Lift a pure per-entity numeric function into an ``on_tick_batch``
     classmethod: ``fn(x, y, z, yaw, dt) -> (x, y, z, yaw)`` on scalars,
     applied to every live entity of the class in ONE ``jax.jit(jax.vmap)``
-    call per tick (compiled once, cached on the hook). Falls back to
-    calling ``fn`` with whole columns (numpy broadcasting) when jax is
-    unavailable, so numeric behaviors written with array-generic ops run
-    either way."""
-    state: dict = {}
-
-    def _batched():
-        batched = state.get("fn")
-        if batched is None:
-            try:
-                import jax
-
-                jitted = jax.jit(jax.vmap(fn, in_axes=(0, 0, 0, 0, None)))
-                state["jitted"] = jitted
-
-                def batched(x, y, z, yaw, dt):
-                    out = jitted(x, y, z, yaw, dt)
-                    return tuple(np.asarray(o) for o in out)
-
-            except Exception:  # pragma: no cover - jax is in the image
-                batched = fn
-            state["fn"] = batched
-        return batched
-
-    def hook(cls, view: SlabTickView) -> None:
-        if len(view) == 0:
-            return
-        x, y, z, yaw = _batched()(
-            view.x, view.y, view.z, view.yaw, np.float32(view.dt))
-        view.set_position_yaw(x, y, z, yaw)
-
-    def prewarm(n: int, dt: float = 0.05) -> None:
-        """Compile the hook's jit at population ``n`` with a dummy-shaped
-        call (results discarded). The vmapped jit specializes on the view
-        LENGTH, so a restored game pre-warms each adopted class at its
-        restored population BEFORE re-handshaking — otherwise the first
-        live tick after clients re-attach pays the XLA trace while RPCs
-        are already flowing (the ~4.7 s respawn stall of ISSUE 7)."""
-        if n <= 0:
-            return
-        z = np.zeros(n, np.float32)
-        _batched()(z, z, z, z, np.float32(dt))
-
-    def jit_cache_size() -> int:
-        """Compiled-trace count of the underlying jit (0 before first
-        use; tests assert the restore path adds no fresh trace)."""
-        jitted = state.get("jitted")
-        if jitted is None:
-            return 0
-        try:
-            return int(jitted._cache_size())
-        except Exception:  # pragma: no cover - private-API drift
-            return -1
-
-    hook.prewarm = prewarm
-    hook.jit_cache_size = jit_cache_size
-    return classmethod(hook)
+    call per tick (compiled once, cached on the hook; numpy fallback when
+    jax is unavailable). The column-free case of
+    :func:`goworld_tpu.entity.columns.columnar_tick`, which this now
+    delegates to — and therefore fusion-eligible like any columnar hook
+    (``[aoi] fuse_logic`` compiles ``fn`` into the AOI step jit)."""
+    return columnar_tick(fn, ())
 
 
 class EntitySlabs:
@@ -269,6 +239,19 @@ class EntitySlabs:
         self.active = np.zeros(capacity, bool)
         self.space_ids = np.zeros(capacity, np.int32)
         self.radius = np.zeros(capacity, np.float32)
+        # Declared attr columns (entity/columns.py): one process-wide
+        # array per Column attr name, allocated lazily on the first
+        # entity of a declaring type and shared across types (specs must
+        # match). Ride the same grow/quarantine/recycle machinery as the
+        # built-in columns.
+        self.columns: dict[str, np.ndarray] = {}
+        self.column_specs: dict[str, ColumnSpec] = {}
+        # Host-write fence for the fused tick (aoi/batched.py): a slot
+        # whose position/yaw/columns were written host-side since the
+        # last fused dispatch is skipped by that dispatch's writeback —
+        # host writes (teleports, client sync, restore, release/realloc)
+        # win over the in-flight device logic for that slot.
+        self.fused_dirty = np.zeros(capacity, bool)
         self.entities: list = [None] * capacity
         self._free: list[int] = list(range(capacity - 1, -1, -1))
         self._quarantine: list[int] = []
@@ -326,9 +309,33 @@ class EntitySlabs:
         self.entities[slot] = entity
         self.used += 1
         cls = type(entity)
+        desc = getattr(cls, "_type_desc", None)
+        colspecs = getattr(desc, "column_attrs", None)
+        if colspecs:
+            for spec in colspecs.values():
+                self.ensure_column(spec)[slot] = spec.default
+        # A fresh allocation invalidates any in-flight fused writeback
+        # aimed at this slot's previous tenant (aoi/batched.py).
+        self.fused_dirty[slot] = True
         if getattr(cls, "on_tick_batch", None) is not None:
             self._tick_register(cls, entity, slot)
         return slot
+
+    def ensure_column(self, spec: ColumnSpec) -> np.ndarray:
+        """Get-or-create the slab column for ``spec``. Two entity types
+        may share a column name only with an identical (dtype, default)
+        spec — the storage is one array."""
+        cur = self.column_specs.get(spec.name)
+        if cur is not None:
+            if cur != spec:
+                raise ValueError(
+                    f"Column {spec.name!r} redeclared with a different "
+                    f"spec: {cur} vs {spec}")
+            return self.columns[spec.name]
+        arr = np.full(self.capacity, spec.default, spec.np_dtype)
+        self.columns[spec.name] = arr
+        self.column_specs[spec.name] = spec
+        return arr
 
     def release(self, slot: int, entity=None) -> None:
         """Destroy-time release: clear the row's sync-visible columns (so
@@ -342,6 +349,12 @@ class EntitySlabs:
         self.has_client[slot] = False
         self.eid[slot] = b""
         self.gateid[slot] = 0
+        # Columns reset to their declared defaults (a quarantined slot's
+        # stale values must never leak into its next tenant) and the slot
+        # is fenced against any in-flight fused writeback.
+        for name, arr in self.columns.items():
+            arr[slot] = self.column_specs[name].default
+        self.fused_dirty[slot] = True
         if self.active[slot]:
             self.active[slot] = False
             if self.aoi_service is not None:
@@ -402,6 +415,13 @@ class EntitySlabs:
         self.active = pad(self.active, (n,), bool)
         self.space_ids = pad(self.space_ids, (n,), np.int32)
         self.radius = pad(self.radius, (n,), np.float32)
+        self.fused_dirty = pad(self.fused_dirty, (n,), bool)
+        for name, arr in self.columns.items():
+            # New rows start at the column's declared default, not zero.
+            spec = self.column_specs[name]
+            grown = np.full(n, spec.default, arr.dtype)
+            grown[: arr.shape[0]] = arr
+            self.columns[name] = grown
         self._edge_refs = pad(self._edge_refs, (n,), np.int32)
         self.entities.extend([None] * (n - old))
         # New slots go UNDER existing free ones so pop() hands out the
@@ -576,30 +596,58 @@ class EntitySlabs:
 
     def prewarm_tick_hooks(self) -> None:
         """Dummy-shaped compile of every adopted class's batched tick jit
-        at its CURRENT live population (vmapped_position_tick.prewarm).
-        The restore path calls this before the cluster re-handshake so
-        the first live tick triggers no fresh trace; hooks without a
-        prewarm surface (hand-written on_tick_batch bodies) are skipped —
-        whatever they lazily build is their own contract."""
+        at its CURRENT live population (columnar_tick.prewarm, with the
+        class's declared column dtypes). The restore path calls this
+        before the cluster re-handshake so the first live tick triggers
+        no fresh trace; hooks without a prewarm surface (hand-written
+        on_tick_batch bodies) are skipped — whatever they lazily build is
+        their own contract. Classes the attached AOI service runs FUSED
+        skip the per-class jit (it never executes there) and are instead
+        covered by the service's fused-step prewarm, called at the end."""
+        svc = self.aoi_service
+        take = getattr(svc, "takes_over_tick", None)  # duck test doubles
         for cls, bucket in list(self._tick_buckets.items()):
             n = len(bucket.entities)
             if n == 0:
                 continue
+            if take is not None and take(cls):
+                continue
             hook = inspect.getattr_static(cls, "on_tick_batch", None)
-            pw = getattr(getattr(hook, "__func__", None), "prewarm", None)
-            if pw is not None:
-                gwutils.run_panicless(lambda p=pw, k=n: p(k))
+            fn = getattr(hook, "__func__", None)
+            pw = getattr(fn, "prewarm", None)
+            if pw is None:
+                continue
+            prog = getattr(fn, "fused_program", None)
+            dtypes = None
+            if prog is not None and prog.columns:
+                dtypes = tuple(
+                    self.column_specs[c].dtype for c in prog.columns
+                    if c in self.column_specs) or None
+            gwutils.run_panicless(
+                lambda p=pw, k=n, d=dtypes: p(k, col_dtypes=d))
+        pf = getattr(svc, "prewarm_fused", None)
+        if pf is not None:
+            gwutils.run_panicless(pf)
 
     def run_tick_batches(self, now: float | None = None) -> None:
         """Fire each adopted class's ``on_tick_batch`` once over its live
-        entities (the vectorized replacement for per-entity timers)."""
+        entities (the vectorized replacement for per-entity timers).
+        Classes the attached AOI service runs FUSED ([aoi] fuse_logic) are
+        skipped: their program executes inside the engine step at the AOI
+        cadence instead (aoi/batched.py). Their ``last_tick`` stays fresh
+        so a later fallback to host-side execution resumes with a sane
+        dt, not one spanning the whole fused period."""
         if not self._tick_buckets:
             return
         if now is None:
             now = time.monotonic()
+        take = getattr(self.aoi_service, "takes_over_tick", None)
         for cls, bucket in list(self._tick_buckets.items()):
             n = len(bucket.entities)
             if n == 0:
+                continue
+            if take is not None and take(cls):
+                bucket.last_tick = now
                 continue
             dt = now - bucket.last_tick
             bucket.last_tick = now

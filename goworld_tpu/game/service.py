@@ -166,6 +166,7 @@ class GameService:
         rt.aoi_shard_mode = self.cfg.aoi.shard_mode
         rt.aoi_delivery = self.cfg.aoi.delivery
         rt.aoi_sync_wait_budget = self.cfg.aoi.sync_wait_budget
+        rt.aoi_fuse_logic = self.cfg.aoi.fuse_logic
         ecfg = getattr(self.cfg, "entity", None)
         if ecfg is not None:
             # Pre-size the slab store ([entity] slab_initial) so steady-
@@ -235,13 +236,17 @@ class GameService:
             self._restore_freezed_entities()
             # Pre-warm the per-class batched tick jits at the restored
             # populations BEFORE the cluster re-handshake admits traffic:
-            # vmapped_position_tick compiles lazily on first call and
-            # specializes on the view length, so without this the first
-            # live tick after respawn pays the XLA trace while buffered
-            # client RPCs are already draining — the ~4.7 s stall vs the
-            # 5 s strict RPC timeout ISSUE 7 measured. (The AOI engine
-            # itself is already hot: warmup() ran above, and any tier
-            # growth during restore compiled synchronously here too.)
+            # columnar_tick/vmapped_position_tick compile lazily on first
+            # call and specialize on the view length, so without this the
+            # first live tick after respawn pays the XLA trace while
+            # buffered client RPCs are already draining — the ~4.7 s stall
+            # vs the 5 s strict RPC timeout ISSUE 7 measured. With
+            # [aoi] fuse_logic this also compiles the FUSED step jit for
+            # the restored program set (service.prewarm_fused), so the
+            # first post-restore fused dispatch adds no fresh trace.
+            # (The AOI engine itself is already hot: warmup() ran above,
+            # and any tier growth during restore compiled synchronously
+            # here too.)
             rt.slabs.prewarm_tick_hooks()
         elif entity_manager.get_nil_space() is None:
             entity_manager.create_nil_space(self.gameid)

@@ -853,6 +853,111 @@ def _step_pallas(
     return enter_ctx, leave_ctx, out, next_grid
 
 
+# --- fused entity logic ------------------------------------------------------
+#
+# [aoi] fuse_logic (ROADMAP item 2, the AsyncTaichi inter-kernel-fusion
+# end-state): per-class pure tick programs (entity/columns.columnar_tick)
+# ride the SAME device launch as the AOI step. The fused wrapper never
+# changes what the step computes — the diff runs on the dispatched epoch
+# exactly as before — it additionally applies each program elementwise to
+# the dispatched (pos, y, yaw, columns) and returns the results as extra
+# outputs. The host writes them back just before the NEXT dispatch
+# (aoi/batched.py _consume_fused), so the program's output becomes the
+# next dispatched epoch: logic rides the AOI cadence, trajectories are
+# bit-identical to running the same vmapped program host-side after each
+# dispatch, and every engine's event-exactness machinery (fast guards,
+# carried grids, strip layout) is untouched.
+
+
+def _fused_program_apply(prog, x, y, z, yaw, dt, cols):
+    """One program, vmapped over every row (masking is the caller's)."""
+    vfn = jax.vmap(prog.fn, in_axes=(0, 0, 0, 0, None) + (0,) * len(cols))
+    return vfn(x, y, z, yaw, dt, *cols)
+
+
+def _apply_fused_logic(programs, pos, y, yaw, sel, dt, cols):
+    """Apply each fused program to its rows (``sel == k+1``; 0 = no
+    program). ``cols`` is the flat per-program concatenation of column
+    arrays. The Python loop over ``programs`` runs at TRACE time — the
+    compiled launch contains only the unrolled elementwise ops. Returns
+    (new_pos [N,2], new_y, new_yaw, new_cols tuple)."""
+    x = pos[:, 0]
+    z = pos[:, 1]
+    new = [x, y, z, yaw]
+    out_cols = list(cols)
+    off = 0
+    for k, prog in enumerate(programs):
+        nc = len(prog.columns)
+        pc = tuple(cols[off + i] for i in range(nc))
+        outs = _fused_program_apply(prog, x, y, z, yaw, dt, pc)
+        m = sel == jnp.int32(k + 1)
+        for i in range(4):
+            new[i] = jnp.where(m, outs[i].astype(new[i].dtype), new[i])
+        for i in range(nc):
+            base = out_cols[off + i]
+            out_cols[off + i] = jnp.where(
+                m, outs[4 + i].astype(base.dtype), base)
+        off += nc
+    new_pos = jnp.stack([new[0], new[2]], axis=1)
+    return new_pos, new[1], new[3], tuple(out_cols)
+
+
+def _step_packed_fused_jnp(
+    p: NeighborParams, programs,
+    ppos, pact, pspc, prad, pos, act, spc, rad, y, yaw, sel, dt, *cols,
+):
+    """The jnp step plus the fused entity logic in one launch (gwlint
+    HOT_PATHS: body must stay loop-free — the trace-time program loop
+    lives in _apply_fused_logic)."""
+    enter_ids, leave_ids, out = _step_packed_jnp(
+        p, ppos, pact, pspc, prad, pos, act, spc, rad
+    )
+    new_pos, new_y, new_yaw, new_cols = _apply_fused_logic(
+        programs, pos, y, yaw, sel, dt, cols
+    )
+    return enter_ids, leave_ids, out, (new_pos, new_y, new_yaw) + new_cols
+
+
+def _step_packed_fused_pallas(
+    p: NeighborParams, interpret: bool, programs,
+    ppos, pact, pspc, prad,
+    pcx, pcz, psm, ptable, pslot, porder, pdst,
+    pos, act, spc, rad, y, yaw, sel, dt, *cols,
+):
+    """The Pallas step plus the fused entity logic in one launch (the
+    logic is jnp elementwise around the kernel; XLA fuses it into the
+    same executable — still exactly one dispatch per tick)."""
+    enter_ctx, leave_ctx, out, next_grid = _step_pallas(
+        p, interpret,
+        ppos, pact, pspc, prad,
+        pcx, pcz, psm, ptable, pslot, porder, pdst,
+        pos, act, spc, rad,
+    )
+    new_pos, new_y, new_yaw, new_cols = _apply_fused_logic(
+        programs, pos, y, yaw, sel, dt, cols
+    )
+    return enter_ctx, leave_ctx, out, next_grid, (
+        (new_pos, new_y, new_yaw) + new_cols
+    )
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_step_packed_fused(params: NeighborParams, backend: str,
+                              programs: tuple):
+    """One jit per (params, backend, program tuple): the program set is
+    part of the compiled launch. Program churn (a new class adopted) is a
+    new trace — rare, like a tier jump, and prewarmable
+    (NeighborEngine.warmup_fused)."""
+    if backend == "jnp":
+        fn = functools.partial(_step_packed_fused_jnp, params, programs)
+    else:
+        fn = functools.partial(
+            _step_packed_fused_pallas, params,
+            backend == "pallas_interpret", programs,
+        )
+    return jax.jit(fn)
+
+
 # --- jit wrappers ------------------------------------------------------------
 
 
@@ -927,13 +1032,18 @@ class PendingStep:
     engine's documented delivery model anyway (batched.py docstring).
     """
 
-    __slots__ = ("_engine", "_pager", "_out", "_collected")
+    __slots__ = ("_engine", "_pager", "_out", "_collected", "fused")
 
     def __init__(self, engine: "NeighborEngine", pager, out) -> None:
         self._engine = engine
         self._pager = pager  # pager(which, remaining, start_flat) -> pairs
         self._out = out
         self._collected = False
+        # Fused-tick payload, set by the dispatching caller when the step
+        # carried entity logic: (programs, sel slot-space snapshot,
+        # row→slot perm or None, device output arrays). Consumed exactly
+        # once by BatchAOIService._consume_fused before the next dispatch.
+        self.fused = None
         start_host_copy(out)
 
     def is_ready(self) -> bool:
@@ -1082,6 +1192,10 @@ class NeighborEngine:
                 start = start + take if rank_paging else aux[take - 1] + 1
         return np.concatenate(chunks)
 
+    # The batched service may hand this engine a fused-logic payload
+    # (aoi/batched.py _build_logic); sharded variants opt in separately.
+    supports_fused_logic = True
+
     def step_async(
         self,
         pos: np.ndarray,
@@ -1089,6 +1203,7 @@ class NeighborEngine:
         space: np.ndarray,
         radius: np.ndarray,
         meta_dirty: bool = True,
+        logic: tuple | None = None,
     ) -> PendingStep:
         """Dispatch one tick without blocking; collect() fetches the events.
 
@@ -1100,6 +1215,14 @@ class NeighborEngine:
         since the previous step: the device-resident copies are reused and
         only positions are uploaded (~half the per-tick host→device bytes;
         spawn/despawn/space/radius changes are rare relative to movement).
+
+        ``logic = (programs, sel, y, yaw, dt, cols)`` fuses the per-class
+        entity-logic programs into the SAME launch (see the fused-logic
+        section above): the AOI diff is computed exactly as without logic,
+        and the programs' outputs over the dispatched epoch ride back on
+        ``pending.fused`` for the caller to write back before the next
+        dispatch. ``sel`` is int32[capacity] (program index + 1, 0 = none),
+        ``cols`` the flat per-program column arrays.
         """
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
@@ -1118,26 +1241,103 @@ class NeighborEngine:
         else:
             meta = self._state[1:4]
         cur = (jnp.array(pos, jnp.float32),) + meta
-        if self.backend == "jnp":
+        fused_out = None
+        if logic is not None:
+            programs, sel, y, yaw, dt, cols = logic
+            jit_fused = _jitted_step_packed_fused(
+                self.params, self.backend, tuple(programs)
+            )
+            extra = (
+                jnp.array(y, jnp.float32),
+                jnp.array(yaw, jnp.float32),
+                jnp.array(sel, jnp.int32),
+                jnp.float32(dt),
+            ) + tuple(jnp.array(c) for c in cols)
+            if self.backend == "jnp":
+                enter_ids, leave_ids, out, fused_out = jit_fused(
+                    *self._state, *cur, *extra
+                )
+                next_state = cur
+            else:
+                enter_ctx, leave_ctx, out, next_grid, fused_out = jit_fused(
+                    *self._state, *cur, *extra
+                )
+                next_state = cur + next_grid
+        elif self.backend == "jnp":
             enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
             next_state = cur
-
-            def pager(which, remaining, start):
-                ids = enter_ids if which == "enter" else leave_ids
-                return self._page((ids,), remaining, start)
-
         else:
             enter_ctx, leave_ctx, out, next_grid = self._jit_step(
                 *self._state, *cur
             )
             next_state = cur + next_grid
 
+        if self.backend == "jnp":
+            def pager(which, remaining, start):
+                ids = enter_ids if which == "enter" else leave_ids
+                return self._page((ids,), remaining, start)
+        else:
             def pager(which, remaining, start):
                 ctx = enter_ctx if which == "enter" else leave_ctx
                 return self._page(ctx, remaining, start)
 
         self._state = next_state
-        return PendingStep(self, pager, out)
+        pending = PendingStep(self, pager, out)
+        if fused_out is not None:
+            for arr in fused_out:
+                start_host_copy(arr)
+            pending.fused = (tuple(logic[0]), np.asarray(logic[1]),
+                             None, fused_out)
+        return pending
+
+    def warmup_fused(self, programs: tuple, col_dtypes: tuple) -> None:
+        """Compile the fused step jit for ``programs`` WITHOUT touching
+        engine state: an all-zero dummy call at full capacity populates
+        the lru jit cache so the first real fused dispatch (or the first
+        one after a freeze→restore respawn) pays no XLA trace inside the
+        game loop. ``col_dtypes`` must match the flat per-program column
+        dtypes of the real calls."""
+        n = self.params.capacity
+        zeros = (
+            jnp.zeros((n, 2), jnp.float32),
+            jnp.zeros((n,), jnp.bool_),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.float32),
+        )
+        state: tuple = zeros
+        if self.backend != "jnp":
+            table_size = self.params.num_buckets * LANES
+            state = state + (
+                jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.full((table_size,), n, jnp.int32),
+                jnp.full((n,), -1, jnp.int32),
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.full((n,), table_size, jnp.int32),
+            )
+        extra = (
+            jnp.zeros((n,), jnp.float32),  # y
+            jnp.zeros((n,), jnp.float32),  # yaw
+            jnp.zeros((n,), jnp.int32),  # sel
+            jnp.float32(0.0),  # dt
+        ) + tuple(jnp.zeros((n,), np.dtype(d)) for d in col_dtypes)
+        jit_fused = _jitted_step_packed_fused(
+            self.params, self.backend, tuple(programs)
+        )
+        jax.block_until_ready(jit_fused(*state, *zeros, *extra)[2])
+
+    def fused_trace_count(self, programs: tuple) -> int:
+        """Compiled-trace count of the fused step jit for ``programs`` —
+        the one-launch regression gate asserts this stays at 1 across
+        steady-state ticks (and across a restore after warmup_fused)."""
+        jit_fused = _jitted_step_packed_fused(
+            self.params, self.backend, tuple(programs)
+        )
+        try:
+            return int(jit_fused._cache_size())
+        except Exception:  # pragma: no cover - private-API drift
+            return -1
 
     def step(
         self,

@@ -40,6 +40,7 @@ from goworld_tpu.ops.neighbor import (
     LANES,
     _PACK,
     NeighborParams,
+    _apply_fused_logic,
     _bins,
     _build_table,
     _fast_guard,
@@ -316,6 +317,48 @@ def _sharded_drain(
     return pairs, idx[None]
 
 
+def _sharded_step_fused(
+    p: NeighborParams, events_inline: int, programs,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    y_l, yaw_l, sel_l, dt_l, *cols_l,
+):
+    """The all-gather step plus fused entity logic on this shard's LOCAL
+    rows (elementwise — no extra comms). Used by the spatial engine's
+    exact-fallback ticks so a teleport/overflow tick still advances the
+    fused programs; outputs are in ROW space, mapped back through the
+    dispatch-time perm snapshot by the caller."""
+    enter_ids, leave_ids, out = _sharded_step(
+        p, events_inline,
+        ppos_l, pact_l, pspc_l, prad_l,
+        pos_l, act_l, spc_l, rad_l,
+    )
+    new_pos, new_y, new_yaw, new_cols = _apply_fused_logic(
+        programs, pos_l, y_l, yaw_l, sel_l, dt_l[0], cols_l
+    )
+    return enter_ids, leave_ids, out, (new_pos, new_y, new_yaw) + new_cols
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_sharded_step_fused(
+    params: NeighborParams, mesh: Mesh, events_inline: int,
+    programs: tuple, n_cols: int,
+):
+    shard_map = resolve_shard_map()
+
+    body = functools.partial(
+        _sharded_step_fused, params, events_inline, programs
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * (12 + n_cols),
+        out_specs=(spec, spec, spec, (spec,) * (3 + n_cols)),
+    )
+    return jax.jit(mapped)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int):
     shard_map = resolve_shard_map()
@@ -392,7 +435,8 @@ class ShardedPendingStep:
     """In-flight sharded tick; ``collect()`` = ONE blocking host read of the
     stacked per-shard packed buffers, then (rare) storm paging."""
 
-    __slots__ = ("_engine", "_enter_ctx", "_leave_ctx", "_out", "_collected")
+    __slots__ = ("_engine", "_enter_ctx", "_leave_ctx", "_out", "_collected",
+                 "fused")
 
     def __init__(self, engine, enter_ctx, leave_ctx, out) -> None:
         self._engine = engine
@@ -400,6 +444,9 @@ class ShardedPendingStep:
         self._leave_ctx = leave_ctx
         self._out = out
         self._collected = False
+        # Fused-tick payload (same contract as PendingStep.fused): set by
+        # the dispatching engine when the launch carried entity logic.
+        self.fused = None
         start_host_copy(out)
 
     def is_ready(self) -> bool:

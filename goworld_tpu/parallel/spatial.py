@@ -65,6 +65,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from goworld_tpu.ops.neighbor import (
     NeighborParams,
+    _apply_fused_logic,
     _bins,
     _drain_ids,
     _gather_cands,
@@ -79,6 +80,7 @@ from goworld_tpu.parallel.mesh import (
     ShardedPendingStep,
     _jitted_sharded_drain,
     _jitted_sharded_step,
+    _jitted_sharded_step_fused,
 )
 
 # Halo feature-block bytes per exchanged row: f32 (px, pz, x, z) + i32
@@ -278,6 +280,61 @@ def _spatial_drain(
     ent = jnp.where(ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n)
     pairs = jnp.stack([ent, pairs[:, 1]], axis=1)
     return pairs, idx[None]
+
+
+def _spatial_step_fused_impl(
+    p: NeighborParams,
+    events_inline: int,
+    halo_cap: int,
+    n_dev: int,
+    programs,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    slot_l,
+    send_lo_idx,
+    send_hi_idx,
+    y_l, yaw_l, sel_l, dt_l, *cols_l,
+):
+    """The spatial halo-exchange step plus fused entity logic on this
+    shard's LOCAL rows. The logic is elementwise per row — it never
+    crosses a seam, needs no halo, and leaves every layout invariant of
+    the spatial step untouched (the diff runs on the dispatched epoch
+    exactly as unfused). Logic inputs/outputs are in ROW-permuted layout:
+    the host uploads sel/y/yaw/columns through the same ``perm`` as the
+    positions, and writes the outputs back through the dispatch-time perm
+    snapshot (a strip migration or re-plan between dispatches therefore
+    CANNOT misroute or reset a column — the satellite contract pinned in
+    tests/test_spatial.py)."""
+    enter_ids, leave_ids, out = _spatial_step_impl(
+        p, events_inline, halo_cap, n_dev,
+        ppos_l, pact_l, pspc_l, prad_l,
+        pos_l, act_l, spc_l, rad_l,
+        slot_l, send_lo_idx, send_hi_idx,
+    )
+    new_pos, new_y, new_yaw, new_cols = _apply_fused_logic(
+        programs, pos_l, y_l, yaw_l, sel_l, dt_l[0], cols_l
+    )
+    return enter_ids, leave_ids, out, (new_pos, new_y, new_yaw) + new_cols
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_step_fused(
+    params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int,
+    programs: tuple, n_cols: int,
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(
+        _spatial_step_fused_impl, params, events_inline, halo_cap,
+        mesh.devices.size, programs,
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * (15 + n_cols),
+        out_specs=(spec, spec, spec, (spec,) * (3 + n_cols)),
+    )
+    return jax.jit(mapped)
 
 
 @functools.lru_cache(maxsize=None)
@@ -589,6 +646,10 @@ class SpatialShardedNeighborEngine:
 
     # --- dispatch -----------------------------------------------------------
 
+    # Fused entity logic is supported: per-row elementwise programs ride
+    # the spatial launch in row-permuted layout (see _spatial_step_fused).
+    supports_fused_logic = True
+
     def step_async(
         self,
         pos: np.ndarray,
@@ -596,6 +657,7 @@ class SpatialShardedNeighborEngine:
         space: np.ndarray,
         radius: np.ndarray,
         meta_dirty: bool = True,
+        logic: tuple | None = None,
     ):
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
@@ -724,20 +786,54 @@ class SpatialShardedNeighborEngine:
             meta = self._state[1:4]
         cur_dev = (put(cur[0][perm]),) + meta
 
+        fused_out = None
+        logic_dev: tuple = ()
+        if logic is not None:
+            # Row-permuted upload of the fused-logic inputs: the programs
+            # run per LOCAL row, so sel/y/yaw/columns travel through the
+            # same perm as the positions; dt rides as a [D] sharded array
+            # (one scalar per shard body).
+            programs, sel, y, yaw, dt, cols = logic
+            logic_dev = (
+                put(np.asarray(y, np.float32)[perm]),
+                put(np.asarray(yaw, np.float32)[perm]),
+                put(np.asarray(sel, np.int32)[perm]),
+                put(np.full(self.n_devices, dt, np.float32)),
+            ) + tuple(put(np.asarray(c)[perm]) for c in cols)
+
         if fallback_reason is None:
-            enter_ids, leave_ids, out = self._jit_step(
-                *self._state, *cur_dev, self._perm_dev,
-                put(send_lo), put(send_hi),
-            )
+            if logic is not None:
+                jit_fused = _jitted_spatial_step_fused(
+                    self.params, self.mesh, self.events_inline,
+                    self.halo_cap, tuple(logic[0]), len(logic[5]),
+                )
+                enter_ids, leave_ids, out, fused_out = jit_fused(
+                    *self._state, *cur_dev, self._perm_dev,
+                    put(send_lo), put(send_hi), *logic_dev,
+                )
+            else:
+                enter_ids, leave_ids, out = self._jit_step(
+                    *self._state, *cur_dev, self._perm_dev,
+                    put(send_lo), put(send_hi),
+                )
             enter_ctx = ("spatial", enter_ids, self._perm_dev)
             leave_ctx = ("spatial", leave_ids, self._perm_dev)
             self.last_mode = "spatial"
             self._m_halo_bytes.inc(self.halo_bytes_per_tick)
             pending = ShardedPendingStep(self, enter_ctx, leave_ctx, out)
         else:
-            enter_ids, leave_ids, out = self._jit_fallback(
-                *self._state, *cur_dev
-            )
+            if logic is not None:
+                jit_fused = _jitted_sharded_step_fused(
+                    self.params, self.mesh, self.events_inline,
+                    tuple(logic[0]), len(logic[5]),
+                )
+                enter_ids, leave_ids, out, fused_out = jit_fused(
+                    *self._state, *cur_dev, *logic_dev,
+                )
+            else:
+                enter_ids, leave_ids, out = self._jit_fallback(
+                    *self._state, *cur_dev
+                )
             enter_ctx = ("fallback", enter_ids)
             leave_ctx = ("fallback", leave_ids)
             self.last_mode = f"fallback:{fallback_reason}"
@@ -747,10 +843,72 @@ class SpatialShardedNeighborEngine:
                 self, enter_ctx, leave_ctx, out, perm.copy()
             )
 
+        if fused_out is not None:
+            from goworld_tpu.ops.neighbor import start_host_copy
+
+            for arr in fused_out:
+                start_host_copy(arr)
+            # Outputs are in ROW space: the perm SNAPSHOT maps row→slot at
+            # writeback time, immune to later migrations/re-plans.
+            pending.fused = (tuple(logic[0]), np.asarray(logic[1]),
+                             perm.copy(), fused_out)
+
         self._state = cur_dev
         self._host_prev = cur
         self._prev_cx = cx
         return pending
+
+    def warmup_fused(self, programs: tuple, col_dtypes: tuple) -> None:
+        """Compile BOTH fused programs (spatial + exact fallback) for this
+        program set without touching engine state — the spatial analog of
+        NeighborEngine.warmup_fused (restore-path prewarm)."""
+        n = self.params.capacity
+        d = self.n_devices
+        put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
+        zeros = (
+            put(np.zeros((n, 2), np.float32)),
+            put(np.zeros((n,), bool)),
+            put(np.zeros((n,), np.int32)),
+            put(np.zeros((n,), np.float32)),
+        )
+        logic_dev = (
+            put(np.zeros(n, np.float32)),
+            put(np.zeros(n, np.float32)),
+            put(np.zeros(n, np.int32)),
+            put(np.zeros(d, np.float32)),
+        ) + tuple(put(np.zeros(n, np.dtype(dt))) for dt in col_dtypes)
+        ncols = len(col_dtypes)
+        perm = put(np.arange(n, dtype=np.int32))
+        empty_band = put(np.full(d * self.halo_cap, self.chunk, np.int32))
+        jit_sp = _jitted_spatial_step_fused(
+            self.params, self.mesh, self.events_inline, self.halo_cap,
+            tuple(programs), ncols,
+        )
+        jax.block_until_ready(
+            jit_sp(*zeros, *zeros, perm, empty_band, empty_band,
+                   *logic_dev)[2])
+        jit_fb = _jitted_sharded_step_fused(
+            self.params, self.mesh, self.events_inline,
+            tuple(programs), ncols,
+        )
+        jax.block_until_ready(jit_fb(*zeros, *zeros, *logic_dev)[2])
+
+    def fused_trace_count(self, programs: tuple) -> int:
+        """Trace count of the fused SPATIAL jit for ``programs`` (the
+        no-fresh-trace restore gate; the fallback jit is warmed alongside
+        but not counted here)."""
+        jit_sp = _jitted_spatial_step_fused(
+            self.params, self.mesh, self.events_inline, self.halo_cap,
+            tuple(programs), self._warmed_ncols(programs),
+        )
+        try:
+            return int(jit_sp._cache_size())
+        except Exception:  # pragma: no cover - private-API drift
+            return -1
+
+    @staticmethod
+    def _warmed_ncols(programs: tuple) -> int:
+        return sum(len(p.columns) for p in programs)
 
     def _build_bands(self, cx, cur_act, prev_act):
         """Per-shard send-index arrays for both seams (flattened

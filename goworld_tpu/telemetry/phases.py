@@ -26,6 +26,14 @@ Usage, inside a loop that must stay cheap (the 5 ms game tick):
 Cost per tick: one monotonic() call per mark, a small-dict accumulate, and
 one histogram observe per touched phase at commit — microseconds against a
 5 ms tick budget.
+
+Phase semantics under the fused tick ([aoi] fuse_logic, entity/columns.py):
+per-class columnar tick programs compile INTO the AOI device launch, so
+``run_tick_batches`` skips them and ``entity_logic`` collapses to the
+residual host work (timers, crontab, post queue, non-fusable hooks) while
+the logic cost moves inside the ``aoi`` phase's device step — the collapse
+is the observable signature that fusion is live (``bench.py --fused``
+reports it; aoi_fused_classes/aoi_fused_slots on /metrics name the cause).
 """
 
 from __future__ import annotations

@@ -382,6 +382,19 @@ def test_r6_covers_rebalance_and_client_sections():
     assert "rpc_timeout" in fams["client"]
 
 
+def test_r6_covers_fuse_logic_key():
+    """ISSUE 12 satellite: the [aoi] fuse_logic key is documented in the
+    sample AND consumed by read_config — inside R6's coverage, so future
+    drift in either direction fails the gate."""
+    import os
+
+    from goworld_tpu.analysis.rules import _sample_keys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fams, _lines = _sample_keys(root)
+    assert "fuse_logic" in fams["aoi"]
+
+
 # --- R7: proto conformance ---------------------------------------------------
 
 

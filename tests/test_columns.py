@@ -1,0 +1,515 @@
+"""Columnar ECS attribute subsystem + fused single-launch tick (ISSUE 12).
+
+Four layers:
+
+- Column attr declaration/storage: define_attr("Column") proxying through
+  entity.attrs, defaults, dtype rules, grow/release/recycle, migrate and
+  freeze round-trips (the msgpack blob carries plain scalars).
+- columnar_tick (unfused): vectorized hook behavior over position +
+  Column attrs, prewarm surface.
+- The fused engine contract (ops/neighbor): a randomized fused-vs-unfused
+  trajectory oracle — same inputs, the fused launch must produce the
+  EXACT event stream of the unfused step and the EXACT trajectory of
+  applying the same vmapped program host-side after each dispatch.
+- The fused service (aoi/batched): one launch per steady-state tick
+  (per-class hook jit never traced — the gating regression test), host
+  writes win over in-flight writeback, release fencing, freeze→restore
+  with no fresh trace, and automatic fallbacks.
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.entity import entity_manager as em
+from goworld_tpu.entity.columns import (
+    ColumnBackedMapAttr,
+    ColumnSpec,
+    FusedProgram,
+    columnar_tick,
+)
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.slabs import (
+    SIF_SYNC_NEIGHBOR_CLIENTS,
+    SIF_SYNC_OWN_CLIENT,
+)
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.vector import Vector3
+from goworld_tpu.ops.neighbor import NeighborEngine, NeighborParams
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    em.cleanup_for_tests()
+    yield
+    em.cleanup_for_tests()
+
+
+def _drift(x, y, z, yaw, dt, vx, hp):
+    return x + vx * dt, y, z, yaw + dt, vx, hp - dt
+
+
+def make_columnar_class(name="ColAvatar", use_aoi=False, extra_flags=()):
+    class ColAvatar(Entity):
+        on_tick_batch = columnar_tick(_drift, ("vx", "hp"))
+
+        @classmethod
+        def describe_entity_type(cls, desc):
+            if use_aoi:
+                desc.set_use_aoi(True, 100.0)
+            desc.define_attr("vx", "Column")
+            desc.define_attr("hp", "Column", *extra_flags,
+                             default=100.0)
+
+    em.register_entity(ColAvatar, name)
+    return ColAvatar
+
+
+# --- column attr storage ------------------------------------------------------
+
+
+def test_column_attr_proxies_to_slab():
+    make_columnar_class()
+    e = em.create_entity_locally("ColAvatar")
+    slabs = em.runtime.slabs
+    assert isinstance(e.attrs, ColumnBackedMapAttr)
+    # Defaults applied at alloc.
+    assert e.attrs["hp"] == 100.0
+    assert e.attrs["vx"] == 0.0
+    # Writes land in the column; reads come back as plain Python floats.
+    e.attrs["hp"] = 55.5
+    assert slabs.columns["hp"][e._slot] == np.float32(55.5)
+    assert isinstance(e.attrs["hp"], float)
+    # Non-column keys stay dict attrs.
+    e.attrs["name"] = "bob"
+    assert e.attrs["name"] == "bob"
+    assert "name" not in slabs.columns
+    d = e.attrs.to_dict()
+    assert d["hp"] == pytest.approx(55.5) and d["name"] == "bob"
+    assert set(e.attrs.keys()) >= {"vx", "hp", "name"}
+    assert e.attrs.has("hp") and "hp" in e.attrs
+    assert e.attrs.get_float("hp") == pytest.approx(55.5)
+    with pytest.raises(ValueError, match="cannot be deleted"):
+        del e.attrs["hp"]
+
+
+def test_column_defaults_reset_on_release_and_realloc():
+    make_columnar_class()
+    slabs = em.runtime.slabs
+    e = em.create_entity_locally("ColAvatar")
+    slot = e._slot
+    e.attrs["hp"] = 1.0
+    e.destroy()
+    # Released row resets to the declared default (no leak to next tenant)
+    assert slabs.columns["hp"][slot] == np.float32(100.0)
+    # Post-destroy reads stay valid via the release-time snapshot.
+    assert e.attrs["hp"] == pytest.approx(1.0)
+    e2 = em.create_entity_locally("ColAvatar")
+    assert e2.attrs["hp"] == 100.0
+
+
+def test_column_survives_slab_grow():
+    make_columnar_class()
+    slabs = em.runtime.slabs
+    ents = [em.create_entity_locally("ColAvatar") for _ in range(8)]
+    for i, e in enumerate(ents):
+        e.attrs["hp"] = float(i)
+    cap0 = slabs.capacity
+    slabs.ensure_capacity(cap0 * 2)
+    assert slabs.columns["hp"].shape[0] == slabs.capacity
+    for i, e in enumerate(ents):
+        assert e.attrs["hp"] == float(i)
+    # New region carries the declared default, not zero.
+    assert slabs.columns["hp"][cap0:].max() == np.float32(100.0)
+    assert slabs.columns["hp"][cap0:].min() == np.float32(100.0)
+
+
+def test_column_spec_conflict_rejected():
+    slabs = em.runtime.slabs
+    slabs.ensure_column(ColumnSpec("mana", "float32", 5.0))
+    with pytest.raises(ValueError, match="redeclared"):
+        slabs.ensure_column(ColumnSpec("mana", "int32", 5))
+    with pytest.raises(ValueError, match="dtype"):
+        ColumnSpec("bad", "complex64")
+
+
+def test_column_int_dtype_round_trips_as_int():
+    class Scorer(Entity):
+        @classmethod
+        def describe_entity_type(cls, desc):
+            desc.define_attr("score", "Column", dtype="int32", default=7)
+
+    em.register_entity(Scorer)
+    e = em.create_entity_locally("Scorer")
+    assert e.attrs["score"] == 7 and isinstance(e.attrs["score"], int)
+    e.attrs["score"] = 123
+    assert em.runtime.slabs.columns["score"][e._slot] == 123
+
+
+def test_column_streams_attr_changes_to_client():
+    """A per-entity set() on a Client-flagged Column attr streams exactly
+    like a dict attr (the vectorized paths don't stream — by design)."""
+    make_columnar_class(extra_flags=("Client",))
+    sent = []
+
+    class FakeClient:
+        clientid = "C" * 16
+        gateid = 1
+        gate_gen = 0
+        owner_id = ""
+
+        def send_map_attr_change(self, eid, path, key, val):
+            sent.append((eid, tuple(path), key, val))
+
+    e = em.create_entity_locally("ColAvatar")
+    e._client = FakeClient()  # bypass binding machinery; streaming only
+    e.attrs["hp"] = 42.0
+    assert sent == [(e.id, (), "hp", 42.0)]
+
+
+def test_column_migrate_roundtrip_and_freeze():
+    """Columns ride the EXISTING migrate/freeze blob as plain scalars —
+    and restore routes them back into the fresh slot's columns."""
+    em.register_space(Space)
+    make_columnar_class()
+    space = em.create_space_locally(1)
+    e = em.create_entity_locally("ColAvatar", space=space, pos=Vector3())
+    eid = e.id
+    e.attrs["hp"] = 61.25
+    e.attrs["vx"] = -2.5
+    e.attrs["title"] = "capt"
+    data = e.get_migrate_data()
+    assert data["attrs"]["hp"] == pytest.approx(61.25)
+    assert isinstance(data["attrs"]["hp"], float)  # msgpack-safe scalar
+    e._destroy(is_migrate=True)
+    restored = em.restore_entity(eid, data, is_migrate=True)
+    slabs = em.runtime.slabs
+    assert slabs.columns["hp"][restored._slot] == np.float32(61.25)
+    assert restored.attrs["vx"] == pytest.approx(-2.5)
+    assert restored.attrs["title"] == "capt"
+
+
+def test_column_persistent_filter_sees_columns():
+    make_columnar_class(extra_flags=("Persistent",))
+    e = em.create_entity_locally("ColAvatar")
+    e.attrs["hp"] = 9.0
+    assert e.persistent_attrs() == {"hp": pytest.approx(9.0)}
+
+
+# --- columnar_tick (unfused) --------------------------------------------------
+
+
+def test_columnar_tick_unfused_updates_positions_and_columns():
+    make_columnar_class()
+    ents = [em.create_entity_locally("ColAvatar") for _ in range(5)]
+    for i, e in enumerate(ents):
+        e.set_position(Vector3(float(i), 0.0, 0.0))
+        e.attrs["vx"] = float(i + 1)
+    em.collect_entity_sync_infos()  # drain creation flags
+    slabs = em.runtime.slabs
+    bucket = slabs._tick_buckets[type(ents[0])]
+    slabs.run_tick_batches(bucket.last_tick + 0.5)  # dt = exactly 0.5
+    # x += vx * dt; hp -= dt; yaw += dt — all through the vmapped hook.
+    for i, e in enumerate(ents):
+        assert e.position.x == pytest.approx(i + (i + 1) * 0.5, abs=1e-4)
+        assert e.attrs["hp"] == pytest.approx(100.0 - 0.5, abs=1e-4)
+        assert e._sync_info_flag & SIF_SYNC_OWN_CLIENT
+
+
+def test_columnar_tick_prewarm_no_fresh_trace():
+    cls = make_columnar_class()
+    for _ in range(4):
+        em.create_entity_locally("ColAvatar")
+    hook = cls.on_tick_batch.__func__
+    assert hook.jit_cache_size() == 0
+    em.runtime.slabs.prewarm_tick_hooks()
+    assert hook.jit_cache_size() == 1
+    em.runtime.slabs.run_tick_batches()
+    assert hook.jit_cache_size() == 1  # same shapes: no fresh trace
+
+
+# --- fused engine oracle ------------------------------------------------------
+
+
+ENGINE_PARAMS = NeighborParams(
+    capacity=128, cell_size=100.0, grid_x=16, grid_z=16,
+    space_slots=2, cell_capacity=32, max_events=4096,
+)
+
+
+def test_fused_vs_unfused_randomized_oracle():
+    """THE parity oracle (same discipline as the sharded engine's single-
+    device oracle): a random world driven through the fused launch must
+    produce (a) the exact event stream of the unfused engine on the same
+    uploads and (b) the exact trajectory of applying the same vmapped
+    program host-side after each dispatch — positions, yaw and columns
+    bit-identical, across spawn/despawn churn and multi-program worlds."""
+    import jax
+
+    p = ENGINE_PARAMS
+    n = p.capacity
+    fused = NeighborEngine(p, backend="jnp")
+    unfused = NeighborEngine(p, backend="jnp")
+    fused.reset()
+    unfused.reset()
+
+    def prog_a(x, y, z, yaw, dt, vx, hp):
+        return x + vx * dt, y, z + 0.25 * dt, yaw + 3.0 * dt, vx, hp - dt
+
+    def prog_b(x, y, z, yaw, dt, cool):
+        return x, y + dt, z, yaw, cool * 0.5
+
+    pa = FusedProgram(prog_a, ("vx", "hp"))
+    pb = FusedProgram(prog_b, ("cool",))
+    vfa = jax.jit(jax.vmap(prog_a, in_axes=(0, 0, 0, 0, None, 0, 0)))
+    vfb = jax.jit(jax.vmap(prog_b, in_axes=(0, 0, 0, 0, None, 0)))
+
+    rng = np.random.default_rng(12)
+    pos = rng.uniform(0, 1600, (n, 2)).astype(np.float32)
+    act = np.zeros(n, bool)
+    act[: n - 16] = True
+    spc = rng.integers(0, 2, n).astype(np.int32)
+    rad = np.full(n, 100.0, np.float32)
+    y = np.zeros(n, np.float32)
+    yaw = rng.uniform(0, 360, n).astype(np.float32)
+    vx = rng.normal(0, 30, n).astype(np.float32)
+    hp = np.full(n, 100.0, np.float32)
+    cool = rng.uniform(0, 8, n).astype(np.float32)
+    sel = rng.integers(0, 3, n).astype(np.int32)  # 0=none, 1=a, 2=b
+
+    rpos, ry, ryaw = pos.copy(), y.copy(), yaw.copy()
+    rvx, rhp, rcool = vx.copy(), hp.copy(), cool.copy()
+
+    saw_events = 0
+    for t in range(6):
+        dt = 0.05 + 0.01 * t
+        pend = fused.step_async(
+            pos, act, spc, rad,
+            logic=((pa, pb), sel, y, yaw, dt, (vx, hp, cool)))
+        e2, l2, d2 = pend.collect()
+        e1, l1, d1 = unfused.step(rpos, act, spc, rad)
+        assert d1 == d2
+        assert sorted(map(tuple, e1)) == sorted(map(tuple, e2)), f"@ {t}"
+        assert sorted(map(tuple, l1)) == sorted(map(tuple, l2)), f"@ {t}"
+        saw_events += len(e1) + len(l1)
+        # Fused writeback (what the service does before the next dispatch)
+        programs, sel_s, perm, outs = pend.fused
+        assert perm is None and programs == (pa, pb)
+        new_pos, new_y, new_yaw = (np.asarray(a) for a in outs[:3])
+        new_vx, new_hp, new_cool = (np.asarray(a) for a in outs[3:])
+        rows = np.flatnonzero(sel_s)
+        pos[rows] = new_pos[rows]
+        y[rows] = new_y[rows]
+        yaw[rows] = new_yaw[rows]
+        ma = sel_s == 1
+        mb = sel_s == 2
+        vx[ma] = new_vx[ma]
+        hp[ma] = new_hp[ma]
+        cool[mb] = new_cool[mb]
+        # Reference: the SAME programs applied host-side after dispatch.
+        ax, ay, az, ayaw, avx, ahp = (np.asarray(a) for a in vfa(
+            rpos[:, 0], ry, rpos[:, 1], ryaw, np.float32(dt), rvx, rhp))
+        bx, by, bz, byaw, bcool = (np.asarray(a) for a in vfb(
+            rpos[:, 0], ry, rpos[:, 1], ryaw, np.float32(dt), rcool))
+        rpos[ma, 0] = ax[ma]; ry[ma] = ay[ma]; rpos[ma, 1] = az[ma]
+        ryaw[ma] = ayaw[ma]; rvx[ma] = avx[ma]; rhp[ma] = ahp[ma]
+        rpos[mb, 0] = bx[mb]; ry[mb] = by[mb]; rpos[mb, 1] = bz[mb]
+        ryaw[mb] = byaw[mb]; rcool[mb] = bcool[mb]
+        assert np.array_equal(pos, rpos), f"pos diverged @ {t}"
+        assert np.array_equal(y, ry) and np.array_equal(yaw, ryaw)
+        assert np.array_equal(hp, rhp) and np.array_equal(cool, rcool)
+        # Churn: spawn/despawn a few rows to exercise meta-dirty ticks.
+        act = act.copy()
+        act[rng.integers(0, n, 3)] ^= True
+    assert saw_events > 0, "walk produced no events — oracle is vacuous"
+    # One-launch invariant: exactly one fused trace served every tick.
+    assert fused.fused_trace_count((pa, pb)) == 1
+
+
+# --- fused service integration ------------------------------------------------
+
+
+def _fused_world(n=12, fuse=True):
+    """Embedded runtime with a batched AOI space and n fused avatars."""
+    class FusedSpace(Space):
+        def on_space_created(self):
+            if self.kind == 1:
+                self.enable_aoi(100.0)
+
+    em.register_space(FusedSpace)
+    cls = make_columnar_class(use_aoi=True)
+    rt = em.runtime
+    rt.aoi_backend = "batched"
+    rt.aoi_params = NeighborParams(
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=2, cell_capacity=32, max_events=4096)
+    rt.aoi_fuse_logic = fuse
+    space = em.create_space_locally(1)
+    ents = []
+    for i in range(n):
+        e = em.create_entity_locally(
+            "ColAvatar", space=space, pos=Vector3(10.0 * i, 0.0, 10.0))
+        e.attrs["vx"] = 2.0
+        ents.append(e)
+    svc = rt.aoi_service
+    assert svc is not None
+    return cls, svc, ents
+
+
+def test_fused_service_one_launch_trace_counts():
+    """The gating regression test: with fuse_logic on, steady-state ticks
+    are ONE launch — the per-class hook jit is NEVER traced (the host-side
+    entity_logic work is gone), the fused step jit holds exactly one
+    trace, positions/columns advance, and sync flags are set by the
+    writeback exactly like the host hook would."""
+    cls, svc, ents = _fused_world()
+    hook = cls.on_tick_batch.__func__
+    rt = em.runtime
+    x0 = [e.position.x for e in ents]
+    em.collect_entity_sync_infos()  # drain creation flags
+    for _ in range(4):
+        rt.tick()  # run_tick_batches (skips fused class) + svc.tick()
+    assert hook.jit_cache_size() == 0, "fused class's host jit must not run"
+    progs, _ = svc._live_programs()
+    assert progs and svc.engine.fused_trace_count(progs) == 1
+    assert all(e.position.x > x for e, x in zip(ents, x0))
+    assert all(e.attrs["hp"] < 100.0 for e in ents)
+    # Writeback set the sync flags (positions reach clients next collect).
+    flags = rt.slabs.flags[[e._slot for e in ents]]
+    assert ((flags & (SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS))
+            > 0).all()
+
+
+def test_fused_service_host_writes_win():
+    """A host teleport between dispatches must beat the in-flight fused
+    writeback (fused_dirty fence), and the logic resumes FROM the host
+    value on the next tick."""
+    cls, svc, ents = _fused_world(n=4)
+    rt = em.runtime
+    for _ in range(3):
+        rt.tick()
+    e = ents[0]
+    e.set_position(Vector3(555.0, 0.0, 7.0))  # host write, fence set
+    rt.tick()  # in-flight writeback must skip the fenced slot
+    assert e.position.x == pytest.approx(555.0)
+    rt.tick()  # next tick's logic starts from the teleported x
+    assert 555.0 < e.position.x < 556.0
+
+
+def test_fused_service_release_fences_writeback():
+    """An entity destroyed with a fused step in flight: the quarantined
+    slot's columns reset to defaults and the late writeback must not
+    resurrect them (release marks fused_dirty)."""
+    cls, svc, ents = _fused_world(n=4)
+    rt = em.runtime
+    for _ in range(3):
+        rt.tick()
+    e = ents[0]
+    slot = e._slot
+    e.attrs["hp"] = 3.0
+    e.destroy()
+    slabs = rt.slabs
+    assert slabs.columns["hp"][slot] == np.float32(100.0)  # default reset
+    rt.tick()  # consumes the in-flight fused step
+    assert slabs.columns["hp"][slot] == np.float32(100.0)
+    assert slabs.flags[slot] == 0  # no flag resurrection on the dead row
+
+
+def test_fused_fallback_for_hand_written_hooks():
+    """A class with a hand-written on_tick_batch must keep running host-
+    side under fuse_logic (automatic fallback), sharing the world with a
+    fused class."""
+    calls = []
+
+    class Manual(Entity):
+        @classmethod
+        def on_tick_batch(cls, view):
+            calls.append(len(view))
+
+    em.register_entity(Manual)
+    cls, svc, ents = _fused_world(n=3)
+    em.create_entity_locally("Manual")
+    rt = em.runtime
+    for _ in range(2):
+        rt.tick()
+    assert calls and calls[-1] == 1  # manual hook still fires
+    assert svc.takes_over_tick(cls) is True
+    assert svc.takes_over_tick(Manual) is False
+
+
+def test_unfused_service_ignores_fuse_machinery():
+    """fuse_logic off: the host hook runs exactly as before and no fused
+    payload is ever attached to a pending step."""
+    cls, svc, ents = _fused_world(n=3, fuse=False)
+    hook = cls.on_tick_batch.__func__
+    rt = em.runtime
+    x0 = [e.position.x for e in ents]
+    for _ in range(3):
+        rt.tick()
+    assert hook.jit_cache_size() == 1  # host jit did the work
+    assert all(e.position.x > x for e, x in zip(ents, x0))
+    assert svc._pending is None or svc._pending[0].fused is None
+
+
+def test_fused_freeze_restore_preserves_columns_no_fresh_trace():
+    """Freeze→restore with fuse on: the in-flight tick's outputs land
+    before packing (flush), Column values survive the round trip, and
+    prewarm_tick_hooks compiles the fused jit so the first post-restore
+    dispatch adds NO fresh trace (the satellite contract)."""
+    cls, svc, ents = _fused_world(n=6)
+    rt = em.runtime
+    em.create_nil_space(rt.gameid)
+    for _ in range(3):
+        rt.tick()
+    svc.flush()  # freeze barrier: fused outputs land in the slabs
+    hp_before = {e.id: e.attrs["hp"] for e in ents}
+    x_before = {e.id: e.position.x for e in ents}
+    data = em.freeze_entities(rt.gameid)
+    em.reset_world()
+    # "New process": same classes, fresh runtime/slabs/engine.
+    rt = em.runtime
+    rt.aoi_backend = "batched"
+    rt.aoi_params = NeighborParams(
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=2, cell_capacity=32, max_events=4096)
+    rt.aoi_fuse_logic = True
+    rt.get_aoi_service()
+    em.restore_freezed_entities(data)
+    for e in [em.get_entity(i) for i in hp_before]:
+        assert e.attrs["hp"] == pytest.approx(hp_before[e.id])
+        assert e.position.x == pytest.approx(x_before[e.id])
+    # Restore-path prewarm: first live dispatch adds no fresh trace.
+    rt.slabs.prewarm_tick_hooks()
+    svc2 = rt.aoi_service
+    progs, _ = svc2._live_programs()
+    assert progs
+    traces = svc2.engine.fused_trace_count(progs)
+    assert traces == 1
+    rt.tick()
+    rt.tick()
+    assert svc2.engine.fused_trace_count(progs) == traces
+    hook = cls.on_tick_batch.__func__
+    assert hook.jit_cache_size() == 0  # still never host-traced
+
+
+def test_fused_migrate_races_inflight_tick():
+    """Migrate-out while a fused step is in flight (the rebalancer's
+    constant case): the packed blob carries the last HOST-visible column
+    values, and the late writeback cannot corrupt the quarantined slot
+    (release fence) or the restored entity's fresh slot."""
+    cls, svc, ents = _fused_world(n=4)
+    rt = em.runtime
+    for _ in range(3):
+        rt.tick()  # steady fused state; one step in flight
+    e = ents[0]
+    eid = e.id
+    hp_at_pack = e.attrs["hp"]
+    data = e.get_migrate_data()
+    assert data["attrs"]["hp"] == pytest.approx(hp_at_pack)
+    e._destroy(is_migrate=True)
+    rt.tick()  # in-flight step consumed; must not touch the dead slot
+    restored = em.restore_entity(eid, data, is_migrate=True)
+    assert restored.attrs["hp"] == pytest.approx(hp_at_pack)
+    rt.tick()
+    rt.tick()
+    # The restored entity re-joined the fused tick (hp keeps draining).
+    assert restored.attrs["hp"] < hp_at_pack

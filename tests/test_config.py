@@ -163,6 +163,20 @@ def test_aoi_delivery_knob(cfg, tmp_path):
         read_config.set_config_file(None)
 
 
+def test_aoi_fuse_logic_knob(cfg, tmp_path):
+    """[aoi] fuse_logic parses (default off) — ISSUE 12."""
+    assert cfg.aoi.fuse_logic is False  # default
+    on = SAMPLE.replace("backend = xzlist",
+                        "backend = xzlist\nfuse_logic = true")
+    p = tmp_path / "fuse.ini"
+    p.write_text(on)
+    read_config.set_config_file(str(p))
+    try:
+        assert read_config.get().aoi.fuse_logic is True
+    finally:
+        read_config.set_config_file(None)
+
+
 def test_per_game_aoi_platform(cfg, tmp_path):
     """One game may ride the chip while the rest force CPU (single-client
     TPU transports); invalid values fail loudly like [aoi] platform."""

@@ -286,6 +286,98 @@ def test_migrate_carries_pending_sync_flag():
     assert restored.position.x == pytest.approx(5.0)
 
 
+def test_migrate_carries_column_attrs_losslessly():
+    """ISSUE 12 satellite: Column attrs (entity/columns.py) ride the
+    EXISTING msgpack migrate-data blob as plain scalars — no wire-format
+    change, pinned by the schema digest staying exactly at the committed
+    PROTO_VERSION entry (no bump needed)."""
+    from goworld_tpu.proto import schema
+    from goworld_tpu.proto.msgtypes import PROTO_VERSION
+
+    class ColAvatar(Entity):
+        @classmethod
+        def describe_entity_type(cls, desc):
+            desc.set_use_aoi(True)
+            desc.define_attr("hp", "Column", default=100.0)
+            desc.define_attr("combo", "Column", dtype="int32", default=0)
+
+    em.register_entity(ColAvatar)
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("ColAvatar", space=space, pos=Vector3())
+    eid = a.id
+    a.attrs["hp"] = 41.5
+    a.attrs["combo"] = 9
+    data = a.get_migrate_data()
+    # Plain msgpack-safe scalars inside the existing attrs dict.
+    assert data["attrs"]["hp"] == pytest.approx(41.5)
+    assert data["attrs"]["combo"] == 9
+    assert type(data["attrs"]["hp"]) is float
+    assert type(data["attrs"]["combo"]) is int
+    a._destroy(is_migrate=True)
+    restored = em.restore_entity(eid, data, is_migrate=True)
+    assert restored.attrs["hp"] == pytest.approx(41.5)
+    assert restored.attrs["combo"] == 9
+    # The wire contract is untouched: the current schema digest still
+    # matches the committed history entry for the CURRENT version — a
+    # column-induced layout change would fail here (and in gwlint R7).
+    assert schema.SCHEMA_HISTORY[PROTO_VERSION] == schema.schema_digest()
+
+
+def test_migrate_races_inflight_fused_tick():
+    """A rebalancer-commanded migrate packing out while a FUSED AOI step
+    is in flight: the blob carries the last host-visible column values,
+    the late writeback cannot touch the released (quarantined) slot, and
+    the restored entity re-joins the fused tick — the service-level twin
+    lives in tests/test_columns.py; this pins the migrate-data seam."""
+    from goworld_tpu.entity.columns import columnar_tick
+    from goworld_tpu.entity.space import Space as _Space
+    from goworld_tpu.ops.neighbor import NeighborParams
+
+    def drain(x, y, z, yaw, dt, hp):
+        return x + dt, y, z, yaw, hp - dt
+
+    class FusedAvatar(Entity):
+        on_tick_batch = columnar_tick(drain, ("hp",))
+
+        @classmethod
+        def describe_entity_type(cls, desc):
+            desc.set_use_aoi(True)
+            desc.define_attr("hp", "Column", default=100.0)
+
+    class FusedSpace(_Space):
+        def on_space_created(self):
+            if self.kind == 2:
+                self.enable_aoi(100.0)
+
+    em.register_entity(FusedAvatar)
+    em.register_entity(FusedSpace, "FusedSpace")
+    rt = em.runtime
+    rt.aoi_backend = "batched"
+    rt.aoi_params = NeighborParams(
+        capacity=256, cell_size=100.0, grid_x=16, grid_z=16,
+        space_slots=2, cell_capacity=32, max_events=4096)
+    rt.aoi_fuse_logic = True
+    space = em._new_entity(FusedSpace._type_desc, None, None, None, None,
+                           kind=2)
+    a = em.create_entity_locally("FusedAvatar", space=space,
+                                 pos=Vector3(5.0, 0.0, 5.0))
+    for _ in range(3):
+        rt.tick()  # fused steady state; one step in flight
+    old_slot = a._slot
+    hp_at_pack = a.attrs["hp"]
+    data = a.get_migrate_data()
+    assert data["attrs"]["hp"] == pytest.approx(hp_at_pack)
+    a._destroy(is_migrate=True)
+    rt.tick()  # consume the in-flight fused step
+    slabs = rt.slabs
+    assert slabs.columns["hp"][old_slot] == 100.0  # default, not stale
+    restored = em.restore_entity(a.id, data, is_migrate=True)
+    assert restored.attrs["hp"] == pytest.approx(hp_at_pack)
+    rt.tick()
+    rt.tick()
+    assert restored.attrs["hp"] < hp_at_pack  # re-joined the fused tick
+
+
 def test_migrate_while_aoi_leave_quarantined():
     """Migrate-out while a batched AOI step still owes the slot its leave
     events: the slot must quarantine (mapping intact for the in-flight

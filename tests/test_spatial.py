@@ -377,6 +377,104 @@ def test_telemetry_counters_move():
     assert spatial.halo_bytes_per_tick < spatial.allgather_bytes_per_tick
 
 
+def test_fused_logic_randomized_oracle_with_migrations_and_replans():
+    """ISSUE 12 satellite: fused entity logic on the SPATIAL engine. The
+    logic inputs (sel/y/yaw/Column attrs) upload row-permuted through the
+    same perm as positions; outputs come back in ROW space and map to
+    slots through the dispatch-time perm SNAPSHOT — so strip migrations
+    and density re-plans between dispatches can neither misroute a value
+    nor reset a column to its default. Oracle: exact event parity with
+    the single-device engine AND bit-exact trajectory parity with the
+    same vmapped program applied host-side after each dispatch."""
+    import jax
+
+    from goworld_tpu.entity.columns import FusedProgram
+
+    single, spatial = make_engines(replan_interval=3)
+    rng, pos, active, space, radius = make_world(400, seed=7)
+
+    def drift(x, y, z, yaw, dt, vx):
+        return x + vx * dt, y, z, yaw + dt, vx
+
+    prog = FusedProgram(drift, ("vx",))
+    vfn = jax.jit(jax.vmap(drift, in_axes=(0, 0, 0, 0, None, 0)))
+    y = np.zeros(N, np.float32)
+    yaw = rng.uniform(0, 360, N).astype(np.float32)
+    vx = rng.normal(0, 60, N).astype(np.float32)  # seam-crossing drift
+    vx0 = vx.copy()
+    sel = (rng.random(N) < 0.8).astype(np.int32)
+    rpos, ryaw, rvx = pos.copy(), yaw.copy(), vx.copy()
+    for tick in range(8):
+        dt = np.float32(0.25)
+        pend = spatial.step_async(
+            pos, active, space, radius,
+            logic=((prog,), sel, y, yaw, float(dt), (vx,)))
+        e2, l2, d2 = pend.collect()
+        e1, l1, d1 = single.step(rpos, active, space, radius)
+        assert d1 == d2
+        assert to_sets(e1) == to_sets(e2), f"fused enters differ @ {tick}"
+        assert to_sets(l1) == to_sets(l2), f"fused leaves differ @ {tick}"
+        assert spatial.last_mode == "spatial", spatial.last_mode
+        # Row-space outputs → slot space through the perm snapshot.
+        programs, sel_s, perm, outs = pend.fused
+        assert perm is not None
+        new_pos, new_y, new_yaw, new_vx = (np.asarray(a) for a in outs)
+        rows = np.flatnonzero(sel_s[perm])
+        slots = perm[rows]
+        pos = pos.copy()
+        pos[slots] = new_pos[rows]
+        yaw[slots] = new_yaw[rows]
+        vx[slots] = new_vx[rows]
+        # Host-side reference of the same program.
+        ox, _, _, oyaw, ovx = (np.asarray(a) for a in vfn(
+            rpos[:, 0], y, rpos[:, 1], ryaw, dt, rvx))
+        m = sel_s > 0
+        rpos = rpos.copy()
+        rpos[m, 0] = ox[m]
+        ryaw[m] = oyaw[m]
+        rvx[m] = ovx[m]
+        assert np.array_equal(pos, rpos), f"trajectory diverged @ {tick}"
+        assert np.array_equal(yaw, ryaw) and np.array_equal(vx, rvx)
+    assert spatial.total_migrations > 0, "no strip migrations exercised"
+    # A migration tick must never reset a column: vx is program-invariant
+    # here, so any loss (a default-zero write) would show as a change.
+    assert np.array_equal(vx[sel > 0], vx0[sel > 0])
+    assert spatial.total_fallbacks == 0
+
+
+def test_fused_logic_advances_on_fallback_ticks():
+    """A teleport tick runs the exact all-gather fallback — the fused
+    program must STILL advance (the fallback jit carries the logic too),
+    with outputs row-mapped through the same perm-snapshot contract."""
+    from goworld_tpu.entity.columns import FusedProgram
+
+    single, spatial = make_engines()
+    rng, pos, active, space, radius = make_world(300, seed=3)
+
+    def drift(x, y, z, yaw, dt, vx):
+        return x + vx * dt, y, z, yaw, vx
+
+    prog = FusedProgram(drift, ("vx",))
+    y = np.zeros(N, np.float32)
+    yaw = np.zeros(N, np.float32)
+    vx = np.full(N, 8.0, np.float32)
+    sel = np.ones(N, np.int32)
+    logic = ((prog,), sel, y, yaw, 0.5, (vx,))
+    spatial.step_async(pos, active, space, radius, logic=logic).collect()
+    # Mass teleport: previous cells escape the halo → exact fallback.
+    pos2 = rng.uniform(0, WORLD_X, (N, 2)).astype(np.float32)
+    pos2[:, 1] %= 1600.0
+    pend = spatial.step_async(pos2, active, space, radius, logic=logic)
+    pend.collect()
+    assert "fallback" in spatial.last_mode, spatial.last_mode
+    programs, sel_s, perm, outs = pend.fused
+    new_pos = np.asarray(outs[0])
+    rows = np.flatnonzero(sel_s[perm])
+    slots = perm[rows]
+    expect = pos2[slots, 0] + np.float32(8.0) * np.float32(0.5)
+    assert np.array_equal(new_pos[rows, 0], expect.astype(np.float32))
+
+
 def test_halo_span_on_traced_ticks():
     """A traced dispatch must leave a ``tick.halo`` span in the ring with
     the migration count and mode attributed (the observability clause of
