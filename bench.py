@@ -364,6 +364,18 @@ def bench_aoi(n: int | None = None, space_slots: int = 4, n_spaces: int = 1,
     }
 
 
+
+def _steady_state_retraces() -> int:
+    """Current sum of jit_retrace_events_total (the device-runtime
+    sentinel; telemetry/sentinel.py). Floors report the DELTA across
+    their own run — the counter is process-global, and the in-process
+    fanout gate would otherwise inherit the retraces the seeded-mutation
+    tests deliberately inject earlier in the same suite."""
+    from goworld_tpu.telemetry import sentinel
+
+    return int(sentinel.steady_state_retraces())
+
+
 # --- pinned-floor regression gate (VERDICT r5 weak #1) -----------------------
 
 # FIXED config: never self-tuned, never env-scaled, CPU backend — the one
@@ -393,6 +405,7 @@ def bench_pinned_floor() -> dict:
     jax.config.update("jax_platforms", "cpu")
     from goworld_tpu.ops import NeighborEngine, NeighborParams
 
+    retraces0 = _steady_state_retraces()
     c = PINNED_FLOOR_CONFIG
     n = c["n"]
     params = NeighborParams(
@@ -432,6 +445,7 @@ def bench_pinned_floor() -> dict:
         "runs": [round(r, 1) for r in runs],
         "config": dict(c),
         "platform": "cpu",
+        "steady_state_retraces": _steady_state_retraces() - retraces0,
         "floor_file": PINNED_FLOOR_FILE,
     }
 
@@ -493,6 +507,7 @@ def bench_sharded() -> dict:
         cell_capacity=c["cell_capacity"], max_events=c["max_events"],
     )
     mesh = make_mesh(c["shards"])
+    retraces0 = _steady_state_retraces()
     world = c["grid"] * c["cell_size"]
 
     def make_world():
@@ -572,6 +587,7 @@ def bench_sharded() -> dict:
             2),
         "fallback_ticks": fallback_ticks,
         "shard_migrations": migrations,
+        "steady_state_retraces": _steady_state_retraces() - retraces0,
         "floor_file": PINNED_FLOOR_FILE,
     }
 
@@ -886,6 +902,7 @@ def bench_fanout(trace_sample_rate: int | None = None,
             em.cleanup_for_tests()
             tmp.cleanup()
 
+    retraces0 = _steady_state_retraces()
     rates, hops = asyncio.run(run())
     out = {
         "metric": ("fanout_sync_records_per_sec"
@@ -896,6 +913,7 @@ def bench_fanout(trace_sample_rate: int | None = None,
         "runs": [round(r, 1) for r in rates],
         "config": dict(c),
         "platform": "cpu",
+        "steady_state_retraces": _steady_state_retraces() - retraces0,
         "floor_file": PINNED_FLOOR_FILE,
     }
     out.update(hops)

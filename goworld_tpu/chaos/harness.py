@@ -43,6 +43,7 @@ from goworld_tpu.entity.entity import Entity
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.game import GameService
+from goworld_tpu.game.service import RS_RUNNING
 from goworld_tpu.gate import GateService
 from goworld_tpu.utils import gwlog
 
@@ -318,6 +319,68 @@ class ChaosCluster:
             for svc in (self.game, self.gate)
             for m in svc.cluster._mgrs
         )
+
+    def collector_targets(self):
+        """Cluster-collector targets over the LIVE service objects (the
+        in-process analog of the production loopback scrape — the health
+        provider slot is process-global, so an in-process cluster feeds
+        the collector directly; the summary code path is identical).
+        Closures read ``self.<service>`` at fetch time, so a killed and
+        recreated service is picked up without rebuilding targets."""
+
+        def disp_fetch(i: int):
+            async def fetch() -> dict:
+                d = self.dispatchers[i]
+                if d is None:
+                    raise RuntimeError("dispatcher killed")
+                return {"health": d._health(), "metrics": {}}
+
+            return fetch
+
+        async def game_fetch() -> dict:
+            if self.game is None or self.game.run_state != RS_RUNNING:
+                raise RuntimeError("game down")
+            return {"health": self.game._health(), "metrics": {}}
+
+        async def gate_fetch() -> dict:
+            if self.gate is None:
+                raise RuntimeError("gate down")
+            return {"health": self.gate._health(), "metrics": {}}
+
+        targets = [(f"dispatcher{i + 1}", disp_fetch(i))
+                   for i in range(self.n_dispatchers)]
+        targets.append(("game1", game_fetch))
+        targets.append(("gate1", gate_fetch))
+        return targets
+
+    async def assert_cluster_view_converged(
+            self, deadline: float = 20.0) -> float:
+        """ISSUE 13: after a scenario, recovery is judged from the
+        AGGREGATED view too — poll a ClusterCollector over the live
+        services until every process reports, the client census is
+        conserved at the bot count, and no stale generation row (or any
+        other alert) remains. Returns seconds until convergence."""
+        import json as _json
+
+        from goworld_tpu.telemetry.collector import ClusterCollector
+
+        coll = ClusterCollector(self.collector_targets(), interval=0.05)
+        t0 = time.monotonic()
+        last = None
+        while time.monotonic() - t0 < deadline:
+            await coll.poll_once()
+            summary = coll.view()["summary"]
+            census = summary["census"]
+            if (summary["reporting"] == summary["expected"]
+                    and not summary["alerts"]
+                    and census["clients_conserved"]
+                    and census["gate_clients"] == len(self.bots)):
+                return time.monotonic() - t0
+            last = summary
+            await asyncio.sleep(0.05)
+        raise AssertionError(
+            "chaos: /cluster view never re-converged: "
+            f"{_json.dumps(last, default=str)}")
 
     async def assert_rpc_roundtrip(self, deadline: float = 10.0) -> float:
         """Every bot pings its avatar; returns seconds until every pong
@@ -722,7 +785,13 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
             for fn in scenario_fns:
                 name = fn.__name__.removeprefix("scenario_")
                 try:
-                    results.append(await fn(cluster))
+                    r = await fn(cluster)
+                    # ISSUE 13: recovery is also judged from the
+                    # AGGREGATED cluster view — every process reporting,
+                    # census conserved, no stale generation rows.
+                    r["cluster_view_converge_s"] = round(
+                        await cluster.assert_cluster_view_converged(), 3)
+                    results.append(r)
                 except Exception as exc:  # captured, not swallowed
                     gwlog.trace_error("chaos: scenario %s failed", name)
                     failures.append({

@@ -66,9 +66,11 @@ log_level = info
 [game1]
 boot_entity = MGAvatar
 log_file = game1.log
+http_addr = 127.0.0.1:{g1_http}
 
 [game2]
 log_file = game2.log
+http_addr = 127.0.0.1:{g2_http}
 
 [gate1]
 port = {gate_port}
@@ -171,8 +173,13 @@ class MultigameCluster:
         self.gate = GateService(1, cfg)
         await self.gate.start()
 
+        # Debug ports for the REAL game children: the cluster-view
+        # convergence check scrapes their /snapshot over HTTP — the same
+        # production path the driver dispatcher's collector uses.
+        self.game_http = [self._free_port(), self._free_port()]
         rb = self.rebalance_cfg
         ini = _INI.format(
+            g1_http=self.game_http[0], g2_http=self.game_http[1],
             n_disp=self.n_dispatchers,
             dispatcher_sections="".join(
                 f"[dispatcher{i + 1}]\nport = {p}\n\n"
@@ -295,6 +302,72 @@ class MultigameCluster:
                                 p.z + random.uniform(-0.5, 0.5), p.yaw)
 
     # --- observability -------------------------------------------------------
+
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return int(s.getsockname()[1])
+
+    def collector_targets(self):
+        """Cluster-collector targets: the REAL game children are scraped
+        over their debug HTTP ports (the exact production path), the
+        in-parent dispatchers/gate feed the collector directly — the
+        process-global health-provider slot can't tell them apart."""
+        from goworld_tpu.telemetry.collector import http_target
+
+        def disp_fetch(i: int):
+            async def fetch() -> dict:
+                d = self.dispatchers[i]
+                if d is None:
+                    raise RuntimeError("dispatcher killed")
+                return {"health": d._health(), "metrics": {}}
+
+            return fetch
+
+        async def gate_fetch() -> dict:
+            if self.gate is None:
+                raise RuntimeError("gate down")
+            return {"health": self.gate._health(), "metrics": {}}
+
+        targets = [(f"dispatcher{i + 1}", disp_fetch(i))
+                   for i in range(self.n_dispatchers)]
+        for gid in (1, 2):
+            targets.append(http_target(
+                f"game{gid}", f"127.0.0.1:{self.game_http[gid - 1]}"))
+        targets.append(("gate1", gate_fetch))
+        return targets
+
+    async def assert_cluster_view_converged(
+            self, deadline: float = 25.0) -> float:
+        """ISSUE 13: the aggregated view over BOTH real game processes +
+        dispatchers + gate must re-converge — every process reporting
+        (the restarted dispatcher included), client census conserved at
+        the bot count across the two games, no stale generation rows.
+        Returns seconds until convergence."""
+        import json as _json
+
+        from goworld_tpu.telemetry.collector import ClusterCollector
+
+        coll = ClusterCollector(self.collector_targets(), interval=0.1)
+        t0 = time.monotonic()
+        last = None
+        while time.monotonic() - t0 < deadline:
+            await coll.poll_once()
+            summary = coll.view()["summary"]
+            census = summary["census"]
+            if (summary["reporting"] == summary["expected"]
+                    and not summary["alerts"]
+                    and census["clients_conserved"]
+                    and census["gate_clients"] == len(self.bots)):
+                return time.monotonic() - t0
+            last = summary
+            await asyncio.sleep(0.1)
+        raise AssertionError(
+            "multigame: /cluster view never re-converged: "
+            f"{_json.dumps(last, default=str)}")
 
     def _planner(self):
         for d in self.dispatchers:
@@ -502,9 +575,11 @@ class MultigameCluster:
         done = int(mig1["routed"] - mig0["routed"])
         rolled = int((mig1["cancel"] - mig0["cancel"])
                      + (mig1["bounced"] - mig0["bounced"]))
+        view_converge = await self.assert_cluster_view_converged()
         return {
             "scenario": "migrate_during_dispatcher_restart",
             "recovery_s": round(recovery, 3),
+            "cluster_view_converge_s": round(view_converge, 3),
             "post_roundtrip_s": round(rt, 3),
             "census_before": list(census0),
             "census_after": list(self.census()),

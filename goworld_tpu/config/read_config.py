@@ -300,6 +300,16 @@ class TelemetryConfig:
     slow_tick_budget: float = 0.1
     # How many tick records the flight recorder keeps.
     flight_ring_size: int = 240
+    # Cluster observability plane (telemetry/collector.py): the driver
+    # dispatcher scrapes every configured http_addr's /snapshot at this
+    # cadence and serves the aggregate as GET /cluster (gwtop's source).
+    # 0 disables the collector.
+    cluster_snapshot_interval: float = 1.0
+    # Device-runtime sentinel (telemetry/sentinel.py): launches after
+    # which a fresh XLA trace of an engine step jit counts as a
+    # steady-state retrace (jit_retrace_events_total + ONE structured
+    # WARN naming the arg shape/dtype delta).
+    retrace_warm_ticks: int = 32
 
 
 @dataclasses.dataclass
@@ -537,6 +547,9 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             trace_ring_size=int(s.get("trace_ring_size", 4096)),
             slow_tick_budget=float(s.get("slow_tick_budget", 0.1)),
             flight_ring_size=int(s.get("flight_ring_size", 240)),
+            cluster_snapshot_interval=float(
+                s.get("cluster_snapshot_interval", 1.0)),
+            retrace_warm_ticks=int(s.get("retrace_warm_ticks", 32)),
         )
     if cp.has_section("log"):
         cfg.log = LogConfig(
@@ -740,6 +753,12 @@ def _validate(cfg: GoWorldConfig) -> None:
             "[telemetry] slow_tick_budget must be >= 0 (0 = no slow dumps)")
     if t.flight_ring_size < 1:
         raise ValueError("[telemetry] flight_ring_size must be >= 1")
+    if t.cluster_snapshot_interval < 0:
+        raise ValueError(
+            "[telemetry] cluster_snapshot_interval must be >= 0 seconds "
+            "(0 = no cluster collector)")
+    if t.retrace_warm_ticks < 1:
+        raise ValueError("[telemetry] retrace_warm_ticks must be >= 1")
     if cfg.log.format not in ("text", "json"):
         raise ValueError(
             f"[log] format must be text|json, got {cfg.log.format!r}")

@@ -60,9 +60,35 @@ def run(dispid: int | None = None) -> int:
         await svc.start(host, port,
                         uds_dir=(cfg.cluster.uds_dir
                                  if cfg.cluster.transport == "uds" else None))
+        from goworld_tpu.utils import debug_http
         from goworld_tpu.utils.debug_http import setup_http_server
 
         debug_srv = await setup_http_server(disp_cfg.http_addr if disp_cfg else "")
+        # Cluster observability plane: the DRIVER dispatcher (the same
+        # process that plans rebalancing) hosts the ClusterCollector —
+        # a loopback scrape of every configured http_addr, aggregated as
+        # GET /cluster on this debug port (telemetry/collector.py;
+        # rendered live by `python -m goworld_tpu.tools.gwtop`).
+        collector = None
+        if (cfg.telemetry.cluster_snapshot_interval > 0
+                and args.dispid == cfg.rebalance.driver_dispatcher
+                and disp_cfg is not None and disp_cfg.http_addr):
+            from goworld_tpu.telemetry.collector import (
+                ClusterCollector,
+                http_targets_from_config,
+            )
+
+            targets = http_targets_from_config(cfg)
+            if targets:
+                collector = ClusterCollector(
+                    targets,
+                    interval=cfg.telemetry.cluster_snapshot_interval)
+                await collector.start()
+                debug_http.set_cluster_provider(collector.view)
+                gwlog.infof(
+                    "cluster collector: aggregating %d processes on "
+                    "/cluster every %.1fs", len(targets),
+                    collector.interval)
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         try:
@@ -70,6 +96,9 @@ def run(dispid: int | None = None) -> int:
         except (NotImplementedError, RuntimeError):
             pass
         await stop.wait()
+        if collector is not None:
+            debug_http.clear_cluster_provider(collector.view)
+            await collector.stop()
         if debug_srv is not None:
             await debug_srv.stop()
         await svc.stop()

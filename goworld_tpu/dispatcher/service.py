@@ -145,6 +145,11 @@ class _GateInfo:
         self.proxy: Optional[GoWorldConnection] = None
         self.block_until = 0.0  # reconnect-grace window while down
         self.pending: Deque[tuple[int, Packet]] = collections.deque()
+        # Boot generation announced at the gate's SET_GATE_ID handshake
+        # (0 until one registers): /healthz reports it so the cluster
+        # collector can cross-check every binding against the gate's own
+        # announced generation (telemetry/collector.py summarize).
+        self.generation = 0
 
     @property
     def connected(self) -> bool:
@@ -327,7 +332,8 @@ class DispatcherService:
             },
             "gates": {
                 str(gid): {"connected": gt.connected,
-                           "last_seen_age_s": age(gt.proxy)}
+                           "last_seen_age_s": age(gt.proxy),
+                           "gen": gt.generation}
                 for gid, gt in self.gates.items()
             },
         }
@@ -954,6 +960,7 @@ class DispatcherService:
                 dropped)
         gt = self._gate(gateid)
         gt.proxy = proxy
+        gt.generation = gen
         gt.block_until = 0.0
         self._proxy_gates[proxy] = gateid
         self._track_peer_gauge(f"gate{gateid}")

@@ -382,7 +382,20 @@ class GameService:
                     "until reconnect", self.gameid, index)
 
     def _health(self) -> dict:
-        """One JSON object for GET /healthz."""
+        """One JSON object for GET /healthz (and the /snapshot row the
+        cluster collector aggregates)."""
+        # Client-binding census by gate + the generations those bindings
+        # carry: the collector's conservation law (clients bound on games
+        # == clients connected on gates) and stale-generation check read
+        # exactly these (telemetry/collector.py summarize).
+        clients = 0
+        gate_gens: dict[str, set] = {}
+        for e in entity_manager.entities().values():
+            c = e.client
+            if c is None:
+                continue
+            clients += 1
+            gate_gens.setdefault(str(c.gateid), set()).add(c.gate_gen)
         return {
             "kind": "game",
             "id": self.gameid,
@@ -390,6 +403,9 @@ class GameService:
             "deployment_ready": self.deployment_ready,
             "run_state": self.run_state,
             "entities": len(entity_manager.entities()),
+            "clients": clients,
+            "queue_depth": self.queue_depth(),
+            "client_gate_gens": {g: sorted(s) for g, s in gate_gens.items()},
             "online_games": sorted(self.online_games),
             "dispatcher_links": (
                 self.cluster.link_states() if self.cluster is not None

@@ -235,12 +235,16 @@ class GateService:
                 fam.remove(g)
 
     def _health(self) -> dict:
-        """One JSON object for GET /healthz."""
+        """One JSON object for GET /healthz (and the /snapshot row the
+        cluster collector aggregates — ``generation`` is the value every
+        game binding and dispatcher registration must carry for this
+        gate, or the /cluster summary flags a stale generation row)."""
         return {
             "kind": "gate",
             "id": self.gateid,
             "uptime_s": round(
                 time.monotonic() - getattr(self, "_started_at", 0.0), 3),
+            "generation": self.generation,
             "clients": len(self.clients),
             "queue_depth": self._queue.qsize(),
             "dispatcher_links": (

@@ -65,6 +65,13 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from goworld_tpu.telemetry import sentinel
+
+# Launch/trace accounting for every step jit built below; this module
+# already owns the process's first jax import, so the persistent
+# compile-cache listener installs here too.
+sentinel.install_compile_cache_listener()
+
 LANES = 128  # Pallas cell capacity = one TPU lane dimension
 _PACK = 16  # event-mask bits packed per i32 word
 _F = 8  # feature count (sublane multiple of 8)
@@ -955,7 +962,7 @@ def _jitted_step_packed_fused(params: NeighborParams, backend: str,
             _step_packed_fused_pallas, params,
             backend == "pallas_interpret", programs,
         )
-    return jax.jit(fn)
+    return sentinel.SentinelJit(f"aoi_step_fused_{backend}", jax.jit(fn))
 
 
 # --- jit wrappers ------------------------------------------------------------
@@ -978,21 +985,22 @@ def _jitted_step_packed(params: NeighborParams, backend: str):
     # buffers; likewise the previous meta arrays (act/space/radius), which
     # with ``meta_dirty=False`` are the SAME device buffers as the current
     # epoch's meta.
-    return jax.jit(fn)
+    return sentinel.SentinelJit(f"aoi_step_{backend}", jax.jit(fn))
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_drain_ids(params: NeighborParams):
-    return jax.jit(
+    return sentinel.SentinelJit("aoi_drain_ids", jax.jit(
         functools.partial(
             _drain_ids, n=params.capacity, max_events=params.max_events
         )
-    )
+    ))
 
 
 @functools.lru_cache(maxsize=None)
 def _jitted_drain_bits(params: NeighborParams):
-    return jax.jit(functools.partial(_drain_bits, params))
+    return sentinel.SentinelJit(
+        "aoi_drain_bits", jax.jit(functools.partial(_drain_bits, params)))
 
 
 # --- host-facing engine ------------------------------------------------------

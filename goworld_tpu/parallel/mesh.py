@@ -36,6 +36,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from goworld_tpu.parallel.compat import resolve_shard_map
+from goworld_tpu.telemetry import sentinel
 from goworld_tpu.ops.neighbor import (
     LANES,
     _PACK,
@@ -356,7 +357,7 @@ def _jitted_sharded_step_fused(
         in_specs=(spec,) * (12 + n_cols),
         out_specs=(spec, spec, spec, (spec,) * (3 + n_cols)),
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("sharded_step_fused", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -376,7 +377,7 @@ def _jitted_sharded_step(params: NeighborParams, mesh: Mesh, events_inline: int)
     # the "Some donated buffers were not usable" dryrun warning. (The
     # previous meta buffers must not be donated regardless: with
     # meta_dirty=False they are passed as both epochs' meta.)
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("sharded_step", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -400,7 +401,7 @@ def _jitted_sharded_step_pallas(
         check_vma=False,
     )
     # No donation — same unusable-layout reasoning as _jitted_sharded_step.
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("sharded_step_pallas", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -414,7 +415,7 @@ def _jitted_sharded_drain(
     mapped = shard_map(
         body, mesh=mesh, in_specs=(spec, spec), out_specs=(spec, spec)
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("sharded_drain", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -428,7 +429,7 @@ def _jitted_sharded_drain_bits(
     mapped = shard_map(
         body, mesh=mesh, in_specs=(spec,) * 6, out_specs=(spec, spec)
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("sharded_drain_bits", jax.jit(mapped))
 
 
 class ShardedPendingStep:

@@ -75,6 +75,7 @@ from goworld_tpu.ops.neighbor import (
     sorted_ranks_by,
 )
 from goworld_tpu.parallel.compat import resolve_shard_map
+from goworld_tpu.telemetry import sentinel
 from goworld_tpu.parallel.mesh import (
     SHARD_AXIS,
     ShardedPendingStep,
@@ -334,7 +335,7 @@ def _jitted_spatial_step_fused(
         in_specs=(spec,) * (15 + n_cols),
         out_specs=(spec, spec, spec, (spec,) * (3 + n_cols)),
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("spatial_step_fused", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -353,7 +354,7 @@ def _jitted_spatial_step(
         in_specs=(spec,) * 11,
         out_specs=(spec, spec, spec),
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("spatial_step", jax.jit(mapped))
 
 
 @functools.lru_cache(maxsize=None)
@@ -367,7 +368,7 @@ def _jitted_spatial_drain(
         body, mesh=mesh, in_specs=(spec, spec, spec),
         out_specs=(spec, spec),
     )
-    return jax.jit(mapped)
+    return sentinel.SentinelJit("spatial_drain", jax.jit(mapped))
 
 
 def plan_strips(
@@ -502,6 +503,26 @@ class SpatialShardedNeighborEngine:
             "(structural: halo_cap rows x 2 directions x D shards per "
             "spatial tick).",
         )
+        self._m_allgather_bytes = telemetry.counter(
+            "aoi_allgather_bytes_total",
+            "Bytes the exact all-gather fallback program moves between "
+            "shards (every other shard's rows, both epochs) on ticks the "
+            "strip invariants cannot cover.",
+        )
+        # The structural comms story as live gauges (previously only a
+        # bench headline): what one spatial tick moves vs what the
+        # all-gather formulation would move — their ratio is THE point of
+        # the spatial engine, now watchable on /metrics and /cluster.
+        telemetry.gauge(
+            "aoi_halo_bytes_per_tick",
+            "Structural ppermute payload of one spatial tick "
+            "(halo_cap rows x 2 directions x D shards).",
+        ).set(self.halo_bytes_per_tick)
+        telemetry.gauge(
+            "aoi_allgather_equiv_bytes_per_tick",
+            "What the all-gather formulation would move per tick at this "
+            "tier (every other shard's rows, both epochs, on D devices).",
+        ).set(self.allgather_bytes_per_tick)
         self._m_migrations = telemetry.counter(
             "aoi_shard_migrations_total",
             "Entities reassigned to a different AOI grid-strip shard "
@@ -839,6 +860,7 @@ class SpatialShardedNeighborEngine:
             self.last_mode = f"fallback:{fallback_reason}"
             self.total_fallbacks += 1
             self._m_fallback.labels(fallback_reason).inc()
+            self._m_allgather_bytes.inc(self.allgather_bytes_per_tick)
             pending = _FallbackPendingStep(
                 self, enter_ctx, leave_ctx, out, perm.copy()
             )

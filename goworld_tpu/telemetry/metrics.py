@@ -357,7 +357,7 @@ class Registry:
 
     def snapshot(self) -> dict:
         """JSON-able structured dump (the ``/opmon`` superset: every family,
-        every series; histograms carry count/avg/max/p50/p99)."""
+        every series; histograms carry count/avg/max/p50/p95/p99)."""
         out: dict = {}
         for fam in self._families_snapshot():
             series = []
@@ -372,6 +372,7 @@ class Registry:
                         "avg": child.sum / cnt if cnt else 0.0,
                         "max": child.max,
                         "p50": child.percentile(0.50),
+                        "p95": child.percentile(0.95),
                         "p99": child.percentile(0.99),
                     })
                 else:

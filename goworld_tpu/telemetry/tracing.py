@@ -155,9 +155,14 @@ def configure(sample_rate: Optional[int] = None,
 
 
 def configure_from_config(tcfg) -> None:
-    """Apply a read_config.TelemetryConfig (each process at boot)."""
+    """Apply a read_config.TelemetryConfig (each process at boot). Also
+    the [telemetry] seam for the device-runtime sentinel: every process
+    that configures tracing gets its retrace warm threshold set here."""
     configure(sample_rate=tcfg.trace_sample_rate,
               ring_size=tcfg.trace_ring_size)
+    from goworld_tpu.telemetry import sentinel
+
+    sentinel.configure_from_config(tcfg)
 
 
 def sample_rate() -> int:

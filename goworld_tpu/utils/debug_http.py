@@ -20,6 +20,12 @@ framework in this image) serving:
   records + the most recent over-budget dump; telemetry/tracing.py)
 - ``/opmon``     — JSON dump of operation monitor stats (opmon.go:37-118;
   now a legacy view over the telemetry op_duration_seconds family)
+- ``/snapshot``  — this process's cluster-plane row: the /healthz object
+  plus the selected metric families the ClusterCollector aggregates
+  (telemetry/collector.py)
+- ``/cluster``   — the aggregated whole-deployment view, served ONLY by
+  the process hosting the collector (the driver dispatcher); rendered
+  live by ``python -m goworld_tpu.tools.gwtop``
 - ``/stack``     — all-thread stack dump (the practical subset of pprof)
 - ``/profile``   — cProfile the main thread for ?seconds=S; ``&mode=jax``
   instead wraps the window in jax.profiler.trace (the step jits of the
@@ -65,6 +71,44 @@ def clear_health_provider(fn: Callable[[], dict]) -> None:
     # but compare equal for the same function + instance.
     if _health_provider == fn:
         _health_provider = None
+
+
+def health_snapshot() -> dict:
+    """The /healthz object (also embedded in /snapshot rows the cluster
+    collector scrapes — telemetry/collector.py)."""
+    from goworld_tpu.proto.msgtypes import PROTO_VERSION
+
+    health = {
+        "status": "ok",
+        "pid": os.getpid(),
+        "proto_version": PROTO_VERSION,
+        "uptime_s": round(time.monotonic() - _module_t0, 3),
+    }
+    if _health_provider is not None:
+        try:
+            health.update(_health_provider())
+        except Exception as exc:
+            health["status"] = "degraded"
+            health["health_provider_error"] = str(exc)
+    return health
+
+
+# /cluster provider: the process hosting a ClusterCollector (the driver
+# dispatcher) registers its view() here; every other process 404s with a
+# pointer. Module-level for the same one-service-per-process reason as
+# the health provider.
+_cluster_provider: Optional[Callable[[], dict]] = None
+
+
+def set_cluster_provider(fn: Callable[[], dict]) -> None:
+    global _cluster_provider
+    _cluster_provider = fn
+
+
+def clear_cluster_provider(fn: Callable[[], dict]) -> None:
+    global _cluster_provider
+    if _cluster_provider == fn:
+        _cluster_provider = None
 
 
 def _dump_stacks() -> str:
@@ -211,22 +255,32 @@ class DebugHTTPServer:
 
     def _route(self, path: str, query: Optional[dict] = None) -> tuple[str, str, bytes]:
         if path == "/healthz":
-            from goworld_tpu.proto.msgtypes import PROTO_VERSION
-
-            health = {
-                "status": "ok",
-                "pid": os.getpid(),
-                "proto_version": PROTO_VERSION,
-                "uptime_s": round(time.monotonic() - _module_t0, 3),
-            }
-            if _health_provider is not None:
-                try:
-                    health.update(_health_provider())
-                except Exception as exc:
-                    health["status"] = "degraded"
-                    health["health_provider_error"] = str(exc)
             return ("200 OK", "application/json",
-                    json.dumps(health, default=str).encode())
+                    json.dumps(health_snapshot(), default=str).encode())
+        if path == "/snapshot":
+            # One compact JSON row for the cluster collector: /healthz +
+            # the cluster-plane metric families (telemetry/collector.py).
+            from goworld_tpu.telemetry import collector
+
+            return ("200 OK", "application/json",
+                    json.dumps(collector.build_local_snapshot(),
+                               default=str).encode())
+        if path == "/cluster":
+            if _cluster_provider is None:
+                return ("404 Not Found", "application/json",
+                        json.dumps({
+                            "error": "no collector in this process",
+                            "hint": "GET /cluster is served by the driver "
+                                    "dispatcher's debug port ([telemetry] "
+                                    "cluster_snapshot_interval > 0)",
+                        }).encode())
+            try:
+                view = _cluster_provider()
+            except Exception as exc:
+                return ("500 Internal Server Error", "application/json",
+                        json.dumps({"error": str(exc)}).encode())
+            return ("200 OK", "application/json",
+                    json.dumps(view, default=str).encode())
         if path == "/trace":
             from goworld_tpu.telemetry import tracing
 

@@ -412,6 +412,10 @@ def test_pinned_floor_gate():
     # comparison is apples-to-oranges.
     result = bench._pinned_floor_tier1_env()
     assert result["config"] == bench.PINNED_FLOOR_CONFIG
+    # Device-runtime sentinel (ISSUE 13): the measured run must be free
+    # of steady-state retraces — a mid-run recompile would both corrupt
+    # the number and be a real engine regression.
+    assert result["steady_state_retraces"] == 0
     floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
     assert result["value"] >= floor, (
         f"pinned-floor regression: {result['value']:.0f} upd/s < "
@@ -442,6 +446,7 @@ def test_sharded_floor_gate():
         "the fixed floor config must run the SPATIAL program every tick; "
         f"{result['fallback_ticks']} ticks fell back to all-gather"
     )
+    assert result["steady_state_retraces"] == 0
     floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
     assert result["value"] >= floor, (
         f"sharded-floor regression: {result['value']:.0f} upd/s < "
@@ -462,6 +467,7 @@ def test_fanout_floor_gate():
     bench = _load_bench()
     result = bench.bench_fanout()
     assert result["config"] == bench.FANOUT_CONFIG
+    assert result["steady_state_retraces"] == 0
     floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
     assert result["value"] >= floor, (
         f"fanout-floor regression: {result['value']:.0f} records/s < "

@@ -1,0 +1,13 @@
+"""Thin launcher for ``goworld_tpu.tools.gwtop`` (kept beside tracecat so
+both operator consoles live in one directory; the real implementation is
+importable from the deployed package — run it as
+``python -m goworld_tpu.tools.gwtop`` in production)."""
+
+from __future__ import annotations
+
+import sys
+
+from goworld_tpu.tools.gwtop import main
+
+if __name__ == "__main__":
+    sys.exit(main())
