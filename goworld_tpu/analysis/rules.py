@@ -442,13 +442,19 @@ HOT_PATHS: dict[str, set[str]] = {
     "goworld_tpu/entity/slabs.py": {
         "collect_sync_selection", "pack_sync", "collect_sync",
         "run_tick_batches", "set_position_yaw",
+        # Adaptive per-client sync (ISSUE 14): the tiered collect runs
+        # every position-sync collection — selection, quantization,
+        # baseline advance and wire pack must stay vectorized.
+        "_collect_sync_tiered", "_emit_mask", "_pack_rows",
+        "retier_host",
     },
     "goworld_tpu/dispatcher/service.py": {
         "_handle_sync_position_yaw_from_client", "_send_pending_syncs",
         "_flush_pending_sync", "_route_to_gate",
     },
     "goworld_tpu/gate/service.py": {
-        "_handle_sync_on_clients", "_flush_pending_syncs",
+        "_handle_sync_on_clients", "_handle_sync_delta_on_clients",
+        "_flush_pending_syncs",
     },
     "goworld_tpu/ops/neighbor.py": {
         "neighbor_step", "build_tables", "diff_events",
@@ -456,6 +462,8 @@ HOT_PATHS: dict[str, set[str]] = {
         # stay loop-free — the trace-time program unroll lives in
         # _apply_fused_logic, outside the guarded set by design.
         "_step_packed_fused_jnp", "_step_packed_fused_pallas",
+        # The [sync] tier pass rides the step launch: loop-free jnp.
+        "_tier_pass",
     },
     "goworld_tpu/parallel/spatial.py": {
         "_spatial_step_fused_impl",

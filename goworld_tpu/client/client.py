@@ -17,7 +17,12 @@ from typing import Callable, Optional
 
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
-from goworld_tpu.proto.conn import SYNC_RECORD_SIZE, GoWorldConnection, pack_sync_record
+from goworld_tpu.proto.conn import (
+    DELTA_SYNC_RECORD_SIZE,
+    SYNC_RECORD_SIZE,
+    GoWorldConnection,
+    pack_sync_record,
+)
 from goworld_tpu.proto.msgtypes import MsgType
 from goworld_tpu.utils import gwlog
 
@@ -40,6 +45,14 @@ class ClientEntity:
         self.attrs = attrs
         self.x, self.y, self.z, self.yaw = x, y, z, yaw
         self.destroyed = False
+        # v6 adaptive sync: deltas are only decodable after a full-
+        # precision keyframe established the baseline — the CREATE
+        # position deliberately does NOT count (the server forces a
+        # keyframe as every pair's first emission, so a delta arriving
+        # first is a stale-baseline protocol violation, strict-checked).
+        self.delta_ready = False
+        self.keyframes = 0
+        self.deltas = 0
 
     # --- server → client ----------------------------------------------------
 
@@ -313,8 +326,46 @@ class ClientBot:
                     import struct
 
                     e.x, e.y, e.z, e.yaw = struct.unpack_from("<4f", rec, 16)
+                    e.delta_ready = True
+                    e.keyframes += 1
+        elif msgtype == MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+            self._handle_sync_delta(packet)
         else:
             self.error(f"unhandled server msgtype {msgtype}")
+
+    def _handle_sync_delta(self, packet: Packet) -> None:
+        """Decode v6 quantized-delta sync records: [u8 quantize_bits] +
+        concatenated 24 B [eid + dx,dy,dz,dyaw int16] records. The
+        position advances in FLOAT32 arithmetic — the server's baseline
+        column is float32, and matching its rounding bit-for-bit is what
+        keeps decode error bounded by the quantization step forever
+        (entity/slabs.py encoding contract)."""
+        import struct
+
+        import numpy as np
+
+        data = packet.payload
+        if not data:
+            return
+        step = np.float32(2.0 ** -data[0])
+        for off in range(1, len(data) - DELTA_SYNC_RECORD_SIZE + 1,
+                         DELTA_SYNC_RECORD_SIZE):
+            rec = data[off : off + DELTA_SYNC_RECORD_SIZE]
+            eid = rec[:16].decode("ascii")
+            e = self.entities.get(eid)
+            if e is None:
+                continue  # same contract as full records for unknown eids
+            if not e.delta_ready:
+                self.error(
+                    f"delta sync for {eid} before any keyframe — stale "
+                    f"baseline (server must keyframe first)")
+                continue
+            dx, dy, dz, dyaw = struct.unpack_from("<4h", rec, 16)
+            e.x = float(np.float32(e.x) + np.float32(dx) * step)
+            e.y = float(np.float32(e.y) + np.float32(dy) * step)
+            e.z = float(np.float32(e.z) + np.float32(dz) * step)
+            e.yaw = float(np.float32(e.yaw) + np.float32(dyaw) * step)
+            e.deltas += 1
 
     def _handle_create_entity(self, packet: Packet) -> None:
         is_player = packet.read_bool()

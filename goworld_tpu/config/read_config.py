@@ -241,6 +241,30 @@ class EntityConfig:
 
 
 @dataclasses.dataclass
+class SyncConfig:
+    """Adaptive per-client position sync (``[sync]``; entity/slabs.py —
+    ROADMAP item 5: per-client cost must go sublinear in neighbors x tick
+    rate). Defaults preserve the legacy full-rate/full-precision path
+    bit-for-bit."""
+
+    # Per-tier emission periods in collections, ascending, first must be 1
+    # (tier 0 = near neighbors at full rate). ("1",) disables tiering.
+    tier_cadences: tuple[int, ...] = (1,)
+    # Delta records carry int16 multiples of 2^-quantize_bits world units
+    # between keyframes; 0 = full-precision records only (delta off).
+    quantize_bits: int = 0
+    # Collections between forced full-precision keyframes per pair.
+    keyframe_interval: int = 32
+    # distance/AOI-radius classification band: <= near_ratio -> tier 0,
+    # >= far_ratio -> last tier, linear spread between.
+    near_ratio: float = 0.5
+    far_ratio: float = 0.8
+    # Host-side re-classification cadence (collections); the batched AOI
+    # engine's in-launch tier pass supersedes it.
+    retier_interval: int = 8
+
+
+@dataclasses.dataclass
 class RebalanceConfig:
     """Telemetry-driven live rebalancer knobs (``[rebalance]``;
     rebalance/planner.py + rebalance/migrator.py — no reference analog:
@@ -338,6 +362,7 @@ class GoWorldConfig:
     aoi: AOIConfig = dataclasses.field(default_factory=AOIConfig)
     entity: EntityConfig = dataclasses.field(default_factory=EntityConfig)
     cluster: ClusterConfig = dataclasses.field(default_factory=ClusterConfig)
+    sync: SyncConfig = dataclasses.field(default_factory=SyncConfig)
     rebalance: RebalanceConfig = dataclasses.field(default_factory=RebalanceConfig)
     client: ClientConfig = dataclasses.field(default_factory=ClientConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
@@ -522,6 +547,19 @@ def _load(path: Optional[str]) -> GoWorldConfig:
     if cp.has_section("entity"):
         cfg.entity = EntityConfig(
             slab_initial=int(cp["entity"].get("slab_initial", 256)),
+        )
+    if cp.has_section("sync"):
+        s = cp["sync"]
+        cfg.sync = SyncConfig(
+            tier_cadences=tuple(
+                int(v) for v in
+                s.get("tier_cadences", "1").replace(" ", "").split(",")
+                if v),
+            quantize_bits=int(s.get("quantize_bits", 0)),
+            keyframe_interval=int(s.get("keyframe_interval", 32)),
+            near_ratio=float(s.get("near_ratio", 0.5)),
+            far_ratio=float(s.get("far_ratio", 0.8)),
+            retier_interval=int(s.get("retier_interval", 8)),
         )
     if cp.has_section("rebalance"):
         s = cp["rebalance"]
@@ -713,6 +751,36 @@ def _validate(cfg: GoWorldConfig) -> None:
     if cl.sync_flush_bytes < 0:
         raise ValueError(
             "[cluster] sync_flush_bytes must be >= 0 (0 = tick-only flush)")
+    sy = cfg.sync
+    if not sy.tier_cadences or sy.tier_cadences[0] != 1:
+        # Tier 0 is the full-rate tier by contract: new/near pairs land
+        # there, so a first cadence != 1 would throttle EVERY pair.
+        raise ValueError(
+            "[sync] tier_cadences must be a non-empty ascending list "
+            "starting at 1 (tier 0 = full rate), got "
+            f"{list(sy.tier_cadences)}")
+    if any(b <= a for a, b in zip(sy.tier_cadences, sy.tier_cadences[1:])):
+        raise ValueError(
+            "[sync] tier_cadences must be strictly ascending, got "
+            f"{list(sy.tier_cadences)}")
+    if any(c > 1024 for c in sy.tier_cadences):
+        raise ValueError("[sync] tier cadences above 1024 would stall "
+                         "distant pairs for tens of seconds")
+    if not 0 <= sy.quantize_bits <= 14:
+        # 15+ fractional bits leave the int16 delta range below one world
+        # unit — any real movement would force a keyframe every record.
+        raise ValueError(
+            f"[sync] quantize_bits must be in [0, 14], got "
+            f"{sy.quantize_bits}")
+    if sy.keyframe_interval < 2:
+        raise ValueError("[sync] keyframe_interval must be >= 2 "
+                         "collections (1 would disable deltas implicitly)")
+    if not 0.0 < sy.near_ratio < sy.far_ratio <= 1.0:
+        raise ValueError(
+            "[sync] requires 0 < near_ratio < far_ratio <= 1.0, got "
+            f"near_ratio={sy.near_ratio} far_ratio={sy.far_ratio}")
+    if sy.retier_interval < 1:
+        raise ValueError("[sync] retier_interval must be >= 1")
     rb = cfg.rebalance
     if rb.driver_dispatcher < 1:
         raise ValueError("[rebalance] driver_dispatcher must be >= 1")

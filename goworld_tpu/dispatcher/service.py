@@ -34,12 +34,18 @@ from goworld_tpu import consts, telemetry
 from goworld_tpu.dispatcher.lbc import LBCHeap
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
-from goworld_tpu.proto.conn import SYNC_DTYPE, SYNC_RECORD_SIZE, GoWorldConnection
+from goworld_tpu.proto.conn import (
+    DELTA_SYNC_RECORD_SIZE,
+    SYNC_DTYPE,
+    SYNC_RECORD_SIZE,
+    GoWorldConnection,
+)
 from goworld_tpu.proto.msgtypes import PROTO_VERSION, MsgType, is_gate_redirect
 from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import gwlog
 
 _CLIENT_SYNC_BLOCK = 16 + SYNC_RECORD_SIZE  # [clientid + record] (downstream)
+_CLIENT_DELTA_BLOCK = 16 + DELTA_SYNC_RECORD_SIZE  # v6 delta variant
 
 # Records-per-packet amortization made visible (ISSUE 6): the whole point
 # of batch routing is that one packet carries MANY records — these count
@@ -551,6 +557,8 @@ class DispatcherService:
             return packet.payload_len() // SYNC_RECORD_SIZE
         if msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
             return (packet.payload_len() - 2) // _CLIENT_SYNC_BLOCK
+        if msgtype == MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+            return (packet.payload_len() - 3) // _CLIENT_DELTA_BLOCK
         return None
 
     async def _tick_loop(self) -> None:
@@ -807,6 +815,16 @@ class DispatcherService:
             t0 = time.perf_counter()
             self._sync_records_down.inc(
                 (packet.payload_len() - 2) // _CLIENT_SYNC_BLOCK)
+            self._route_to_gate(msgtype, packet)
+            _HOP_ROUTE.inc(time.perf_counter() - t0)
+            return
+        if msgtype == MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+            # v6 quantized-delta sync: same gateid-prefix routing as the
+            # full-precision stream (the extra quantize_bits byte rides
+            # the payload untouched).
+            t0 = time.perf_counter()
+            self._sync_records_down.inc(
+                (packet.payload_len() - 3) // _CLIENT_DELTA_BLOCK)
             self._route_to_gate(msgtype, packet)
             _HOP_ROUTE.inc(time.perf_counter() - t0)
             return

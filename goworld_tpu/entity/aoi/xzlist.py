@@ -40,6 +40,14 @@ class XZListAOIManager(AOIManagerBase):
             return
         t = _Tracker(entity, x, z)
         self._trackers[entity] = t
+        # Mirror the AOI distance into the slab radius column: the
+        # adaptive-sync tier classification (entity/slabs.py) reads it
+        # for every backend, and only the batched service fills it
+        # otherwise.
+        slot = getattr(entity, "_slot", -1)
+        slabs = getattr(entity, "_slabs", None)
+        if slot >= 0 and slabs is not None:
+            slabs.radius[slot] = self.distance
         bisect.insort(self._xlist, (x, id(t), t))
         self._update_neighbors(t)
 

@@ -453,30 +453,36 @@ def on_game_ready() -> None:
 # --- position sync collection (Entity.go:1221-1267) --------------------------
 
 
-def collect_entity_sync_infos() -> dict[int, bytes]:
-    """Build one coalesced buffer per gate of [clientid(16) + 32B sync
-    record] blocks for every entity whose position/yaw changed since last
-    collection — pure column ops over the entity slabs (slabs.collect_sync):
-    the own-client rows are one boolean-mask gather over the flag slab and
-    the neighbor fan-out rows come from the slot-indexed interest-edge
-    table instead of a Python loop over every entity's ``interested_by``
-    set, so cost scales with flagged rows + live edges, not entity count.
-    Destroyed entities and unbound clients are dropped STRUCTURALLY: slot
-    release / client unbind clear the flag and cid columns the masks read.
-    Wall time lands on fanout_hop_seconds_total{hop=game_collect|game_pack}
-    (the two game-side sub-hops of bench.py --fanout's breakdown)."""
+def collect_entity_sync_infos() -> dict[int, tuple[bytes, bytes]]:
+    """Build the coalesced sync buffers per gate — a (full_records,
+    delta_records) pair: full = [clientid(16) + 32B keyframe] blocks,
+    delta = [clientid(16) + 24B quantized-delta] blocks (empty under the
+    default [sync] config, where this is exactly the legacy full-rate
+    path). Pure column ops over the entity slabs: the own-client rows are
+    one boolean-mask gather over the flag slab and the neighbor fan-out
+    rows come from the slot-indexed interest-edge table gated by each
+    pair's cadence tier, so cost scales with flagged rows + DUE edges,
+    not entity count x neighbors. Destroyed entities and unbound clients
+    are dropped STRUCTURALLY: slot release / client unbind clear the flag
+    and cid columns the masks read. Wall time lands on
+    fanout_hop_seconds_total{hop=game_collect|game_pack} (the two
+    game-side sub-hops of bench.py --fanout's breakdown)."""
     slabs = runtime.slabs
     t0 = time.perf_counter()
-    sel = slabs.collect_sync_selection()
-    t1 = time.perf_counter()
-    _HOP_COLLECT.inc(t1 - t0)
-    if sel is None:
-        return {}
-    out = {
-        gateid: arr.tobytes()
-        for gateid, arr in slabs.pack_sync(sel).items()
-    }
-    _HOP_PACK.inc(time.perf_counter() - t1)
+    if not slabs.sync.enabled:
+        sel = slabs.collect_sync_selection()
+        t1 = time.perf_counter()
+        _HOP_COLLECT.inc(t1 - t0)
+        if sel is None:
+            return {}
+        out = {
+            gateid: (arr.tobytes(), b"")
+            for gateid, arr in slabs.pack_sync(sel).items()
+        }
+        _HOP_PACK.inc(time.perf_counter() - t1)
+        return out
+    out = slabs.collect_sync_packets()
+    _HOP_COLLECT.inc(time.perf_counter() - t0)
     return out
 
 

@@ -59,7 +59,93 @@ _INITIAL_EDGES = 256
 # One wire block of the game→dispatcher→gate sync fan-out:
 # [clientid(16)][sync record: eid(16) + x,y,z,yaw float32] — the canonical
 # layout lives with the other wire dtypes in proto/conn.py.
-from goworld_tpu.proto.conn import CLIENT_SYNC_BLOCK_DTYPE  # noqa: E402
+from goworld_tpu.proto.conn import (  # noqa: E402
+    CLIENT_DELTA_SYNC_BLOCK_DTYPE,
+    CLIENT_SYNC_BLOCK_DTYPE,
+)
+
+# --- adaptive per-client sync telemetry ([sync]; module-scope per R5) --------
+_M_TIER_EDGES = telemetry.gauge(
+    "sync_tier_edges",
+    "Interest pairs per sync cadence tier at the last classification "
+    "(tier 0 = full rate; higher tiers sync at 1/cadence).", ("tier",))
+_M_SYNC_RECORDS = telemetry.counter(
+    "sync_records_total",
+    "Position-sync records emitted by the tiered collect, by encoding "
+    "(keyframe = full-precision 48 B block, delta = quantized 40 B block).",
+    ("kind",))
+_M_SYNC_BYTES = telemetry.counter(
+    "sync_wire_bytes_total",
+    "Wire bytes of the game-side sync buffers, by encoding.", ("kind",))
+_M_SYNC_SUPPRESSED = telemetry.counter(
+    "sync_records_suppressed_total",
+    "Neighbor sync rows gated off by their pair's cadence tier (the "
+    "sublinear fan-out win, as a live counter).")
+_M_BYTES_PER_CLIENT = telemetry.gauge(
+    "sync_bytes_per_client_per_s",
+    "Rolling sync wire bytes per bound client per second served by this "
+    "game (~1 s window; live while [sync] tiering/quantization is on — "
+    "the gwtop SYNC column's bytes half).")
+_M_KEYFRAMES_FORCED = telemetry.counter(
+    "sync_keyframes_forced_total",
+    "Full-precision keyframes forced outside the periodic schedule "
+    "(new_pair: first emission for a pair; rebind: the watcher's client "
+    "changed since the baseline; teleport: delta overflowed the int16 "
+    "range).", ("reason",))
+_EMPTY = b""
+_KIND_KEY = _M_SYNC_RECORDS.labels("keyframe")
+_KIND_DELTA = _M_SYNC_RECORDS.labels("delta")
+_BYTES_KEY = _M_SYNC_BYTES.labels("keyframe")
+_BYTES_DELTA = _M_SYNC_BYTES.labels("delta")
+_FORCED_NEW = _M_KEYFRAMES_FORCED.labels("new_pair")
+_FORCED_REBIND = _M_KEYFRAMES_FORCED.labels("rebind")
+_FORCED_TELEPORT = _M_KEYFRAMES_FORCED.labels("teleport")
+
+
+class SyncTuning:
+    """Resolved [sync] knobs on the slab store (config/read_config.py
+    SyncConfig; defaults = the legacy full-rate/full-precision path)."""
+
+    __slots__ = ("cadences", "quantize_bits", "step", "keyframe_interval",
+                 "near_ratio", "far_ratio", "retier_interval", "enabled")
+
+    def __init__(self, tier_cadences=(1,), quantize_bits=0,
+                 keyframe_interval=32, near_ratio=0.5, far_ratio=0.8,
+                 retier_interval=8) -> None:
+        self.cadences = np.asarray(tier_cadences, np.int32)
+        self.quantize_bits = int(quantize_bits)
+        self.step = np.float32(2.0 ** -self.quantize_bits)
+        self.keyframe_interval = int(keyframe_interval)
+        self.near_ratio = float(near_ratio)
+        self.far_ratio = float(far_ratio)
+        self.retier_interval = int(retier_interval)
+        # The legacy path is the special case of one full-rate tier and
+        # full precision; anything else takes the tiered collect.
+        self.enabled = len(self.cadences) > 1 or self.quantize_bits > 0
+
+
+def classify_tiers(d2: np.ndarray, radius: np.ndarray, n_tiers: int,
+                   near_ratio: float, far_ratio: float,
+                   last_d2: np.ndarray | None = None) -> np.ndarray:
+    """Distance/approach-rate tier classification, shared verbatim by the
+    host re-tier pass and the test oracles (the device pass in
+    ops/neighbor.py mirrors this formula in jnp — pinned by parity tests).
+
+    ratio = dist / watcher AOI radius: <= near_ratio -> tier 0,
+    >= far_ratio -> the last tier, linear spread between. A pair whose
+    distance SHRANK since the previous classification (``last_d2``) is
+    approaching and drops one tier toward full rate — an inbound player
+    must sharpen before arrival, not after."""
+    r2 = np.maximum(radius.astype(np.float32) ** 2, np.float32(1e-12))
+    ratio2 = d2 / r2
+    span = max(far_ratio - near_ratio, 1e-9)
+    frac = (np.sqrt(ratio2) - near_ratio) / span
+    tier = 1 + np.floor(frac * (n_tiers - 1)).astype(np.int32)
+    tier = np.clip(tier, 0, n_tiers - 1)
+    tier[ratio2 <= near_ratio * near_ratio] = 0
+    if last_d2 is not None:
+        tier = np.where(d2 < last_d2, np.maximum(tier - 1, 0), tier)
+    return tier.astype(np.uint8)
 
 
 class _TickBucket:
@@ -272,6 +358,39 @@ class EntitySlabs:
         self._e_n = 0
         self._e_map: dict[int, int] = {}
         self._edge_refs = np.zeros(capacity, np.int32)
+        # Adaptive-sync per-edge state ([sync]; swap-removed in tandem
+        # with the edge itself): cadence tier, delta baseline (the exact
+        # position the watcher's client last converged to), whether that
+        # baseline is live, the clientid it was established against
+        # (self-healing rebind detection), the collection at/after which
+        # a periodic keyframe is due, unsent-movement pending, and the
+        # distance^2 at the last classification (approach detection).
+        self._e_tier = np.zeros(_INITIAL_EDGES, np.uint8)
+        self._e_base = np.zeros((_INITIAL_EDGES, 4), np.float32)
+        self._e_bvalid = np.zeros(_INITIAL_EDGES, bool)
+        self._e_bcid = np.zeros(_INITIAL_EDGES, "S16")
+        self._e_key_at = np.zeros(_INITIAL_EDGES, np.int64)
+        self._e_pending = np.zeros(_INITIAL_EDGES, bool)
+        self._e_last_d2 = np.full(_INITIAL_EDGES, np.inf, np.float32)
+        # Edge-churn-only version (the broader _topo_version also counts
+        # bindings/flags): guards the device tier writeback — a tier
+        # vector computed against a different edge layout is discarded.
+        self._edge_version = 0
+        # Own-client delta baselines, per SLOT (an entity syncing to its
+        # own client rides full rate but still delta-encodes).
+        self.own_base = np.zeros((capacity, 4), np.float32)
+        self.own_bvalid = np.zeros(capacity, bool)
+        self.own_bcid = np.zeros(capacity, "S16")
+        self.own_key_at = np.zeros(capacity, np.int64)
+        # [sync] tuning + collection sequence; device-pass bookkeeping
+        # (True while an attached batched AOI service ships tiers inside
+        # the engine launch — host re-tiering then stands down).
+        self.sync = SyncTuning()
+        self._collect_seq = 0
+        self.device_tiers = False
+        # ~1 s rolling window feeding sync_bytes_per_client_per_s.
+        self._rate_stamp = time.monotonic()
+        self._rate_bytes = 0
         # Per-class batched tick hooks (on_tick_batch classes only).
         self._tick_buckets: dict[type, _TickBucket] = {}
         # Steady-state sync-selection cache: a mover population that flags
@@ -349,6 +468,12 @@ class EntitySlabs:
         self.has_client[slot] = False
         self.eid[slot] = b""
         self.gateid[slot] = 0
+        # Delta-sync baselines die with the tenant: the next entity on
+        # this slot must keyframe before any delta (own_bcid mismatch
+        # would also catch it, but an explicit clear is cheaper to reason
+        # about than a 16-byte compare saving us).
+        self.own_bvalid[slot] = False
+        self.own_bcid[slot] = b""
         # Columns reset to their declared defaults (a quarantined slot's
         # stale values must never leak into its next tenant) and the slot
         # is fenced against any in-flight fused writeback.
@@ -416,6 +541,10 @@ class EntitySlabs:
         self.space_ids = pad(self.space_ids, (n,), np.int32)
         self.radius = pad(self.radius, (n,), np.float32)
         self.fused_dirty = pad(self.fused_dirty, (n,), bool)
+        self.own_base = pad(self.own_base, (n, 4), np.float32)
+        self.own_bvalid = pad(self.own_bvalid, (n,), bool)
+        self.own_bcid = pad(self.own_bcid, (n,), "S16")
+        self.own_key_at = pad(self.own_key_at, (n,), np.int64)
         for name, arr in self.columns.items():
             # New rows start at the column's declared default, not zero.
             spec = self.column_specs[name]
@@ -439,13 +568,31 @@ class EntitySlabs:
         if n == len(self._e_subj):
             self._e_subj = np.resize(self._e_subj, n * 2)
             self._e_wat = np.resize(self._e_wat, n * 2)
+            self._e_tier = np.resize(self._e_tier, n * 2)
+            base = np.zeros((n * 2, 4), np.float32)
+            base[:n] = self._e_base
+            self._e_base = base
+            self._e_bvalid = np.resize(self._e_bvalid, n * 2)
+            self._e_bcid = np.resize(self._e_bcid, n * 2)
+            self._e_key_at = np.resize(self._e_key_at, n * 2)
+            self._e_pending = np.resize(self._e_pending, n * 2)
+            self._e_last_d2 = np.resize(self._e_last_d2, n * 2)
         self._e_subj[n] = subj
         self._e_wat[n] = watcher
+        # Fresh pair: full rate until classified, no baseline — the
+        # FIRST emission (the subject's next movement) is a forced
+        # keyframe; until then the client renders the position the
+        # CREATE_ENTITY_ON_CLIENT carried, exactly like the legacy path.
+        self._e_tier[n] = 0
+        self._e_bvalid[n] = False
+        self._e_pending[n] = False
+        self._e_last_d2[n] = np.inf
         self._e_map[key] = n
         self._e_n = n + 1
         self._edge_refs[subj] += 1
         self._edge_refs[watcher] += 1
         self._topo_version += 1
+        self._edge_version += 1
 
     def edge_remove(self, subj: int, watcher: int) -> None:
         key = (subj << 32) | watcher
@@ -457,11 +604,19 @@ class EntitySlabs:
             ls, lw = int(self._e_subj[last]), int(self._e_wat[last])
             self._e_subj[idx] = ls
             self._e_wat[idx] = lw
+            self._e_tier[idx] = self._e_tier[last]
+            self._e_base[idx] = self._e_base[last]
+            self._e_bvalid[idx] = self._e_bvalid[last]
+            self._e_bcid[idx] = self._e_bcid[last]
+            self._e_key_at[idx] = self._e_key_at[last]
+            self._e_pending[idx] = self._e_pending[last]
+            self._e_last_d2[idx] = self._e_last_d2[last]
             self._e_map[(ls << 32) | lw] = idx
         self._e_n = last
         self._edge_refs[subj] -= 1
         self._edge_refs[watcher] -= 1
         self._topo_version += 1
+        self._edge_version += 1
 
     def edge_count(self) -> int:
         return self._e_n
@@ -579,6 +734,273 @@ class EntitySlabs:
         """Both stages in one call (tests / embedded drivers)."""
         sel = self.collect_sync_selection()
         return {} if sel is None else self.pack_sync(sel)
+
+    # --- adaptive per-client sync ([sync]; ROADMAP item 5) -------------------
+
+    def configure_sync(self, cfg) -> None:
+        """Apply a [sync] section (config/read_config.py SyncConfig — any
+        object with its fields works — or a pre-built SyncTuning).
+        Defaults keep the legacy path."""
+        if isinstance(cfg, SyncTuning):
+            self.sync = cfg
+            return
+        self.sync = SyncTuning(
+            tier_cadences=tuple(cfg.tier_cadences),
+            quantize_bits=cfg.quantize_bits,
+            keyframe_interval=cfg.keyframe_interval,
+            near_ratio=cfg.near_ratio,
+            far_ratio=cfg.far_ratio,
+            retier_interval=cfg.retier_interval,
+        )
+
+    def _set_tier_gauges(self, tier: np.ndarray) -> None:
+        counts = np.bincount(tier, minlength=len(self.sync.cadences))
+        for i, c in enumerate(counts.tolist()):
+            _M_TIER_EDGES.labels(str(i)).set(c)
+
+    def retier_host(self) -> None:
+        """Host-side tier classification of every interest pair: ONE
+        vectorized sweep over the edge table amortizing all clients'
+        range queries (the batched AOI engine's in-launch tier pass
+        supersedes this — ops/neighbor.py — and writes the same column
+        via :meth:`apply_device_tiers`)."""
+        n = self._e_n
+        if n == 0:
+            return
+        subj, wat = self._e_subj[:n], self._e_wat[:n]
+        d = self.xz[subj] - self.xz[wat]
+        d2 = d[:, 0] * d[:, 0] + d[:, 1] * d[:, 1]
+        sy = self.sync
+        tier = classify_tiers(d2, self.radius[wat], len(sy.cadences),
+                              sy.near_ratio, sy.far_ratio,
+                              self._e_last_d2[:n])
+        self._e_tier[:n] = tier
+        self._e_last_d2[:n] = d2
+        self._set_tier_gauges(tier)
+
+    def snapshot_edges_for_tiering(self):
+        """(edge_version, count, subj copy, wat copy) — what the batched
+        AOI dispatch ships to the device tier pass. Copies, because the
+        edge table swap-removes while the step is in flight."""
+        n = self._e_n
+        return (self._edge_version, n,
+                self._e_subj[:n].copy(), self._e_wat[:n].copy())
+
+    def apply_device_tiers(self, edge_version: int, count: int,
+                           tiers: np.ndarray) -> bool:
+        """Write a device-computed tier vector back, iff the edge layout
+        is unchanged since the snapshot (edge churn between dispatch and
+        writeback discards it — affected pairs keep their previous tier
+        and brand-new pairs default to full rate: conservative, never
+        stale)."""
+        if edge_version != self._edge_version or count != self._e_n:
+            return False
+        if count:
+            self._e_tier[:count] = tiers[:count]
+            self._set_tier_gauges(self._e_tier[:count])
+        return True
+
+    def collect_sync_packets(self) -> dict[int, tuple[bytes, bytes]]:
+        """The game-facing sync collection: per destination gate, a
+        (full_records, delta_records) byte-buffer pair — full = 48 B
+        [cid + keyframe] blocks for SYNC_POSITION_YAW_ON_CLIENTS, delta =
+        40 B [cid + quantized delta] blocks for the v6
+        SYNC_POSITION_YAW_DELTA_ON_CLIENTS. With the default [sync]
+        config this is exactly the legacy full-rate path (same cache,
+        same bytes, empty delta halves)."""
+        if not self.sync.enabled:
+            sel = self.collect_sync_selection()
+            if sel is None:
+                return {}
+            return {g: (arr.tobytes(), b"")
+                    for g, arr in self.pack_sync(sel).items()}
+        out = self._collect_sync_tiered()
+        return out if out is not None else {}
+
+    def _emit_mask(self, seq: int):
+        """Stage 1 of the tiered collect: which edges emit THIS collection.
+        Movement latches per edge (``_e_pending``) so a mover's final
+        position always flows out when its pair's tier next comes due —
+        a tier-k pair is never staler than its cadence, and a stationary
+        world emits nothing at any tier."""
+        n = self._e_n
+        if n == 0:
+            return None
+        sy = self.sync
+        flags = self.flags
+        subj, wat = self._e_subj[:n], self._e_wat[:n]
+        pend = self._e_pending[:n]
+        pend |= (flags[subj] & SIF_SYNC_NEIGHBOR_CLIENTS).astype(bool)
+        watc = self.has_client[wat]
+        cad = sy.cadences[self._e_tier[:n]]
+        phase = (subj.astype(np.int64) * 2654435761 + wat) % cad
+        due = (seq % cad) == phase
+        bvalid = self._e_bvalid[:n]
+        rebind = bvalid & (self._e_bcid[:n] != self.cid[wat])
+        forced = (~bvalid) | rebind | (self._e_key_at[:n] <= seq)
+        emit = pend & watc & (due | forced)
+        suppressed = int(np.count_nonzero(pend & watc & ~emit))
+        if suppressed:
+            _M_SYNC_SUPPRESSED.inc(suppressed)
+        eidx = np.flatnonzero(emit)
+        pend[eidx] = False
+        return eidx
+
+    def _collect_sync_tiered(self) -> dict[int, tuple[bytes, bytes]] | None:
+        """The tiered + delta-encoded collection (single stage: selection,
+        quantization, baseline advance and wire pack in one vectorized
+        pass — the steady-state cache doesn't apply because the due
+        pattern cycles with the tier cadences).
+
+        Encoding contract (mirrored by the client decode and pinned by the
+        roundtrip fuzz in tests/test_synctier.py): a pair's first emission
+        — and any emission after a client rebind, past the periodic
+        keyframe schedule, or whose delta overflows int16 — is a KEYFRAME
+        carrying exact float32 position/yaw; every other emission is a
+        delta record of int16 multiples of 2^-quantize_bits. The sender's
+        baseline advances by the QUANTIZED delta (never to the true
+        position), so the receiver reconstructs the baseline bit-exactly
+        and the error vs truth stays <= step/2 forever — quantization
+        error cannot accumulate."""
+        sy = self.sync
+        seq = self._collect_seq
+        self._collect_seq = seq + 1
+        if (not self.device_tiers and len(sy.cadences) > 1
+                and seq % sy.retier_interval == 0):
+            self.retier_host()
+        flags = self.flags
+        flagged = np.flatnonzero(flags)
+        eidx = self._emit_mask(seq)
+        if flagged.size == 0 and (eidx is None or eidx.size == 0):
+            return None
+        if flagged.size:
+            f = flags[flagged]
+            own = flagged[
+                (f & SIF_SYNC_OWN_CLIENT).astype(bool)
+                & self.has_client[flagged]
+                & (self.syncing[flagged] == 0)
+            ]
+            flags[flagged] = 0
+        else:
+            own = np.empty(0, np.int64)
+        if eidx is None:
+            eidx = np.empty(0, np.int64)
+        es = self._e_subj[eidx]
+        ew = self._e_wat[eidx]
+        k_own = own.size
+        rs = np.concatenate([own, es])  # subject slot per row
+        rd = np.concatenate([own, ew])  # destination slot per row
+        if rs.size == 0:
+            return None
+        pos = np.empty((rs.size, 4), np.float32)
+        pos[:, 0] = self.xz[rs, 0]
+        pos[:, 1] = self.y[rs]
+        pos[:, 2] = self.xz[rs, 1]
+        pos[:, 3] = self.yaw[rs]
+        base = np.concatenate([self.own_base[own], self._e_base[eidx]])
+        bvalid_raw = np.concatenate(
+            [self.own_bvalid[own], self._e_bvalid[eidx]])
+        cid_ok = np.concatenate(
+            [self.own_bcid[own] == self.cid[own],
+             self._e_bcid[eidx] == self.cid[ew]])
+        bvalid = bvalid_raw & cid_ok
+        key_at = np.concatenate(
+            [self.own_key_at[own], self._e_key_at[eidx]])
+        if sy.quantize_bits == 0:
+            key = np.ones(rs.size, bool)
+            qd = np.zeros((rs.size, 4), np.int16)
+            new_base = pos
+        else:
+            qf = np.rint((pos - base) / sy.step)
+            over = (np.abs(qf) > 32767.0).any(axis=1)
+            key = (~bvalid) | over | (key_at <= seq)
+            qd = qf.astype(np.int16)
+            new_base = np.where(
+                key[:, None], pos,
+                base + qf.astype(np.float32) * sy.step)
+            new_n = int(np.count_nonzero(~bvalid_raw))
+            rebind_n = int(np.count_nonzero(bvalid_raw & ~cid_ok))
+            tele_n = int(np.count_nonzero(over & bvalid))
+            if new_n:
+                _FORCED_NEW.inc(new_n)
+            if rebind_n:
+                _FORCED_REBIND.inc(rebind_n)
+            if tele_n:
+                _FORCED_TELEPORT.inc(tele_n)
+        # Baseline/schedule advance, written back per source table.
+        keyed = np.flatnonzero(key)
+        new_key_at = np.where(key, seq + sy.keyframe_interval, key_at)
+        self.own_base[own] = new_base[:k_own]
+        self.own_bvalid[own] = True
+        self.own_bcid[own] = self.cid[own]
+        self.own_key_at[own] = new_key_at[:k_own]
+        self._e_base[eidx] = new_base[k_own:]
+        self._e_bvalid[eidx] = True
+        self._e_bcid[eidx] = self.cid[ew]
+        self._e_key_at[eidx] = new_key_at[k_own:]
+        gates_r = self.gateid[rd]
+        full = self._pack_rows(
+            np.flatnonzero(key), rs, rd, gates_r, pos, qd,
+            CLIENT_SYNC_BLOCK_DTYPE)
+        delta = self._pack_rows(
+            np.flatnonzero(~key), rs, rd, gates_r, pos, qd,
+            CLIENT_DELTA_SYNC_BLOCK_DTYPE)
+        if keyed.size:
+            _KIND_KEY.inc(int(keyed.size))
+            _BYTES_KEY.inc(int(keyed.size) * CLIENT_SYNC_BLOCK_DTYPE.itemsize)
+        n_delta = rs.size - keyed.size
+        if n_delta:
+            _KIND_DELTA.inc(n_delta)
+            _BYTES_DELTA.inc(n_delta * CLIENT_DELTA_SYNC_BLOCK_DTYPE.itemsize)
+        self._rate_bytes += (
+            int(keyed.size) * CLIENT_SYNC_BLOCK_DTYPE.itemsize
+            + n_delta * CLIENT_DELTA_SYNC_BLOCK_DTYPE.itemsize)
+        now = time.monotonic()
+        if now - self._rate_stamp >= 1.0:
+            clients = int(np.count_nonzero(self.has_client))
+            _M_BYTES_PER_CLIENT.set(
+                self._rate_bytes / (now - self._rate_stamp)
+                / max(1, clients))
+            self._rate_stamp = now
+            self._rate_bytes = 0
+        merged = {
+            g: (full.get(g, _EMPTY), delta.get(g, _EMPTY))
+            for g in (full.keys() | delta.keys())
+        }
+        return merged or None
+
+    def _pack_rows(self, idx: np.ndarray, rs: np.ndarray, rd: np.ndarray,
+                   gates_r: np.ndarray, pos: np.ndarray, qd: np.ndarray,
+                   dtype: np.dtype) -> dict[int, bytes]:
+        """Pack one encoding's rows into per-gate wire buffers, ordered by
+        (gate, destination slot) so each client's records form one
+        contiguous run for the gate's run-slicing demux."""
+        if idx.size == 0:
+            return {}
+        g = gates_r[idx]
+        order = np.argsort(
+            (g.astype(np.int64) << 32) | rd[idx], kind="stable")
+        idx = idx[order]
+        g = g[order]
+        out = np.empty(idx.size, dtype)
+        out["cid"] = self.cid[rd[idx]]
+        out["eid"] = self.eid[rs[idx]]
+        if dtype is CLIENT_SYNC_BLOCK_DTYPE:
+            out["x"] = pos[idx, 0]
+            out["y"] = pos[idx, 1]
+            out["z"] = pos[idx, 2]
+            out["yaw"] = pos[idx, 3]
+        else:
+            out["dx"] = qd[idx, 0]
+            out["dy"] = qd[idx, 1]
+            out["dz"] = qd[idx, 2]
+            out["dyaw"] = qd[idx, 3]
+        bounds = [0] + (np.flatnonzero(g[1:] != g[:-1]) + 1).tolist()
+        bounds.append(idx.size)
+        return {
+            int(g[bounds[i]]): out[bounds[i]:bounds[i + 1]].tobytes()
+            for i in range(len(bounds) - 1)
+        }
 
     # --- per-class batched tick hooks --------------------------------------
 

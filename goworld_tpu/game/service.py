@@ -172,6 +172,11 @@ class GameService:
             # Pre-size the slab store ([entity] slab_initial) so steady-
             # state populations don't pay growth reallocation mid-login.
             rt.slabs.ensure_capacity(ecfg.slab_initial)
+        sycfg = getattr(self.cfg, "sync", None)
+        if sycfg is not None:
+            # [sync] adaptive per-client sync: cadence tiers + delta/
+            # quantized records (entity/slabs.py; defaults = legacy path).
+            rt.slabs.configure_sync(sycfg)
         if rt.aoi_backend != "xzlist" and rt.aoi_params is None:
             from goworld_tpu.entity.aoi.batched import params_from_config
 
@@ -642,10 +647,14 @@ class GameService:
         if not per_gate:
             return
         t0 = time.perf_counter()
-        for gateid, buf in per_gate.items():
-            dispatchercluster.select_by_gate_id(gateid).send_sync_position_yaw_on_clients(
-                gateid, buf
-            )
+        qb = entity_manager.runtime.slabs.sync.quantize_bits
+        for gateid, (full, delta) in per_gate.items():
+            conn = dispatchercluster.select_by_gate_id(gateid)
+            if full:
+                conn.send_sync_position_yaw_on_clients(gateid, full)
+            if delta:
+                conn.send_sync_position_yaw_delta_on_clients(
+                    gateid, qb, delta)
         _HOP_GAME_SEND.inc(time.perf_counter() - t0)
 
     # --- packet handlers (GameService.go:92-157) ------------------------------

@@ -46,7 +46,9 @@ from goworld_tpu.gate.filter_tree import FilterTree
 from goworld_tpu.netutil.packet import Packet
 from goworld_tpu.netutil.packet_conn import ConnectionClosed, PacketConnection
 from goworld_tpu.proto.conn import (
+    CLIENT_DELTA_SYNC_DTYPE,
     CLIENT_SYNC_DTYPE,
+    DELTA_SYNC_RECORD_SIZE,
     SYNC_RECORD_SIZE,
     GoWorldConnection,
 )
@@ -55,6 +57,7 @@ from goworld_tpu.telemetry import tracing
 from goworld_tpu.utils import gwlog, opmon
 
 _CLIENT_BLOCK_SIZE = 16 + SYNC_RECORD_SIZE  # clientid + sync record
+_CLIENT_DELTA_BLOCK_SIZE = 16 + DELTA_SYNC_RECORD_SIZE  # cid + delta record
 
 # Client proxies killed by the gate itself (vs. orderly client disconnects):
 # reason="heartbeat" = silent past [gateN] heartbeat_timeout (swept in
@@ -579,6 +582,8 @@ class GateService:
             self._handle_redirect(msgtype, packet)
         elif msgtype == MsgType.SYNC_POSITION_YAW_ON_CLIENTS:
             self._handle_sync_on_clients(packet)
+        elif msgtype == MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+            self._handle_sync_delta_on_clients(packet)
         elif msgtype == MsgType.CALL_FILTERED_CLIENTS:
             self._handle_call_filtered_clients(packet)
         else:
@@ -648,6 +653,34 @@ class GateService:
             if cp is not None:
                 cp.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
                         rec[lo:hi].tobytes())
+        _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
+
+    def _handle_sync_delta_on_clients(self, packet: Packet) -> None:
+        """De-multiplex the v6 quantized-delta variant: [u16 gateid]
+        [u8 quantize_bits] + fixed 40 B [clientid + 24 B delta record]
+        blocks, same vectorized run-slicing as the full-precision demux.
+        Each client's forward re-carries the quantize_bits header byte so
+        the client decode stays self-describing — one small concat per
+        RUN, not per record."""
+        t0 = time.perf_counter()
+        packet.read_uint16()  # gateid
+        qb = packet.read_byte()
+        data = packet.read_rest()
+        k = len(data) // _CLIENT_DELTA_BLOCK_SIZE
+        if not k:
+            return
+        header = bytes((qb,))
+        arr = np.frombuffer(data, CLIENT_DELTA_SYNC_DTYPE, count=k)
+        cids = arr["cid"]
+        rec = arr["rec"]
+        bounds = [0] + (np.flatnonzero(cids[1:] != cids[:-1]) + 1).tolist() + [k]
+        clients = self.clients
+        for i in range(len(bounds) - 1):
+            lo, hi = bounds[i], bounds[i + 1]
+            cp = clients.get(cids[lo].decode("ascii"))
+            if cp is not None:
+                cp.send(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,
+                        header + rec[lo:hi].tobytes())
         _HOP_GATE_DEMUX.inc(time.perf_counter() - t0)
 
     # --- filter props (FilterTree.go, GateService.go:300-344) ----------------

@@ -965,6 +965,93 @@ def _jitted_step_packed_fused(params: NeighborParams, backend: str,
     return sentinel.SentinelJit(f"aoi_step_fused_{backend}", jax.jit(fn))
 
 
+# --- sync cadence tier pass ([sync]; rides the step launch) ------------------
+#
+# Adaptive per-client sync (ROADMAP item 5): each (subject, watcher)
+# interest pair is classified into a sync cadence tier by distance and
+# approach rate. The classification is ONE batched sweep over the edge
+# list — all clients' range queries amortized into a single gather pass —
+# and it rides the SAME device launch as the AOI step, so a steady-state
+# tick stays one launch. The formula mirrors entity/slabs.classify_tiers
+# (the host fallback used by non-batched backends), pinned equal by
+# tests/test_synctier.py's parity oracle.
+
+
+def _tier_pass(pos, ppos, radius, subj, wat, n_tiers: int,
+               near_ratio: float, far_ratio: float):
+    """uint8[Ecap] tier per padded edge: subj/wat are int32 slot ids with
+    sentinel >= capacity on pad rows (tier 0 there — full rate is the
+    conservative default). Distance uses the CURRENT epoch; a pair whose
+    distance shrank since the PREVIOUS epoch is approaching and drops one
+    tier toward full rate."""
+    n = pos.shape[0]
+    valid = (subj >= 0) & (subj < n) & (wat >= 0) & (wat < n)
+    s = jnp.clip(subj, 0, n - 1)
+    w = jnp.clip(wat, 0, n - 1)
+    d = pos[s] - pos[w]
+    d2 = d[:, 0] * d[:, 0] + d[:, 1] * d[:, 1]
+    pd = ppos[s] - ppos[w]
+    pd2 = pd[:, 0] * pd[:, 0] + pd[:, 1] * pd[:, 1]
+    r = radius[w]
+    r2 = jnp.maximum(r * r, jnp.float32(1e-12))
+    ratio = jnp.sqrt(d2 / r2)
+    span = max(far_ratio - near_ratio, 1e-9)
+    tier = 1 + jnp.floor(
+        (ratio - near_ratio) / span * (n_tiers - 1)).astype(jnp.int32)
+    tier = jnp.clip(tier, 0, n_tiers - 1)
+    tier = jnp.where(ratio <= near_ratio, 0, tier)
+    tier = jnp.where(d2 < pd2, jnp.maximum(tier - 1, 0), tier)
+    return jnp.where(valid, tier, 0).astype(jnp.uint8)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_step_packed_tiered(params: NeighborParams, backend: str,
+                               programs: tuple | None, tier_cfg: tuple,
+                               edge_cap: int):
+    """The step jit (plain or fused) with the tier pass attached as one
+    extra output — still exactly one launch. Keyed by ``edge_cap`` (the
+    padded edge-array size) ON PURPOSE: edge capacities grow in
+    power-of-two tiers, and a fresh lru instance per capacity makes the
+    growth compile a WARM trace on a new SentinelJit instead of a
+    steady-state retrace on a hot one (telemetry/sentinel.py)."""
+    if programs is None:
+        if backend == "jnp":
+            base = functools.partial(_step_packed_jnp, params)
+        else:
+            base = functools.partial(
+                _step_pallas, params, backend == "pallas_interpret")
+    elif backend == "jnp":
+        base = functools.partial(_step_packed_fused_jnp, params, programs)
+    else:
+        base = functools.partial(
+            _step_packed_fused_pallas, params,
+            backend == "pallas_interpret", programs)
+    # Offset of the CURRENT epoch's (pos, ..., radius) within the args
+    # after the previous epoch's four: the pallas step additionally
+    # carries 7 carried-grid artifacts first.
+    off = 0 if backend == "jnp" else 7
+    n_tiers, near_ratio, far_ratio = tier_cfg
+
+    def fn(subj, wat, ppos, pact, pspc, prad, *rest):
+        outs = base(ppos, pact, pspc, prad, *rest)
+        tiers = _tier_pass(rest[off], ppos, rest[off + 3], subj, wat,
+                           n_tiers, near_ratio, far_ratio)
+        return outs + (tiers,)
+
+    return sentinel.SentinelJit(
+        f"aoi_step_tiered_{backend}", jax.jit(fn))
+
+
+def tier_edge_capacity(n_edges: int) -> int:
+    """Padded edge-array size for ``n_edges`` live edges: power-of-two
+    tiers from 256 so the tiered jit recompiles only on capacity growth
+    (a handful of times over a process's life), never per edge churn."""
+    cap = 256
+    while cap < n_edges:
+        cap *= 2
+    return cap
+
+
 # --- jit wrappers ------------------------------------------------------------
 
 
@@ -1040,7 +1127,8 @@ class PendingStep:
     engine's documented delivery model anyway (batched.py docstring).
     """
 
-    __slots__ = ("_engine", "_pager", "_out", "_collected", "fused")
+    __slots__ = ("_engine", "_pager", "_out", "_collected", "fused",
+                 "tiers")
 
     def __init__(self, engine: "NeighborEngine", pager, out) -> None:
         self._engine = engine
@@ -1052,6 +1140,11 @@ class PendingStep:
         # row→slot perm or None, device output arrays). Consumed exactly
         # once by BatchAOIService._consume_fused before the next dispatch.
         self.fused = None
+        # Sync-tier payload ([sync]; set when the step carried the tier
+        # pass): (edge_version snapshot, edge count, device tier array).
+        # Consumed by BatchAOIService._consume_tiers before the next
+        # dispatch; discarded there if the edge table churned meanwhile.
+        self.tiers = None
         start_host_copy(out)
 
     def is_ready(self) -> bool:
@@ -1203,6 +1296,10 @@ class NeighborEngine:
     # The batched service may hand this engine a fused-logic payload
     # (aoi/batched.py _build_logic); sharded variants opt in separately.
     supports_fused_logic = True
+    # The batched service may additionally ride the [sync] cadence tier
+    # pass on the step launch (step_async tiers=); engines without it
+    # fall back to the host classification in entity/slabs.py.
+    supports_tier_pass = True
 
     def step_async(
         self,
@@ -1212,6 +1309,7 @@ class NeighborEngine:
         radius: np.ndarray,
         meta_dirty: bool = True,
         logic: tuple | None = None,
+        tiers: tuple | None = None,
     ) -> PendingStep:
         """Dispatch one tick without blocking; collect() fetches the events.
 
@@ -1250,34 +1348,56 @@ class NeighborEngine:
             meta = self._state[1:4]
         cur = (jnp.array(pos, jnp.float32),) + meta
         fused_out = None
+        tier_out = None
+        tier_meta = None
+        extra: tuple = ()
+        programs: tuple | None = None
         if logic is not None:
             programs, sel, y, yaw, dt, cols = logic
-            jit_fused = _jitted_step_packed_fused(
-                self.params, self.backend, tuple(programs)
-            )
+            programs = tuple(programs)
             extra = (
                 jnp.array(y, jnp.float32),
                 jnp.array(yaw, jnp.float32),
                 jnp.array(sel, jnp.int32),
                 jnp.float32(dt),
             ) + tuple(jnp.array(c) for c in cols)
-            if self.backend == "jnp":
-                enter_ids, leave_ids, out, fused_out = jit_fused(
-                    *self._state, *cur, *extra
-                )
-                next_state = cur
+        if tiers is not None:
+            # ``tiers = (edge_version, n_edges, subj_pad, wat_pad,
+            # (n_tiers, near_ratio, far_ratio))`` — the [sync] cadence
+            # tier pass rides the SAME launch as the step (+ any fused
+            # logic); its output is the step outputs plus one uint8
+            # tier vector.
+            t_ver, t_n, subj_pad, wat_pad, tcfg = tiers
+            tier_meta = (t_ver, t_n)
+            jit_tiered = _jitted_step_packed_tiered(
+                self.params, self.backend, programs, tuple(tcfg),
+                len(subj_pad),
+            )
+            outs = jit_tiered(
+                jnp.array(subj_pad, jnp.int32),
+                jnp.array(wat_pad, jnp.int32),
+                *self._state, *cur, *extra,
+            )
+            tier_out = outs[-1]
+            outs = outs[:-1]
+        elif logic is not None:
+            jit_fused = _jitted_step_packed_fused(
+                self.params, self.backend, programs
+            )
+            outs = jit_fused(*self._state, *cur, *extra)
+        else:
+            outs = self._jit_step(*self._state, *cur)
+        if self.backend == "jnp":
+            if logic is not None:
+                enter_ids, leave_ids, out, fused_out = outs
             else:
-                enter_ctx, leave_ctx, out, next_grid, fused_out = jit_fused(
-                    *self._state, *cur, *extra
-                )
-                next_state = cur + next_grid
-        elif self.backend == "jnp":
-            enter_ids, leave_ids, out = self._jit_step(*self._state, *cur)
+                enter_ids, leave_ids, out = outs
             next_state = cur
         else:
-            enter_ctx, leave_ctx, out, next_grid = self._jit_step(
-                *self._state, *cur
-            )
+            if logic is not None:
+                enter_ctx, leave_ctx, out, next_grid, fused_out = outs
+            else:
+                enter_ctx, leave_ctx, out, next_grid = outs
             next_state = cur + next_grid
 
         if self.backend == "jnp":
@@ -1296,6 +1416,9 @@ class NeighborEngine:
                 start_host_copy(arr)
             pending.fused = (tuple(logic[0]), np.asarray(logic[1]),
                              None, fused_out)
+        if tier_out is not None:
+            start_host_copy(tier_out)
+            pending.tiers = tier_meta + (tier_out,)
         return pending
 
     def warmup_fused(self, programs: tuple, col_dtypes: tuple) -> None:
@@ -1334,6 +1457,50 @@ class NeighborEngine:
             self.params, self.backend, tuple(programs)
         )
         jax.block_until_ready(jit_fused(*state, *zeros, *extra)[2])
+
+    def warmup_tiered(self, programs: tuple | None, col_dtypes: tuple,
+                      tier_cfg: tuple, edge_cap: int) -> None:
+        """Compile the tiered step jit (plain or fused variant) WITHOUT
+        touching engine state — the warmup_fused analog for the [sync]
+        tier pass. The batched service never dispatches an un-compiled
+        tiered variant from the game loop (a ~seconds XLA trace there
+        froze RPCs, seen live); this populates the lru cache off-thread
+        or at boot."""
+        n = self.params.capacity
+        zeros = (
+            jnp.zeros((n, 2), jnp.float32),
+            jnp.zeros((n,), jnp.bool_),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.float32),
+        )
+        state: tuple = zeros
+        if self.backend != "jnp":
+            table_size = self.params.num_buckets * LANES
+            state = state + (
+                jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.full((table_size,), n, jnp.int32),
+                jnp.full((n,), -1, jnp.int32),
+                jnp.arange(n, dtype=jnp.int32),
+                jnp.full((n,), table_size, jnp.int32),
+            )
+        extra: tuple = ()
+        if programs:
+            extra = (
+                jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.float32),
+                jnp.zeros((n,), jnp.int32),
+                jnp.float32(0.0),
+            ) + tuple(jnp.zeros((n,), np.dtype(d)) for d in col_dtypes)
+        pads = jnp.full((edge_cap,), n, jnp.int32)
+        jit_tiered = _jitted_step_packed_tiered(
+            self.params, self.backend,
+            tuple(programs) if programs else None, tuple(tier_cfg),
+            edge_cap,
+        )
+        jax.block_until_ready(
+            jit_tiered(pads, pads, *state, *zeros, *extra)[2])
 
     def fused_trace_count(self, programs: tuple) -> int:
         """Compiled-trace count of the fused step jit for ``programs`` —

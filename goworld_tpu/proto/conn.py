@@ -47,6 +47,31 @@ assert SYNC_DTYPE.itemsize == SYNC_RECORD_SIZE
 assert CLIENT_SYNC_DTYPE.itemsize == 16 + SYNC_RECORD_SIZE
 assert CLIENT_SYNC_BLOCK_DTYPE.itemsize == 16 + SYNC_RECORD_SIZE
 
+# --- v6 compact sync records (adaptive per-client sync, ROADMAP item 5) ------
+# Quantized position DELTAS against a per-client baseline: EntityID(16) +
+# dx,dy,dz,dyaw int16, each in units of 2^-quantize_bits (the packet
+# header names the step, proto/schema.py). 24 B on the client wire vs the
+# full record's 32 B; the real win is the cadence tiers gating how often
+# a record is emitted at all (entity/slabs.py).
+DELTA_SYNC_RECORD_SIZE = 16 + 4 * 2
+DELTA_SYNC_DTYPE = np.dtype(
+    [("eid", "S16"), ("dx", "<i2"), ("dy", "<i2"), ("dz", "<i2"),
+     ("dyaw", "<i2")]
+)
+# [clientid(16) + 24 B delta record] block (game→dispatcher→gate); the
+# record half stays opaque so the gate demux slices per-client runs with
+# one tobytes() per client, exactly like CLIENT_SYNC_DTYPE.
+CLIENT_DELTA_SYNC_DTYPE = np.dtype([("cid", "S16"), ("rec", "V24")])
+# The same block with named fields — the layout the tiered columnar
+# collect fills by column assignment (entity/slabs.py).
+CLIENT_DELTA_SYNC_BLOCK_DTYPE = np.dtype(
+    [("cid", "S16"), ("eid", "S16"), ("dx", "<i2"), ("dy", "<i2"),
+     ("dz", "<i2"), ("dyaw", "<i2")]
+)
+assert DELTA_SYNC_DTYPE.itemsize == DELTA_SYNC_RECORD_SIZE
+assert CLIENT_DELTA_SYNC_DTYPE.itemsize == 16 + DELTA_SYNC_RECORD_SIZE
+assert CLIENT_DELTA_SYNC_BLOCK_DTYPE.itemsize == 16 + DELTA_SYNC_RECORD_SIZE
+
 # Process-wide wire volume (telemetry): counted HERE because every peer
 # connection of every process — dispatcher↔game/gate streams AND gate
 # client conns over TCP/WS/KCP — goes through GoWorldConnection, so one
@@ -103,6 +128,18 @@ def pack_client_sync_blocks(
     if not rows:
         return b""
     return np.array(rows, dtype=CLIENT_SYNC_BLOCK_DTYPE).tobytes()
+
+
+def pack_client_delta_sync_blocks(
+    rows: list[tuple[str, str, int, int, int, int]]
+) -> bytes:
+    """Batch-pack [clientid(16) + 24 B delta record] blocks from
+    (clientid, eid, dx, dy, dz, dyaw) rows of pre-quantized int16 deltas
+    (tests + the schema fuzz seed; the hot path fills
+    CLIENT_DELTA_SYNC_BLOCK_DTYPE by column assignment in slabs.py)."""
+    if not rows:
+        return b""
+    return np.array(rows, dtype=CLIENT_DELTA_SYNC_BLOCK_DTYPE).tobytes()
 
 
 def pack_client_sync_columns(cid: np.ndarray, eid: np.ndarray,
@@ -427,6 +464,16 @@ class GoWorldConnection:
         fan-out's largest per-tick buffer pays exactly one copy here)."""
         self.send(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
                   Packet(struct.pack("<H", gateid) + records))
+
+    def send_sync_position_yaw_delta_on_clients(
+        self, gateid: int, quantize_bits: int, records: bytes
+    ) -> None:
+        """records = concatenated [clientid(16) + 24 B delta record]
+        blocks (the v6 compact sync variant). ``quantize_bits`` rides the
+        payload so the gate/client decode is self-describing: deltas are
+        int16 multiples of 2^-quantize_bits world units."""
+        self.send(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,
+                  Packet(struct.pack("<HB", gateid, quantize_bits) + records))
 
     # --- process / deployment events ---------------------------------------
 

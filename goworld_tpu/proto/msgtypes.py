@@ -43,7 +43,13 @@ import enum
 # dispatcher would mis-read the bool as the version's first byte), plus
 # the new GAME_LOAD_REPORT / REBALANCE_MIGRATE types a v4 peer would drop
 # as unhandled.
-PROTO_VERSION = 5
+# v6: adaptive per-client sync — the new SYNC_POSITION_YAW_DELTA_ON_CLIENTS
+# type carries quantized position DELTAS against per-client baselines
+# ([u16 gateid][u8 quantize_bits] + fixed 40 B [cid + delta record]
+# blocks, proto/conn.py CLIENT_DELTA_SYNC_DTYPE). A v5 gate would drop
+# the type as unhandled and its clients would silently stop seeing
+# tiered neighbors move — fail the mixed pair at the handshake instead.
+PROTO_VERSION = 6
 
 # High bit of the wire msgtype: a tracing trailer follows the payload.
 # Never a routing class — masked off before any msgtype comparison.
@@ -111,6 +117,12 @@ class MsgType(enum.IntEnum):
     # --- gate-handled (proto.go:116-123) -----------------------------------
     CALL_FILTERED_CLIENTS = 1501
     SYNC_POSITION_YAW_ON_CLIENTS = 1502
+    # Compact sync variant (no reference analog; ROADMAP item 5): quantized
+    # position deltas against a per-client baseline, sent beside the full-
+    # precision keyframes that ride SYNC_POSITION_YAW_ON_CLIENTS. The
+    # payload self-describes its quantization step ([u8 quantize_bits]
+    # after the gateid) so gates and clients need no config coupling.
+    SYNC_POSITION_YAW_DELTA_ON_CLIENTS = 1503
 
     # --- gate↔client direct (proto.go:126-133) -----------------------------
     HEARTBEAT_FROM_CLIENT = 2001

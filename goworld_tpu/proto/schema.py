@@ -228,6 +228,13 @@ SCHEMAS: tuple[MessageSchema, ...] = (
     # (proto/conn.py CLIENT_SYNC_DTYPE).
     schema(MsgType.SYNC_POSITION_YAW_ON_CLIENTS,
            ("gateid", "u16"), raw="client_sync_blocks"),
+    # v6: [u16 gateid][u8 quantize_bits] + concatenated [clientid(16) +
+    # 24 B delta record] blocks (proto/conn.py CLIENT_DELTA_SYNC_DTYPE).
+    # The quantize step (2^-quantize_bits world units) rides the payload
+    # so the gate/client decode needs no config coupling with the game.
+    schema(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,
+           ("gateid", "u16"), ("quantize_bits", "u8"),
+           raw="client_delta_sync_blocks"),
     # --- gate<->client (2001..) --------------------------------------------
     schema(MsgType.HEARTBEAT_FROM_CLIENT),
 )
@@ -284,6 +291,7 @@ def schema_digest() -> str:
 #: and means the mixed-version handshake guard no longer matches history.
 SCHEMA_HISTORY: dict[int, str] = {
     5: "6707328a4b365972",
+    6: "3f2d7dd284f1af13",
 }
 
 
@@ -354,17 +362,25 @@ _FIELD_EXAMPLES: dict[tuple[int, str], object] = {
 _RAW_EXAMPLES: dict[str, bytes] = {
     "sync_records": b"",  # filled lazily to avoid an import cycle
     "client_sync_blocks": b"",
+    "client_delta_sync_blocks": b"",
 }
 
 
 def _raw_example(region: str) -> bytes:
-    from goworld_tpu.proto.conn import pack_client_sync_blocks, pack_sync_record
+    from goworld_tpu.proto.conn import (
+        pack_client_delta_sync_blocks,
+        pack_client_sync_blocks,
+        pack_sync_record,
+    )
 
     if region == "sync_records":
         return pack_sync_record(_EXAMPLE_EID, 1.0, 2.0, 3.0, 0.5)
     if region == "client_sync_blocks":
         return pack_client_sync_blocks(
             [(_EXAMPLE_EID, _EXAMPLE_EID, 1.0, 2.0, 3.0, 0.5)])
+    if region == "client_delta_sync_blocks":
+        return pack_client_delta_sync_blocks(
+            [(_EXAMPLE_EID, _EXAMPLE_EID, 1, -2, 3, 0)])
     raise KeyError(region)
 
 

@@ -54,6 +54,7 @@ SNAPSHOT_FAMILY_PREFIXES: tuple[str, ...] = (
     "gate_",
     "cluster_",
     "rebalance_",
+    "sync_",
     "chaos_recovery_seconds",
     "net_packets_total",
     "net_bytes_total",

@@ -138,13 +138,29 @@ def _row(name: str, proc: dict[str, Any], tick_budget: float) -> list[str]:
         heat,
         f"{int(backlog)}" if backlog is not None else "-",
         fused,
+        _sync_col(m),
         f"{int(launches)}" if launches else "-",
         f"{int(retraces)}" if retraces else "0" if launches else "-",
     ]
 
 
+def _sync_col(metrics: dict[str, Any]) -> str:
+    """Adaptive-sync column ([sync], ISSUE 14): interest-pair population
+    per cadence tier (t0/t1/... slashes) plus the game's rolling sync
+    bytes/client/s — '-' for processes without tiering active."""
+    tiers = _series(metrics, "sync_tier_edges")
+    if not tiers:
+        return "-"
+    counts = "/".join(
+        str(int(s.get("value", 0))) for s in sorted(
+            tiers, key=lambda s: int(s["labels"].get("tier", "0"))))
+    bpc = _gauge(metrics, "sync_bytes_per_client_per_s")
+    return f"{counts}·{bpc:.0f}B/c" if bpc else counts
+
+
 _HEADERS = ["PROCESS", "ST", "AGE", "UP", "CENSUS", "Q",
-            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "LAUNCH", "RETR"]
+            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "SYNC",
+            "LAUNCH", "RETR"]
 
 
 def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:

@@ -632,6 +632,61 @@ def test_mutant_handler_skips_field_caught(tmp_path):
                for v in _r7(mods))
 
 
+def test_mutant_delta_sync_schema_field_drop_caught(tmp_path):
+    """ISSUE 14: dropping the v6 delta record's quantize_bits header from
+    its schema (without a version bump) dies on the digest pin — the
+    wire would otherwise mis-frame every delta block by one byte."""
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/proto/schema.py",
+        'schema(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,\n'
+        '           ("gateid", "u16"), ("quantize_bits", "u8"),\n'
+        '           raw="client_delta_sync_blocks"),',
+        'schema(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,\n'
+        '           ("gateid", "u16"),\n'
+        '           raw="client_delta_sync_blocks"),')
+    assert any("does not match the pinned" in v.message
+               for v in _r7(mods))
+
+
+def test_mutant_delta_sync_handler_read_order_caught(tmp_path):
+    """Gate demux reading quantize_bits BEFORE the gateid mis-frames the
+    v6 delta payload — caught as a read-sequence mismatch."""
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/gate/service.py",
+        "        packet.read_uint16()  # gateid\n"
+        "        qb = packet.read_byte()",
+        "        qb = packet.read_byte()\n"
+        "        packet.read_uint16()  # gateid")
+    assert any("SYNC_POSITION_YAW_DELTA_ON_CLIENTS" in v.message
+               for v in _r7(mods))
+
+
+def test_mutant_delta_sync_layout_edit_without_bump_caught(tmp_path):
+    mods = _mutated_package(
+        tmp_path, "goworld_tpu/proto/schema.py",
+        'schema(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,\n'
+        '           ("gateid", "u16"), ("quantize_bits", "u8"),',
+        'schema(MsgType.SYNC_POSITION_YAW_DELTA_ON_CLIENTS,\n'
+        '           ("gateid", "u16"), ("quantize_bits", "u16"),')
+    assert any("does not match the pinned" in v.message
+               for v in _r7(mods))
+
+
+def test_r6_covers_sync_section():
+    """ISSUE 14 satellite: every [sync] key the reader consumes is
+    documented in goworld.ini.sample and inside R6's key scan, so future
+    drift in either direction fails the gate."""
+    import os
+
+    from goworld_tpu.analysis.rules import _sample_keys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fams, _lines = _sample_keys(root)
+    assert fams["sync"] >= {
+        "tier_cadences", "quantize_bits", "keyframe_interval",
+        "near_ratio", "far_ratio", "retier_interval"}
+
+
 # --- suppression mechanics ---------------------------------------------------
 
 

@@ -84,6 +84,14 @@ SAMPLE = textwrap.dedent(
 
     [client]
     rpc_timeout = 9.5
+
+    [sync]
+    tier_cadences = 1, 4, 16
+    quantize_bits = 7
+    keyframe_interval = 48
+    near_ratio = 0.4
+    far_ratio = 0.9
+    retier_interval = 6
     """
 )
 
@@ -241,6 +249,50 @@ def test_rebalance_and_client_sections(cfg):
     assert rb.migrate_timeout == 4.5
     assert rb.cooldown == 7.0
     assert cfg.client.rpc_timeout == 9.5
+
+
+def test_sync_section(cfg):
+    """[sync] adaptive per-client sync knobs (ISSUE 14) parse with
+    exact types; defaults preserve the legacy full-rate path."""
+    sy = cfg.sync
+    assert sy.tier_cadences == (1, 4, 16)
+    assert sy.quantize_bits == 7
+    assert sy.keyframe_interval == 48
+    assert sy.near_ratio == 0.4 and sy.far_ratio == 0.9
+    assert sy.retier_interval == 6
+
+
+def test_sync_defaults_when_absent(tmp_path):
+    p = tmp_path / "g.ini"
+    p.write_text("[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
+                 "[dispatcher1]\nport = 14001\n")
+    read_config.set_config_file(str(p))
+    try:
+        sy = read_config.get().sync
+        assert sy.tier_cadences == (1,)
+        assert sy.quantize_bits == 0
+    finally:
+        read_config.set_config_file(None)
+
+
+@pytest.mark.parametrize("body,msg", [
+    ("tier_cadences = 2, 4", "starting at 1"),
+    ("tier_cadences = 1, 4, 4", "strictly ascending"),
+    ("quantize_bits = 15", "quantize_bits"),
+    ("keyframe_interval = 1", "keyframe_interval"),
+    ("near_ratio = 0.9\nfar_ratio = 0.5", "near_ratio"),
+    ("retier_interval = 0", "retier_interval"),
+])
+def test_sync_validation_rejects(tmp_path, body, msg):
+    p = tmp_path / "g.ini"
+    p.write_text("[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
+                 "[dispatcher1]\nport = 14001\n[sync]\n" + body + "\n")
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match=msg):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
 
 
 def test_rebalance_defaults_when_absent(tmp_path):

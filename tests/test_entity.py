@@ -680,7 +680,9 @@ def test_collect_entity_sync_infos():
     a.set_position(Vector3(1.0, 0.0, 1.0))
     infos = em.collect_entity_sync_infos()
     assert 3 in infos
-    buf = bytes(infos[3])
+    full, delta = infos[3]
+    buf = bytes(full)
+    assert delta == b""  # default [sync] config: legacy full-rate path
     assert len(buf) == 16 + 32  # clientid + record
     assert buf[:16] == b"B" * 16
     # second collection is empty (flags cleared)

@@ -82,6 +82,17 @@ def _legacy_collect_reference() -> dict[int, bytes]:
             for g, rows in per_gate.items()}
 
 
+def _full_halves(infos: dict[int, tuple]) -> dict[int, bytes]:
+    """The full-precision halves of the per-gate (full, delta) pairs —
+    under the default [sync] config the delta halves must be empty (the
+    legacy path, bit for bit)."""
+    out = {}
+    for g, (full, delta) in infos.items():
+        assert delta == b""
+        out[g] = full
+    return out
+
+
 def _assert_same_rows(legacy: dict[int, bytes], slab: dict[int, bytes]):
     assert set(legacy) == set(slab)
     for g in legacy:
@@ -138,7 +149,7 @@ def test_parity_oracle_randomized():
         legacy = _legacy_collect_reference()
         for e, flag in saved.items():
             e._sync_info_flag = flag
-        slab = em.collect_entity_sync_infos()
+        slab = _full_halves(em.collect_entity_sync_infos())
         _assert_same_rows(legacy, slab)
         # Both paths clear flags: a second collection is empty.
         assert em.collect_entity_sync_infos() == {}
@@ -181,7 +192,7 @@ def test_syncing_from_client_suppresses_own_row_only():
     infos = em.collect_entity_sync_infos()
     # Client-driven sync: no own-client echo (gate 1), neighbor row only.
     assert set(infos) == {2}
-    arr = np.frombuffer(infos[2], CLIENT_SYNC_BLOCK_DTYPE)
+    arr = np.frombuffer(infos[2][0], CLIENT_SYNC_BLOCK_DTYPE)
     assert arr["cid"][0] == b"B" * 16
     assert arr["x"][0] == np.float32(5.0)
     assert arr["yaw"][0] == np.float32(8.0)
@@ -198,7 +209,7 @@ def test_migrate_restore_roundtrip_wire_identical():
     watcher.interest(a)
     a.set_client_syncing(True)
     a._set_position_yaw(Vector3(1.25, -2.5, 3.875), 42.5)
-    before = em.collect_entity_sync_infos()[1]
+    before = em.collect_entity_sync_infos()[1][0]
     eid = a.id
     data = a.get_migrate_data()
     a._destroy(is_migrate=True)
@@ -209,7 +220,7 @@ def test_migrate_restore_roundtrip_wire_identical():
     # re-entry in production) and re-flag: wire bytes must match exactly.
     watcher.interest(e2)
     e2._set_position_yaw(e2.position, e2.yaw)
-    after = em.collect_entity_sync_infos()[1]
+    after = em.collect_entity_sync_infos()[1][0]
     assert sorted(_blocks(before)) == sorted(_blocks(after))
 
 
@@ -226,7 +237,7 @@ def test_per_gate_buffers_are_client_grouped():
                 e.interest(o)
     for e in ents:
         e.set_position(Vector3(1, 0, 1))
-    buf = em.collect_entity_sync_infos()[1]
+    buf = em.collect_entity_sync_infos()[1][0]
     cids = np.frombuffer(buf, CLIENT_SYNC_BLOCK_DTYPE)["cid"]
     runs = 1 + int(np.count_nonzero(cids[1:] != cids[:-1]))
     assert runs == len(set(cids.tolist())), "client rows not contiguous"
@@ -248,7 +259,7 @@ def test_sync_selection_cache_invalidation():
             e._sync_info_flag = (
                 SIF_SYNC_OWN_CLIENT | SIF_SYNC_NEIGHBOR_CLIENTS)
         infos = em.collect_entity_sync_infos()
-        return sorted(_blocks(infos.get(1, b"")))
+        return sorted(_blocks(infos.get(1, (b"", b""))[0]))
 
     base = collect()
     assert collect() == base  # cache hit: identical
@@ -392,7 +403,7 @@ def test_on_tick_batch_view_write_sets_sync_flags():
     em.runtime.slabs.run_tick_batches()
     assert e.position.x == 6.0 and e.yaw == 90.0
     infos = em.collect_entity_sync_infos()
-    arr = np.frombuffer(infos[1], CLIENT_SYNC_BLOCK_DTYPE)
+    arr = np.frombuffer(infos[1][0], CLIENT_SYNC_BLOCK_DTYPE)
     assert arr["x"][0] == np.float32(6.0)
     assert arr["yaw"][0] == np.float32(90.0)
 

@@ -531,3 +531,56 @@ def test_fanout_multi_floor_gate():
         f"{floor_spec['tolerance']:.0%} tolerance). Runs: {result['runs']}. "
         f"See BENCH_FLOOR.json how_to_read."
     )
+
+
+def _massive_in_subprocess() -> dict:
+    """bench.py --fanout-massive in a FRESH subprocess: the harness
+    spawns 4 bot-fleet children beside an in-process cluster, and the
+    suite-churned parent interpreter both skews the measurement (same
+    reasoning as _fanout_tier1_env) and would leak registry state."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, str(_REPO / "bench.py"), "--fanout-massive"],
+        capture_output=True, text=True, timeout=900, check=True,
+        cwd=str(_REPO),
+    )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_fanout_massive_floor_gate():
+    """The thousands-of-clients adaptive-sync floor (ISSUE 14): >= 1000
+    real client sockets across >= 2 gates served by the tiered + delta
+    sync pipeline, with HARD correctness clauses — zero bot errors (the
+    fleets decode strictly: a delta before its keyframe counts), a
+    reconnect storm that re-converges the aggregated cluster view with
+    the census conserved, zero steady-state retraces, and the adaptive
+    encoding's bytes/client/s at least 3x below the full-rate/full-
+    precision equivalent measured on the SAME live cluster and movement.
+    The throughput floor itself has a wide tolerance (the number is
+    cadence-bound, not capacity-bound — the correctness clauses carry
+    the load)."""
+    floor_spec = json.loads(
+        (_REPO / "BENCH_FLOOR.json").read_text())["fanout_massive"]
+    bench = _load_bench()
+    result = _massive_in_subprocess()
+    assert result.get("error") is None, result
+    assert result["clients"] >= 1000
+    assert result["gates"] >= 2
+    assert result["bot_errors"] == 0, result.get("bot_error_samples")
+    assert result["steady_state_retraces"] == 0
+    assert result["bytes_reduction"] >= 3.0, (
+        f"adaptive sync must cut bytes/client/s >= 3x vs full-rate: "
+        f"tiered {result['bytes_per_client_s']} vs full "
+        f"{result['full_equiv_bytes_per_client_s']}")
+    storm = result["reconnect_storm"]
+    assert storm["bot_errors"] == 0, storm
+    assert storm["census_clients"] == result["clients"]
+    floor = floor_spec["floor"] * (1.0 - floor_spec["tolerance"])
+    assert result["value"] >= floor, (
+        f"fanout-massive regression: {result['value']:.0f} records/s < "
+        f"{floor:.0f} (floor {floor_spec['floor']} - "
+        f"{floor_spec['tolerance']:.0%} tolerance). "
+        f"See BENCH_FLOOR.json how_to_read."
+    )
