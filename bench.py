@@ -470,14 +470,65 @@ SHARDED_FLOOR_CONFIG = {
     "parity_ticks": 3,
 }
 
+# --sharded-backend pallas_interpret variant (ISSUE 15): the strip-local
+# Pallas kernel tier through the interpreter (the only kernel execution
+# this CPU image has), same exact-parity + zero-fallback + halo-vs-
+# allgather clauses as the jnp floor. FIXED config, never self-tuned:
+# 2048 entities over a 192-column torus (24-column uniform strips, cap
+# 48), grid_z 8 keeps the interpreted kernel's program count workable,
+# halo_cap 128 covers the ~56-row uniform bands 2x, and radius 40 (vs
+# cell 100) keeps the seam-free single-pass guard TRUE on steady drift
+# ticks so the measured path is the one-kernel-launch fast tick. The
+# structural comms ratio here is 7.9x — above the jnp tier's committed
+# 5.3x because the strips are wider relative to the fixed 6-column band
+# (ratio ~ 0.041 * grid_x at D=8). Wall-clock through the interpreter is
+# NOT a committed floor (the interpreter is orders off real kernel
+# speed); the correctness clauses and the byte ratios are the gate.
+PALLAS_SHARDED_CONFIG = {
+    "n": 2048, "cell_size": 100.0, "grid": 192, "grid_z": 8,
+    "space_slots": 1, "cell_capacity": 32, "max_events": 16384,
+    "shards": 8, "halo_cap": 128, "strip_cols": 48, "radius": 40.0,
+    "active": 1792, "steps": 8, "repeats": 1, "parity_ticks": 2,
+}
 
-def bench_sharded() -> dict:
+
+def _spatial_engine_for(c: dict, backend: str, mesh):
+    """Construct (without stepping) the spatial engine for a bench config
+    — also used to report the OTHER backend's structural bytes in each
+    headline."""
+    from goworld_tpu.ops import NeighborParams
+    from goworld_tpu.parallel.spatial import SpatialShardedNeighborEngine
+
+    params = NeighborParams(
+        capacity=c["n"], cell_size=c["cell_size"], grid_x=c["grid"],
+        grid_z=c.get("grid_z", c["grid"]), space_slots=c["space_slots"],
+        cell_capacity=c["cell_capacity"], max_events=c["max_events"],
+    )
+    return SpatialShardedNeighborEngine(
+        params, mesh, halo_cap=c["halo_cap"], prewarm_fallback=False,
+        backend=backend, strip_cols=c.get("strip_cols"),
+    )
+
+
+def bench_sharded(backend: str | None = None) -> dict:
     """``bench.py --sharded``: updates/sec of the spatially sharded AOI
     engine at the fixed config above, best-of-``repeats`` pipelined runs,
     after an exact event-set parity check against the single-device
     engine on the same trace. Gated against BENCH_FLOOR.json["sharded"]
-    by tier-1 (tests/test_telemetry.py::test_sharded_floor_gate)."""
-    c = SHARDED_FLOOR_CONFIG
+    by tier-1 (tests/test_telemetry.py::test_sharded_floor_gate).
+
+    ``--sharded-backend pallas_interpret`` (or jnp, the default) switches
+    the measured engine to the strip-local Pallas kernel tier at
+    PALLAS_SHARDED_CONFIG — same parity/zero-fallback/byte clauses; the
+    committed floor stays the jnp config's. Each headline reports BOTH
+    backends' structural halo bytes."""
+    if backend is None:
+        backend = "jnp"
+        if "--sharded-backend" in sys.argv[1:]:
+            backend = sys.argv[sys.argv.index("--sharded-backend") + 1]
+    if backend not in ("jnp", "pallas_interpret", "pallas"):
+        raise ValueError(f"unknown --sharded-backend {backend!r}")
+    c = SHARDED_FLOOR_CONFIG if backend == "jnp" else PALLAS_SHARDED_CONFIG
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         # Must land before the first jax import; --update-floor and the
@@ -498,31 +549,37 @@ def bench_sharded() -> dict:
         }
     from goworld_tpu.ops import NeighborEngine, NeighborParams
     from goworld_tpu.parallel import make_mesh
-    from goworld_tpu.parallel.spatial import SpatialShardedNeighborEngine
 
     n = c["n"]
     params = NeighborParams(
         capacity=n, cell_size=c["cell_size"], grid_x=c["grid"],
-        grid_z=c["grid"], space_slots=c["space_slots"],
+        grid_z=c.get("grid_z", c["grid"]), space_slots=c["space_slots"],
         cell_capacity=c["cell_capacity"], max_events=c["max_events"],
     )
     mesh = make_mesh(c["shards"])
     retraces0 = _steady_state_retraces()
     world = c["grid"] * c["cell_size"]
+    world_z = c.get("grid_z", c["grid"]) * c["cell_size"]
 
     def make_world():
         rng = np.random.default_rng(0)
         pos = rng.uniform(0, world, (n, 2)).astype(np.float32)
+        pos[:, 1] %= world_z
         active = np.zeros(n, bool)
         active[:c["active"]] = True
         space = np.zeros(n, np.int32)
-        radius = np.full(n, 100.0, np.float32)
+        radius = np.full(n, c.get("radius", 100.0), np.float32)
         vel = rng.normal(0, 3.0, (n, 2)).astype(np.float32)
         return pos, active, space, radius, vel
 
-    eng = SpatialShardedNeighborEngine(
-        params, mesh, halo_cap=c["halo_cap"], prewarm_fallback=False
-    )
+    eng = _spatial_engine_for(c, backend, mesh)
+    # The OTHER backend's structural bytes at ITS fixed config, so one
+    # headline carries the whole comms story (no stepping — the numbers
+    # are structural per-tick payloads).
+    other_backend = "pallas_interpret" if backend == "jnp" else "jnp"
+    other_cfg = (PALLAS_SHARDED_CONFIG if backend == "jnp"
+                 else SHARDED_FLOOR_CONFIG)
+    other = _spatial_engine_for(other_cfg, other_backend, mesh)
 
     # Exact event-set parity on the measured trace (the floor's honesty
     # clause: the fast number must be the CORRECT number).
@@ -545,9 +602,11 @@ def bench_sharded() -> dict:
     runs = []
     fallback_ticks = 0
     migrations = 0
+    fast_ticks = 0
     for _rep in range(c["repeats"]):
         eng.reset()
         fb0, mg0 = eng.total_fallbacks, eng.total_migrations
+        ft0 = eng.total_fast_ticks
         pos, active, space, radius, vel = make_world()
         eng.step(pos, active, space, radius)  # enter storm
         pending = None
@@ -564,6 +623,7 @@ def bench_sharded() -> dict:
         runs.append(c["steps"] / (time.perf_counter() - t0) * n)
         fallback_ticks += eng.total_fallbacks - fb0
         migrations += eng.total_migrations - mg0
+        fast_ticks += eng.total_fast_ticks - ft0
     return {
         "metric": "sharded_updates_per_sec",
         "value": round(max(runs), 1),
@@ -572,12 +632,15 @@ def bench_sharded() -> dict:
         "config": dict(c),
         "mesh": f"1x{c['shards']}",
         "mesh_devices": c["shards"],
-        "backend": "cpu(jnp,forced-mesh)",
+        "backend": f"cpu({backend},forced-mesh)",
+        "shard_backend": backend,
         "shard_mode": "spatial",
         "platform": "cpu",
         "parity_with_single_device": parity,
         # The comms story, structurally: what the halo exchange moves per
-        # tick vs what the all-gather formulation would move.
+        # tick vs what the all-gather formulation would move — for the
+        # MEASURED backend, with the other backend's structural numbers
+        # at its own fixed config alongside (both tiers in one headline).
         "halo_bytes_per_tick": eng.halo_bytes_per_tick,
         "allgather_equiv_bytes_per_tick": eng.allgather_bytes_per_tick,
         "halo_smaller_than_allgather":
@@ -585,8 +648,20 @@ def bench_sharded() -> dict:
         "comms_reduction": round(
             eng.allgather_bytes_per_tick / max(1, eng.halo_bytes_per_tick),
             2),
+        f"{other_backend.split('_')[0]}_halo_bytes_per_tick":
+            other.halo_bytes_per_tick,
+        f"{other_backend.split('_')[0]}_allgather_equiv_bytes_per_tick":
+            other.allgather_bytes_per_tick,
+        f"{other_backend.split('_')[0]}_comms_reduction": round(
+            other.allgather_bytes_per_tick
+            / max(1, other.halo_bytes_per_tick), 2),
         "fallback_ticks": fallback_ticks,
         "shard_migrations": migrations,
+        # Seam-free single-pass ticks (collected steady-state ticks whose
+        # replicated guard held — the pallas variant's radius-40 config
+        # keeps it true on drift; the jnp floor's radius==cell_size
+        # deliberately keeps the committed trace on the two-pass path).
+        "fast_ticks": fast_ticks,
         "steady_state_retraces": _steady_state_retraces() - retraces0,
         "floor_file": PINNED_FLOOR_FILE,
     }

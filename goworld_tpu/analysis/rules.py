@@ -467,6 +467,13 @@ HOT_PATHS: dict[str, set[str]] = {
     },
     "goworld_tpu/parallel/spatial.py": {
         "_spatial_step_fused_impl",
+        # Pallas strip tier (ISSUE 15): the strip-local step/drain bodies
+        # and the replicated seam-free guard run every spatial tick —
+        # loop-free jnp by design (the ring-permutation comprehension
+        # lives in _exchange_halo, O(devices) like the pre-existing
+        # _spatial_step_impl, outside the guarded set).
+        "_spatial_step_pallas_impl", "_spatial_step_pallas_fused_impl",
+        "_spatial_drain_bits", "_build_table_strip", "_fast_guard_strip",
     },
     "goworld_tpu/parallel/mesh.py": {
         "_sharded_step_fused",

@@ -178,9 +178,19 @@ class AOIConfig:
     mesh_shards: int = 1  # device shards of the batched engine's mesh
     # How mesh_shards > 1 splits the work: "spatial" shards the AOI grid
     # into column strips with halo exchange (O(boundary) comms,
-    # parallel/spatial.py); "entity" shards entity rows with a full
-    # all-gather per tick (parallel/mesh.py — the Pallas-kernel tier).
+    # parallel/spatial.py; on TPU the strip-local Pallas kernel tier);
+    # "entity" shards entity rows with a full all-gather per tick
+    # (parallel/mesh.py).
     shard_mode: str = "spatial"  # spatial | entity
+    # Strip→device placement of the spatial tier: "topology" reorders the
+    # mesh from device coords so ring-adjacent strips land on
+    # interconnect-adjacent chips (AoiZora-style; identity on rigs
+    # without coords), "ring" keeps the mesh order as given.
+    strip_placement: str = "topology"  # topology | ring
+    # Static strip-width cap (columns) of the Pallas spatial tier's
+    # kernel slab. 0 = derive (2x the uniform strip width, clamped to
+    # planner feasibility). Ignored by the jnp spatial backend.
+    pallas_strip_cols: int = 0
     # Grid geometry (0 = derive from max_entities; see params_from_config).
     grid: int = 0  # cells per side (grid_x = grid_z)
     cell_size: float = 0.0  # cell side length; must be >= max AOI distance
@@ -522,6 +532,9 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             max_entities=int(s.get("max_entities", 16384)),
             mesh_shards=int(s.get("mesh_shards", 1)),
             shard_mode=s.get("shard_mode", "spatial").strip().lower(),
+            strip_placement=s.get(
+                "strip_placement", "topology").strip().lower(),
+            pallas_strip_cols=int(s.get("pallas_strip_cols", 0)),
             compilation_cache=s.get("compilation_cache", "auto").strip(),
             grid=int(s.get("grid", 0)),
             cell_size=float(s.get("cell_size", 0.0)),
@@ -642,6 +655,16 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[aoi] mesh_shards must be >= 1")
     if a.shard_mode not in ("spatial", "entity"):
         raise ValueError("[aoi] shard_mode must be spatial or entity")
+    if a.strip_placement not in ("topology", "ring"):
+        raise ValueError(
+            f"[aoi] strip_placement must be topology or ring, "
+            f"got {a.strip_placement!r}"
+        )
+    if a.pallas_strip_cols < 0:
+        # Negative would silently disable the width cap the Pallas slab's
+        # static extent depends on — reject loudly (0 = derive).
+        raise ValueError(
+            "[aoi] pallas_strip_cols must be >= 0 (0 = derive)")
     if not a.compilation_cache:
         raise ValueError(
             "[aoi] compilation_cache must be auto, off, or a directory")

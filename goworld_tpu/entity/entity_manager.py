@@ -64,6 +64,12 @@ class Runtime:
         # [aoi] shard_mode: spatial (grid-strip halo exchange) | entity
         # (all-gather rows); only read when mesh_shards > 1.
         self.aoi_shard_mode: str = "spatial"
+        # [aoi] strip_placement: topology (AoiZora-style strip→device
+        # placement from mesh coords) | ring (mesh order as given).
+        self.aoi_strip_placement: str = "topology"
+        # [aoi] pallas_strip_cols: static strip-width cap of the Pallas
+        # spatial tier's kernel slab (0 = derive: 2x the uniform strip).
+        self.aoi_pallas_strip_cols: int = 0
         # Multi-HOST (DCN) tier: True once this process has joined the
         # jax.distributed mesh ([aoi] multihost_coordinator; the game
         # service calls init_multihost before any jax use).
@@ -101,6 +107,8 @@ class Runtime:
                 multihost=self.aoi_multihost,
                 shard_mode=self.aoi_shard_mode,
                 fuse_logic=self.aoi_fuse_logic,
+                strip_placement=self.aoi_strip_placement,
+                pallas_strip_cols=self.aoi_pallas_strip_cols,
             )
             self.aoi_service.delivery = self.aoi_delivery
             self.aoi_service.sync_wait_budget = self.aoi_sync_wait_budget

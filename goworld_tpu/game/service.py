@@ -164,6 +164,8 @@ class GameService:
         # source of truth; tests may pre-seed rt.aoi_params to override).
         rt.aoi_mesh_shards = max(1, self.cfg.aoi.mesh_shards)
         rt.aoi_shard_mode = self.cfg.aoi.shard_mode
+        rt.aoi_strip_placement = self.cfg.aoi.strip_placement
+        rt.aoi_pallas_strip_cols = self.cfg.aoi.pallas_strip_cols
         rt.aoi_delivery = self.cfg.aoi.delivery
         rt.aoi_sync_wait_budget = self.cfg.aoi.sync_wait_budget
         rt.aoi_fuse_logic = self.cfg.aoi.fuse_logic

@@ -455,7 +455,8 @@ def _step_packed_jnp(p: NeighborParams, ppos, pact, pspc, prad, pos, act, spc, r
 # --- Pallas path -------------------------------------------------------------
 
 
-def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b):
+def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b,
+                   gx_ext: int | None = None):
     """Build the dense cell feature layout with ONE row-vector scatter.
 
     ``order``/``dst`` come from _build_table: sorted entity order and each
@@ -468,8 +469,15 @@ def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b):
     feats_a = (x, z, space, radius) of the epoch the grid is binned by;
     feats_b = the same four for the other epoch. Returns
     f32[space_slots, gz+2, gx+2, F, LANES] with a torus halo ring.
+
+    ``gx_ext`` generalizes the x extent to a STRIP-LOCAL slab
+    (parallel/spatial.py's Pallas tier): the extent already INCLUDES its
+    ghost columns — real entities exchanged from the neighbor strips live
+    there, so only z gets the torus wrap pad and x gets none. None keeps
+    the full-torus layout (both dims wrap-padded).
     """
-    table_size = p.num_buckets * LANES
+    gxe = p.grid_x if gx_ext is None else gx_ext
+    table_size = p.space_slots * p.grid_z * gxe * LANES
     vals = jnp.stack(
         [f.astype(jnp.float32) for f in feats_a]
         + [f.astype(jnp.float32) for f in feats_b],
@@ -477,10 +485,12 @@ def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b):
     )  # [N, F]
     flat = jnp.full((table_size, _F), jnp.nan, jnp.float32)
     flat = flat.at[dst].set(vals[order], mode="drop")
-    cells = flat.reshape(p.space_slots, p.grid_z, p.grid_x, LANES, _F)
-    cells = cells.transpose(0, 1, 2, 4, 3)  # [S, gz, gx, F, LANES]
-    # Torus halo ring per space slab (spatial dims only).
-    return jnp.pad(cells, ((0, 0), (1, 1), (1, 1), (0, 0), (0, 0)), mode="wrap")
+    cells = flat.reshape(p.space_slots, p.grid_z, gxe, LANES, _F)
+    cells = cells.transpose(0, 1, 2, 4, 3)  # [S, gz, gxe, F, LANES]
+    # Halo ring per space slab: torus wrap on z always; on x only for the
+    # full-torus layout (a strip slab's x halo holds real ghost rows).
+    pad_x = (1, 1) if gx_ext is None else (0, 0)
+    return jnp.pad(cells, ((0, 0), (1, 1), pad_x, (0, 0), (0, 0)), mode="wrap")
 
 
 def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
@@ -592,21 +602,27 @@ def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
 
 @functools.lru_cache(maxsize=None)
 def _compiled_event_kernel(p: NeighborParams, interpret: bool,
-                           rows: int | None = None, dual: bool = False):
+                           rows: int | None = None, dual: bool = False,
+                           cols: int | None = None):
     """``rows`` limits the kernel to a slab of grid rows (cells input is then
     the slab plus its 2 halo rows): the sharded engine launches one slab per
-    device (parallel/mesh.py). ``dual`` emits enter+leave masks in one launch
-    (words [0, W) enter, [W, 2W) leave)."""
+    device (parallel/mesh.py). ``cols`` limits it to a slab of grid COLUMNS
+    the same way — the spatially sharded Pallas tier launches one strip-
+    local column slab per device (parallel/spatial.py); the kernel body is
+    row/column symmetric, so both ride the same program. ``dual`` emits
+    enter+leave masks in one launch (words [0, W) enter, [W, 2W) leave)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if rows is None:
         rows = p.grid_z
+    if cols is None:
+        cols = p.grid_x
     w_words = (9 * LANES // _PACK) * (2 if dual else 1)
     kernel = functools.partial(_event_kernel, p, dual)
     return pl.pallas_call(
         kernel,
-        grid=(p.space_slots, rows, p.grid_x),
+        grid=(p.space_slots, rows, cols),
         in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(
             (1, 1, 1, LANES, w_words),
@@ -614,7 +630,7 @@ def _compiled_event_kernel(p: NeighborParams, interpret: bool,
             memory_space=pltpu.VMEM,
         ),
         out_shape=jax.ShapeDtypeStruct(
-            (p.space_slots, rows, p.grid_x, LANES, w_words), jnp.int32
+            (p.space_slots, rows, cols, LANES, w_words), jnp.int32
         ),
         scratch_shapes=[
             pltpu.VMEM((2, 3, 3, _F, LANES), jnp.float32),
@@ -631,6 +647,8 @@ def _drain_bits(
     table: jax.Array,  # i32[num_buckets * LANES] id table of the pass's grid
     start_flat: jax.Array,  # EVENT RANK to resume from (name kept for the
     max_events: int | None = None,  # shared pager call signature)
+    gx_ext: int | None = None,  # strip-local x extent (parallel/spatial.py)
+    wrap_x: bool = True,  # False: x is a strip slab, halo cols are physical
 ):
     """Pallas-path drain: extract the (entity, other) pairs for event RANKS
     [start_rank, start_rank + max_events) out of the packed bit mask.
@@ -646,11 +664,22 @@ def _drain_bits(
     Candidate c of entity i maps to halo cell c // LANES (row-major 3x3) and
     lane c % LANES. Returns (pairs i32[max_events, 2], row_counts' total) —
     paging resumes at start_rank + max_events.
+
+    ``gx_ext``/``wrap_x`` generalize the candidate-cell arithmetic to a
+    STRIP-LOCAL slab (parallel/spatial.py): ``cx`` is then the local slab
+    column, the bucket space is ``space_slots * grid_z * gx_ext``, and x
+    offsets index physical ghost columns instead of wrapping the torus
+    (every own query's 3x3 block is inside the slab by the strip
+    ownership invariant, so no x clamp is needed). ``packed_e`` may hold
+    fewer rows than ``capacity`` there (own rows only); the pair's entity
+    side is then a ROW index the caller maps to a slot.
     """
     if max_events is None:
         max_events = p.max_events
     start_rank = start_flat
     n = p.capacity
+    n_rows = packed_e.shape[0]
+    gxl = p.grid_x if gx_ext is None else gx_ext
     pc = jax.lax.population_count(packed_e)  # [N, W]
     row_counts = jnp.sum(pc, axis=1)  # [N]
     row_cum = jnp.cumsum(row_counts)  # inclusive
@@ -677,15 +706,15 @@ def _drain_bits(
         )
         seed = jnp.full((max_events,), -1, jnp.int32)
         seed = seed.at[target].max(
-            jnp.arange(n, dtype=jnp.int32), mode="drop"
+            jnp.arange(n_rows, dtype=jnp.int32), mode="drop"
         )
-        row = jnp.clip(jax.lax.cummax(seed), 0, n - 1)
+        row = jnp.clip(jax.lax.cummax(seed), 0, n_rows - 1)
     else:
         row = (
             jnp.searchsorted(row_starts, j, side="right").astype(jnp.int32)
             - 1
         )
-        row = jnp.clip(row, 0, n - 1)
+        row = jnp.clip(row, 0, n_rows - 1)
     k = j - row_starts[row]  # event rank within its row
 
     # Word selection by binary search over the row's inclusive word-count
@@ -753,8 +782,11 @@ def _drain_bits(
     dzo = hc // 3 - 1
     dxo = hc % 3 - 1
     czz = jnp.mod(cz[row] + dzo, p.grid_z)
-    cxx = jnp.mod(cx[row] + dxo, p.grid_x)
-    bucket = (sm[row] * p.grid_z + czz) * p.grid_x + cxx
+    if wrap_x:
+        cxx = jnp.mod(cx[row] + dxo, gxl)
+    else:
+        cxx = cx[row] + dxo  # strip slab: ghost columns are physical
+    bucket = (sm[row] * p.grid_z + czz) * gxl + cxx
     other = table[bucket * LANES + lane]
     ent = jnp.where(valid, row, n)
     other = jnp.where(valid, other, n)

@@ -58,6 +58,26 @@ from goworld_tpu.ops.neighbor import (
 
 SHARD_AXIS = "shard"
 
+from goworld_tpu import telemetry  # noqa: E402  (after SHARD_AXIS constant)
+
+# Transfer accounting for the all-gather tiers (ISSUE 15 satellite): what
+# one entity-sharded tick structurally moves between devices — every
+# other shard's rows, both epochs — live beside the spatial tier's halo
+# gauges so the comms story is comparable on /metrics, /cluster and
+# gwtop. Module-scope registration (gwlint R5); same family the spatial
+# engine's fallback ticks account into.
+_M_ALLGATHER_EQUIV = telemetry.gauge(
+    "aoi_allgather_equiv_bytes_per_tick",
+    "What the all-gather formulation moves per tick at this tier (every "
+    "other shard's rows, both epochs, on D devices).",
+)
+_M_ALLGATHER_TOTAL = telemetry.counter(
+    "aoi_allgather_bytes_total",
+    "Bytes moved between shards by all-gather AOI ticks (the entity-"
+    "sharded tier every tick; the spatial tier only on exact-fallback "
+    "ticks).",
+)
+
 
 def make_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
     """Build a 1-D mesh over the entity-shard axis.
@@ -437,7 +457,7 @@ class ShardedPendingStep:
     stacked per-shard packed buffers, then (rare) storm paging."""
 
     __slots__ = ("_engine", "_enter_ctx", "_leave_ctx", "_out", "_collected",
-                 "fused")
+                 "fused", "rank_paging")
 
     def __init__(self, engine, enter_ctx, leave_ctx, out) -> None:
         self._engine = engine
@@ -448,6 +468,12 @@ class ShardedPendingStep:
         # Fused-tick payload (same contract as PendingStep.fused): set by
         # the dispatching engine when the launch carried entity logic.
         self.fused = None
+        # Paging cursor semantics of THIS tick's program: rank-based
+        # (pallas bit drains) vs flat-index (jnp id drains). Engine-level
+        # default; the spatial engine overrides per dispatch — its
+        # pallas-backend SPATIAL ticks page by rank while its jnp
+        # all-gather FALLBACK ticks page by flat index.
+        self.rank_paging = engine.backend != "jnp"
         start_host_copy(out)
 
     def is_ready(self) -> bool:
@@ -478,7 +504,7 @@ class ShardedPendingStep:
         enter_starts = np.zeros(nd, np.int32)
         leave_starts = np.zeros(nd, np.int32)
         dropped = 0
-        rank_paging = eng.backend != "jnp"
+        rank_paging = self.rank_paging
         for d in range(nd):
             o = out[d * block:(d + 1) * block]
             n_e, n_l = int(o[0, 0]), int(o[0, 1])
@@ -497,6 +523,11 @@ class ShardedPendingStep:
         if leave_deficit.any():
             leaves += eng._page(self._leave_ctx, leave_deficit, leave_starts)
         eng.last_grid_dropped = dropped
+        # Header flags (out[1, 1], replicated): the spatial engines report
+        # the seam-free fast-tick bit there; other programs write 0.
+        note = getattr(eng, "_note_step_flags", None)
+        if note is not None:
+            note(int(out[1, 1]))
         return (
             np.concatenate(enters) if enters else np.empty((0, 2), np.int32),
             np.concatenate(leaves) if leaves else np.empty((0, 2), np.int32),
@@ -542,6 +573,12 @@ class ShardedNeighborEngine:
         self.chunk = params.capacity // n_dev
         # Inline budget per shard; total inline capacity stays max_events.
         self.events_inline = params.max_events // n_dev
+        # Structural comms of one tick: every other shard's rows, both
+        # epochs (pos 8B + act 1B + spc 4B + rad 4B each), on D devices.
+        self.allgather_bytes_per_tick = (
+            n_dev * (params.capacity - self.chunk) * 34
+        )
+        _M_ALLGATHER_EQUIV.set(self.allgather_bytes_per_tick)
         if backend == "jnp":
             self._jit_step = _jitted_sharded_step(
                 params, mesh, self.events_inline
@@ -646,6 +683,7 @@ class ShardedNeighborEngine:
             res = self._jit_step(*self._state, *cur)
             enter_ctx, leave_ctx, out = res[0:5], res[5:10], res[10]
         self._state = cur
+        _M_ALLGATHER_TOTAL.inc(self.allgather_bytes_per_tick)
         return ShardedPendingStep(self, enter_ctx, leave_ctx, out)
 
     def step(

@@ -53,6 +53,8 @@ from goworld_tpu.ops.neighbor import (
 )
 from goworld_tpu.parallel.mesh import (
     SHARD_AXIS,
+    _M_ALLGATHER_EQUIV,
+    _M_ALLGATHER_TOTAL,
     _jitted_sharded_drain,
     _jitted_sharded_drain_bits,
     _jitted_sharded_step,
@@ -212,6 +214,17 @@ class MultiHostNeighborEngine:
         self.n_devices = n_dev
         self.chunk = params.capacity // n_dev
         self.events_inline = params.max_events // n_dev
+        # Transfer accounting (ISSUE 15 satellite): the DCN tier pays the
+        # same structural all-gather as the single-host entity tier —
+        # rode ICI within a host, DCN between hosts. Live on /metrics so
+        # the pod-scale comms story is visible beside the spatial tier's
+        # halo gauges. The strip+halo Pallas path stays single-controller
+        # (parallel/spatial.py owns the whole slot space host-side); its
+        # pallas kernels here still ride the shared slab-kernel builders.
+        self.allgather_bytes_per_tick = (
+            n_dev * (params.capacity - self.chunk) * 34
+        )
+        _M_ALLGATHER_EQUIV.set(self.allgather_bytes_per_tick)
         if backend == "jnp":
             self._jit_step = _jitted_sharded_step(
                 params, mesh, self.events_inline
@@ -313,6 +326,7 @@ class MultiHostNeighborEngine:
             res = self._jit_step(*self._state, *cur)
             enter_ctx, leave_ctx, out = res[0:5], res[5:10], res[10]
         self._state = cur
+        _M_ALLGATHER_TOTAL.inc(self.allgather_bytes_per_tick)
         return MultiHostPendingStep(self, enter_ctx, leave_ctx, out)
 
     def step(self, pos, active, space, radius):

@@ -47,9 +47,31 @@ Same host interface as the other engines: ``step_async`` returns a
 pending with ONE blocking packed readback in ``collect()``, storm paging
 beyond the per-shard inline budget, and the ``meta_dirty=False`` upload
 elision (which additionally requires an unchanged row permutation here).
-jnp backend only: the Pallas grid-slab kernel path already shards the
-kernel grid spatially (mesh.py) — this engine is the comms-side analog
-for the all-gather-bound jnp tier.
+
+Two device backends share the halo layout (ISSUE 15):
+
+- **jnp** — strip-local candidate-matrix math (the original tier).
+- **pallas / pallas_interpret** — the strip-local KERNEL slab: each
+  device scatters its own+ghost rows into a
+  ``[space_slots, gz+2, strip_cols+4, F, LANES]`` dense cell layout and
+  launches the dual-mask event kernel there, so the kernel grid, the
+  table build/sort, and the event drain are all strip-local — the
+  all-gather + replicated grid rebuild of mesh._sharded_step_pallas
+  never happens on this path (see the "Pallas strip tier" section
+  below). Fallback ticks run the exact jnp all-gather program on either
+  backend.
+
+Both backends take the seam-free single-pass fast tick: a replicated
+guard (per-shard scalars pmax/psum-reduced — ops/neighbor._fast_guard's
+eligibility) lets steady-state ticks compute the leave diff on the
+CURRENT grid — one combined pass / one dual-output kernel launch —
+halving the per-tick candidate math; guard outcomes ride the packed
+header as ``last_fast_tick`` / ``aoi_spatial_fast_ticks_total``.
+
+Strip→device placement is topology-aware (AoiZora, PAPERS.md): strips
+are ring-ordered by construction, so ``plan_placement`` orders the mesh
+devices along a coordinate snake and ring-adjacent strips land on
+interconnect-adjacent chips; rigs without device coords keep ring order.
 """
 
 from __future__ import annotations
@@ -63,15 +85,22 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from goworld_tpu import telemetry
 from goworld_tpu.ops.neighbor import (
+    LANES,
+    _PACK,
     NeighborParams,
     _apply_fused_logic,
     _bins,
+    _compiled_event_kernel,
+    _drain_bits,
     _drain_ids,
     _gather_cands,
     _pair_valid,
+    _scatter_feats,
     bins_reference,
     check_radius,
+    check_space_ids,
     sorted_ranks_by,
 )
 from goworld_tpu.parallel.compat import resolve_shard_map
@@ -82,6 +111,26 @@ from goworld_tpu.parallel.mesh import (
     _jitted_sharded_drain,
     _jitted_sharded_step,
     _jitted_sharded_step_fused,
+)
+
+# Seam-free single-pass ticks (ISSUE 15): steady-state ticks whose
+# replicated guard held, so the leave diff rode the CURRENT grid — one
+# combined pass (jnp) / one dual-output kernel launch (pallas) instead of
+# two grid passes. Module-scope registration (gwlint R5).
+_M_FAST_TICKS = telemetry.counter(
+    "aoi_spatial_fast_ticks_total",
+    "Spatial-engine ticks served by the seam-free single-pass fast path "
+    "(replicated displacement guard held; leave diff rode the current "
+    "grid).",
+)
+# Topology-aware strip→device placement (AoiZora, PAPERS.md): total
+# interconnect distance (manhattan over device coords) of the strip ring,
+# for the adopted placement vs the naive mesh order it replaced.
+_M_RING_DISTANCE = telemetry.gauge(
+    "aoi_strip_ring_distance",
+    "Sum of interconnect (manhattan coord) distances between ring-adjacent "
+    "strip devices, per placement order.",
+    ("order",),
 )
 
 # Halo feature-block bytes per exchanged row: f32 (px, pz, x, z) + i32
@@ -119,21 +168,20 @@ def _build_table_spatial(p: NeighborParams, bucket, active, slots, chunk):
     return table, in_table, own_dropped
 
 
-def _spatial_step_impl(
-    p: NeighborParams,
-    events_inline: int,
-    halo_cap: int,
-    n_dev: int,
+def _exchange_halo(
+    p: NeighborParams, n_dev: int,
     ppos_l, pact_l, pspc_l, prad_l,
     pos_l, act_l, spc_l, rad_l,
-    slot_l,
-    send_lo_idx,
-    send_hi_idx,
+    slot_l, send_lo_idx, send_hi_idx,
 ):
+    """The halo ``ppermute``: pack both seam bands, exchange with the two
+    ring neighbors, and return the combined own+ghost feature arrays
+    ([chunk + 2h] rows, own rows first). Shared by the jnp and Pallas
+    spatial step bodies — the exchanged bytes are identical on both tiers
+    (radius does not travel; ghost queries are never extracted, so their
+    radius rows may be zero)."""
     n = p.capacity
     chunk = pos_l.shape[0]
-    h = halo_cap
-    n_all = chunk + 2 * h
 
     def pack_band(idx):
         safe = jnp.minimum(idx, chunk - 1)
@@ -176,14 +224,70 @@ def _spatial_step_impl(
     gr_ppos, gr_pos, gr_pspc, gr_spc, gr_slot, gr_pact, gr_act = unpack(
         from_right
     )
+    h = gl_pos.shape[0]
+    zeros_h = jnp.zeros((h,), jnp.float32)
+    return (
+        jnp.concatenate([pos_l, gl_pos, gr_pos], axis=0),
+        jnp.concatenate([ppos_l, gl_ppos, gr_ppos], axis=0),
+        jnp.concatenate([act_l, gl_act, gr_act]),
+        jnp.concatenate([pact_l, gl_pact, gr_pact]),
+        jnp.concatenate([spc_l, gl_spc, gr_spc]),
+        jnp.concatenate([pspc_l, gl_pspc, gr_pspc]),
+        jnp.concatenate([slot_l, gl_slot, gr_slot]),
+        jnp.concatenate([rad_l, zeros_h, zeros_h]),
+        jnp.concatenate([prad_l, zeros_h, zeros_h]),
+    )
 
-    pos_all = jnp.concatenate([pos_l, gl_pos, gr_pos], axis=0)
-    ppos_all = jnp.concatenate([ppos_l, gl_ppos, gr_ppos], axis=0)
-    act_all = jnp.concatenate([act_l, gl_act, gr_act])
-    pact_all = jnp.concatenate([pact_l, gl_pact, gr_pact])
-    spc_all = jnp.concatenate([spc_l, gl_spc, gr_spc])
-    pspc_all = jnp.concatenate([pspc_l, gl_pspc, gr_pspc])
-    slot_all = jnp.concatenate([slot_l, gl_slot, gr_slot])
+
+def _fast_guard_strip(p: NeighborParams, ppos_l, pact_l, pspc_l, prad_l,
+                      pos_l, act_l, spc_l, dropped_total):
+    """The seam-free single-pass guard, replicated across strips: the same
+    eligibility as ops/neighbor._fast_guard (no deactivation, no space
+    change, zero capacity drops, displacement small enough that every pair
+    valid in EITHER epoch sits inside the CURRENT grid's 3x3 halo), with
+    the per-shard scalars reduced over the mesh so the ``cond`` resolves
+    identically on every shard. Own rows partition the slot space, so the
+    local reductions cover every entity exactly once."""
+    both = pact_l & act_l
+    deact = jnp.any(pact_l & ~act_l).astype(jnp.int32)
+    spchg = jnp.any(both & (pspc_l != spc_l)).astype(jnp.int32)
+    disp2 = jnp.max(
+        jnp.where(both, jnp.sum((pos_l - ppos_l) ** 2, axis=1), 0.0)
+    )
+    prad_max = jnp.max(jnp.where(pact_l, prad_l, 0.0))
+    deact_g = jax.lax.pmax(deact, SHARD_AXIS) > 0
+    spchg_g = jax.lax.pmax(spchg, SHARD_AXIS) > 0
+    disp_g = jnp.sqrt(jax.lax.pmax(disp2, SHARD_AXIS))
+    prad_g = jax.lax.pmax(prad_max, SHARD_AXIS)
+    return (
+        (~deact_g)
+        & (~spchg_g)
+        & (dropped_total == 0)
+        & (2.0 * disp_g + prad_g <= p.cell_size)
+    )
+
+
+def _spatial_step_impl(
+    p: NeighborParams,
+    events_inline: int,
+    halo_cap: int,
+    n_dev: int,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    slot_l,
+    send_lo_idx,
+    send_hi_idx,
+):
+    n = p.capacity
+    chunk = pos_l.shape[0]
+    h = halo_cap
+    n_all = chunk + 2 * h
+
+    (pos_all, ppos_all, act_all, pact_all, spc_all, pspc_all, slot_all,
+     _, _) = _exchange_halo(
+        p, n_dev, ppos_l, pact_l, pspc_l, prad_l,
+        pos_l, act_l, spc_l, rad_l, slot_l, send_lo_idx, send_hi_idx,
+    )
 
     cxc, czc, smc = _bins(p, pos_all, spc_all)
     cxp, czp, smp = _bins(p, ppos_all, pspc_all)
@@ -197,6 +301,7 @@ def _spatial_step_impl(
     table_p, av_p, _ = _build_table_spatial(
         p, buc_p, pact_all, slot_all, chunk
     )
+    dropped_total = jax.lax.psum(own_drop, SHARD_AXIS).astype(jnp.int32)
 
     q_iota = jnp.arange(chunk, dtype=jnp.int32)
 
@@ -224,24 +329,39 @@ def _spatial_step_impl(
                     ppos_all, av_p, pspc_all)
     enter_mask = vc & ~vp_on_c
 
-    # Leave pass on the previous grid. (No single-launch fast path here:
-    # both builds are strip-local already, so the second table costs a
-    # chunk+2h sort, not the all-gather path's replicated N-key sort.)
-    cand_p = _gather_cands(p, table_p, cxp[:chunk], czp[:chunk], smp[:chunk])
-    vp = emask(cand_p, ppos_l, av_p[:chunk], pspc_l, prad_l,
-               ppos_all, av_p, pspc_all)
-    vc_on_p = emask(cand_p, pos_l, av_c[:chunk], spc_l, rad_l,
-                    pos_all, av_c, spc_all)
-    leave_mask = vp & ~vc_on_p
+    # Leave pass: seam-free single-pass fast path (ISSUE 15) when the
+    # replicated guard holds — the leave mask is vp_on_c & ~vc over the
+    # already-gathered current candidates, skipping the previous grid's
+    # candidate gather and both epoch-mask passes (the engine's dominant
+    # per-tick FLOPs; both table SORTS stay, av_p feeds vp_on_c). Other
+    # ticks pay the full previous-grid pass.
+    fast = _fast_guard_strip(
+        p, ppos_l, pact_l, pspc_l, prad_l, pos_l, act_l, spc_l,
+        dropped_total,
+    )
+
+    def fast_fn():
+        return vp_on_c & ~vc, cand_c
+
+    def slow_fn():
+        cand_p = _gather_cands(
+            p, table_p, cxp[:chunk], czp[:chunk], smp[:chunk]
+        )
+        vp = emask(cand_p, ppos_l, av_p[:chunk], pspc_l, prad_l,
+                   ppos_all, av_p, pspc_all)
+        vc_on_p = emask(cand_p, pos_l, av_c[:chunk], spc_l, rad_l,
+                        pos_all, av_c, spc_all)
+        return vp & ~vc_on_p, cand_p
+
+    leave_mask, cand_l = jax.lax.cond(fast, fast_fn, slow_fn)
 
     def slot_of(cand):
         return slot_all[jnp.minimum(cand, n_all - 1)]
 
     enter_ids = jnp.where(enter_mask, slot_of(cand_c), n)
-    leave_ids = jnp.where(leave_mask, slot_of(cand_p), n)
+    leave_ids = jnp.where(leave_mask, slot_of(cand_l), n)
     n_enters = jnp.sum(enter_mask).astype(jnp.int32)
     n_leaves = jnp.sum(leave_mask).astype(jnp.int32)
-    dropped_total = jax.lax.psum(own_drop, SHARD_AXIS).astype(jnp.int32)
 
     ep, ei = _drain_ids(enter_ids, n, events_inline, jnp.int32(0))
     lp, li = _drain_ids(leave_ids, n, events_inline, jnp.int32(0))
@@ -256,7 +376,7 @@ def _spatial_step_impl(
     header = jnp.stack(
         [
             jnp.stack([n_enters, n_leaves]),
-            jnp.stack([dropped_total, jnp.int32(0)]),
+            jnp.stack([dropped_total, fast.astype(jnp.int32)]),
             jnp.stack([ei[events_inline - 1], li[events_inline - 1]]),
         ]
     ).astype(jnp.int32)
@@ -371,8 +491,318 @@ def _jitted_spatial_drain(
     return sentinel.SentinelJit("spatial_drain", jax.jit(mapped))
 
 
+# --- Pallas strip tier (ISSUE 15) --------------------------------------------
+#
+# The kernel-tier analog of the jnp halo exchange above: each device
+# builds a STRIP-LOCAL dense cell slab over its own+ghost rows and feeds
+# the existing dual-mask event kernel (ops/neighbor._event_kernel) a
+# [space_slots, gz+2, cols_cap+4, F, LANES] layout instead of a slice of
+# a replicated full-torus grid — the kernel grid, the table build/sort,
+# and the event drain are all strip-local, and the only cross-device
+# traffic is the same seam-band ppermute the jnp tier moves. Column
+# geometry per shard (w = this strip's width, all offsets mod grid_x):
+#
+#   world column:  lo-2  lo-1  lo ... hi-1   hi   hi+1
+#   local column:    0     1    2 ...  w+1   w+2   w+3      (lx)
+#   role:          ghost  QUERY ...... QUERY QUERY ghost
+#
+# Own rows may sit one column outside the strip (the hysteresis slack),
+# so query columns span [lo-1, hi] and candidate columns [lo-2, hi+1] —
+# exactly the 3-column seam bands the halo exchange already ships. The
+# slab's x extent is the STATIC cols_cap + 4 (cols_cap caps strip width;
+# plan_strips enforces it), z keeps the torus wrap; columns past this
+# strip's dynamic width are NaN cells the kernel skims through. Ghost
+# rows appear as un-extracted queries; far ghost columns (a ghost's other
+# epoch far from the seam) fall outside every own query's 3x3 block, and
+# any pair they could carry is > cell_size apart — excluded exactly.
+
+
+def _build_table_strip(
+    p: NeighborParams, bucket, active, slots, num_buckets, chunk
+):
+    """Strip-local LANES-stride table for the kernel slab. Like
+    _build_table_spatial, capacity ties break by SLOT id (seam cells exist
+    as copies on two shards — the drop set must be identical everywhere
+    and identical to the single-device engine's). Table values are SLOT
+    ids (sentinel N) so the bit drain emits pairs directly; ``tpos`` is
+    each combined row's flat table position (-1 = dropped/absent), whose
+    % LANES is the row's kernel lane. Returns
+    (table, tpos, own_dropped, order, dst)."""
+    n_rows = bucket.shape[0]
+    cap = min(p.cell_capacity, LANES)
+    key = jnp.where(active, bucket, num_buckets)
+    order, sorted_key, rank = sorted_ranks_by(key, slots, n_rows)
+    ok = (sorted_key < num_buckets) & (rank < cap)
+    table_size = num_buckets * LANES
+    dst = jnp.where(ok, sorted_key * LANES + rank, table_size)
+    table = jnp.full((table_size,), p.capacity, dtype=jnp.int32)
+    table = table.at[dst].set(slots[order].astype(jnp.int32), mode="drop")
+    tpos = jnp.zeros((n_rows,), jnp.int32).at[order].set(
+        jnp.where(ok, dst, -1).astype(jnp.int32)
+    )
+    dropped_sorted = (sorted_key < num_buckets) & ~ok
+    own_dropped = jnp.sum(dropped_sorted & (order < chunk)).astype(jnp.int32)
+    return table, tpos, own_dropped, order, dst
+
+
+def _spatial_step_pallas_impl(
+    p: NeighborParams,
+    events_inline: int,
+    halo_cap: int,
+    n_dev: int,
+    interpret: bool,
+    cols_cap: int,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    slot_l,
+    send_lo_idx,
+    send_hi_idx,
+    strip_lo,  # [1] i32: this shard's first owned column
+):
+    """Per-shard strip+halo Pallas body (see the section comment). Returns
+    (enter drain ctx x4, table_c, leave drain ctx x4, table_l, out) —
+    the same 11-output contract as parallel/mesh._sharded_step_pallas,
+    with drain contexts in strip-local coordinates."""
+    n = p.capacity
+    chunk = pos_l.shape[0]
+    h = halo_cap
+    n_all = chunk + 2 * h
+    gz = p.grid_z
+    gxe = cols_cap + 4  # slab x extent: query cols + 2 ghost cols per side
+    qcols = cols_cap + 2  # kernel grid columns (strip + hysteresis slack)
+    nb_local = p.space_slots * gz * gxe
+    w_words = 9 * LANES // _PACK
+    kernel = _compiled_event_kernel(p, interpret, rows=gz, cols=qcols)
+    kernel_dual = _compiled_event_kernel(
+        p, interpret, rows=gz, cols=qcols, dual=True
+    )
+
+    (pos_all, ppos_all, act_all, pact_all, spc_all, pspc_all, slot_all,
+     rad_all, prad_all) = _exchange_halo(
+        p, n_dev, ppos_l, pact_l, pspc_l, prad_l,
+        pos_l, act_l, spc_l, rad_l, slot_l, send_lo_idx, send_hi_idx,
+    )
+
+    cxc, czc, smc = _bins(p, pos_all, spc_all)
+    cxp, czp, smp = _bins(p, ppos_all, pspc_all)
+    base = strip_lo[0] - 2
+    lxc = jnp.mod(cxc - base, p.grid_x)
+    lxp = jnp.mod(cxp - base, p.grid_x)
+    # Rows outside the slab's column span (a ghost's OTHER epoch far from
+    # the seam) are absent from that epoch's strip table — NaN-poisoned
+    # like a capacity drop, which is exact: any pair they could carry with
+    # an own query is > cell_size apart in that epoch.
+    in_c = lxc < gxe
+    in_p = lxp < gxe
+    buc_c = jnp.where(in_c, (smc * gz + czc) * gxe + lxc, nb_local)
+    buc_p = jnp.where(in_p, (smp * gz + czp) * gxe + lxp, nb_local)
+    # Strip-local LANES-stride sorts over chunk + 2h keys — the replicated
+    # N-row sort + full-grid scatter of the all-gather kernel tier
+    # (parallel/mesh._sharded_step_pallas) are what this path deletes.
+    table_c, tpos_c, own_drop, order_c, dst_c = _build_table_strip(
+        p, buc_c, act_all & in_c, slot_all, nb_local, chunk
+    )
+    table_p, tpos_p, _, order_p, dst_p = _build_table_strip(
+        p, buc_p, pact_all & in_p, slot_all, nb_local, chunk
+    )
+    dropped_total = jax.lax.psum(own_drop, SHARD_AXIS).astype(jnp.int32)
+
+    # Each epoch's x row poisoned by its OWN table validity
+    # (ops/neighbor._step_pallas — fresh spawns must not be suppressed by
+    # stale previous positions).
+    xs_c = jnp.where(tpos_c >= 0, pos_all[:, 0], jnp.nan)
+    xs_p = jnp.where(tpos_p >= 0, ppos_all[:, 0], jnp.nan)
+    cur_feats = (xs_c, pos_all[:, 1], spc_all, rad_all)
+    prev_feats = (xs_p, ppos_all[:, 1], pspc_all, prad_all)
+    cells_c = _scatter_feats(p, dst_c, order_c, cur_feats, prev_feats,
+                             gx_ext=gxe)
+
+    def extract(packed_cells, lx, cz, sm, tpos):
+        """Packed event words of the OWN rows binned in this slab."""
+        lane = tpos[:chunk] % LANES
+        ocol = lx[:chunk] - 1  # kernel output col: slab col minus ghost col
+        flat = packed_cells.reshape(-1, w_words)
+        oflat = ((sm[:chunk] * gz + cz[:chunk]) * qcols + ocol) * LANES + lane
+        mine = (tpos[:chunk] >= 0) & (ocol >= 0) & (ocol < qcols)
+        safe = jnp.clip(oflat, 0, flat.shape[0] - 1)
+        return jnp.where(mine[:, None], flat[safe], 0)  # i32[chunk, W]
+
+    # Seam-free single-pass fast tick (ISSUE 15): when the replicated
+    # guard holds, ONE dual-output kernel launch on the current slab
+    # yields both masks; other ticks pay the second scatter+kernel pass on
+    # the previous slab. Both strip tables always build (xs poisoning and
+    # drain contexts need them) — the kernel pass is what halves.
+    fast = _fast_guard_strip(
+        p, ppos_l, pact_l, pspc_l, prad_l, pos_l, act_l, spc_l,
+        dropped_total,
+    )
+
+    def fast_fn():
+        pk2 = kernel_dual(cells_c)  # [S, gz, qcols, LANES, 2W]
+        return (pk2[..., :w_words], pk2[..., w_words:],
+                lxc, czc, smc, tpos_c, table_c)
+
+    def slow_fn():
+        pk_e = kernel(cells_c)
+        cells_p = _scatter_feats(p, dst_p, order_p, prev_feats, cur_feats,
+                                 gx_ext=gxe)
+        pk_l = kernel(cells_p)
+        return (pk_e, pk_l, lxp, czp, smp, tpos_p, table_p)
+
+    pk_e, pk_l, l_lx, l_cz, l_sm, l_tpos, l_table = jax.lax.cond(
+        fast, fast_fn, slow_fn
+    )
+    packed_e = extract(pk_e, lxc, czc, smc, tpos_c)
+    packed_l = extract(pk_l, l_lx, l_cz, l_sm, l_tpos)
+    n_enters = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
+    n_leaves = jnp.sum(jax.lax.population_count(packed_l)).astype(jnp.int32)
+
+    ep, _ = _drain_bits(p, packed_e, lxc[:chunk], czc[:chunk], smc[:chunk],
+                        table_c, jnp.int32(0), max_events=events_inline,
+                        gx_ext=gxe, wrap_x=False)
+    lp, _ = _drain_bits(p, packed_l, l_lx[:chunk], l_cz[:chunk],
+                        l_sm[:chunk], l_table, jnp.int32(0),
+                        max_events=events_inline, gx_ext=gxe, wrap_x=False)
+
+    def slotize(pairs):
+        ent = pairs[:, 0]
+        ent = jnp.where(ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n)
+        return jnp.stack([ent, pairs[:, 1]], axis=1)
+
+    zero = jnp.int32(0)
+    header = jnp.stack(
+        [
+            jnp.stack([n_enters, n_leaves]),
+            jnp.stack([dropped_total, fast.astype(jnp.int32)]),
+            jnp.stack([zero, zero]),  # rank paging resumes at events_inline
+        ]
+    ).astype(jnp.int32)
+    # Replicated per-shard counts — see _spatial_step_impl.
+    counts_all = jax.lax.all_gather(header[0], SHARD_AXIS)  # [D, 2]
+    out = jnp.concatenate(
+        [header, counts_all, slotize(ep), slotize(lp)], axis=0
+    )
+    enter_ctx = (packed_e, lxc[:chunk], czc[:chunk], smc[:chunk], table_c)
+    leave_ctx = (packed_l, l_lx[:chunk], l_cz[:chunk], l_sm[:chunk], l_table)
+    return enter_ctx + leave_ctx + (out,)
+
+
+def _spatial_drain_bits(
+    p: NeighborParams, events_inline: int, cols_cap: int,
+    packed_l,  # [chunk, W] this shard's own-row packed event words
+    lx_l, cz_l, sm_l,  # [chunk] strip-local bin coords of the pass's grid
+    table_l,  # [nb_local * LANES] slot-id table of the pass's grid
+    slot_l,  # [chunk] row → slot (dispatch-time perm snapshot)
+    start_l,  # [1] resume EVENT RANK
+):
+    """Pallas-strip storm paging: rank-select past the inline budget, own
+    rows mapped to slots through the dispatch-time perm snapshot."""
+    n = p.capacity
+    chunk = packed_l.shape[0]
+    pairs, total = _drain_bits(
+        p, packed_l, lx_l, cz_l, sm_l, table_l, start_l[0],
+        max_events=events_inline, gx_ext=cols_cap + 4, wrap_x=False,
+    )
+    ent = pairs[:, 0]
+    ent = jnp.where(ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n)
+    pairs = jnp.stack([ent, pairs[:, 1]], axis=1)
+    return pairs, total[None]
+
+
+def _spatial_step_pallas_fused_impl(
+    p: NeighborParams,
+    events_inline: int,
+    halo_cap: int,
+    n_dev: int,
+    interpret: bool,
+    cols_cap: int,
+    programs,
+    ppos_l, pact_l, pspc_l, prad_l,
+    pos_l, act_l, spc_l, rad_l,
+    slot_l,
+    send_lo_idx,
+    send_hi_idx,
+    strip_lo,
+    y_l, yaw_l, sel_l, dt_l, *cols_l,
+):
+    """The Pallas strip step plus fused entity logic on this shard's LOCAL
+    rows — identical logic contract to _spatial_step_fused_impl (row-
+    permuted inputs, perm-snapshot writeback)."""
+    res = _spatial_step_pallas_impl(
+        p, events_inline, halo_cap, n_dev, interpret, cols_cap,
+        ppos_l, pact_l, pspc_l, prad_l,
+        pos_l, act_l, spc_l, rad_l,
+        slot_l, send_lo_idx, send_hi_idx, strip_lo,
+    )
+    new_pos, new_y, new_yaw, new_cols = _apply_fused_logic(
+        programs, pos_l, y_l, yaw_l, sel_l, dt_l[0], cols_l
+    )
+    return res + ((new_pos, new_y, new_yaw) + new_cols,)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_step_pallas(
+    params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int,
+    interpret: bool, cols_cap: int,
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(
+        _spatial_step_pallas_impl, params, events_inline, halo_cap,
+        mesh.devices.size, interpret, cols_cap,
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * 12,
+        out_specs=(spec,) * 11,
+        # pallas_call's out_shape carries no varying-mesh-axes annotation;
+        # skip the vma check (outputs are explicitly per-shard here) —
+        # same reasoning as parallel/mesh._jitted_sharded_step_pallas.
+        check_vma=False,
+    )
+    return sentinel.SentinelJit("spatial_step_pallas", jax.jit(mapped))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_step_pallas_fused(
+    params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int,
+    interpret: bool, cols_cap: int, programs: tuple, n_cols: int,
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(
+        _spatial_step_pallas_fused_impl, params, events_inline, halo_cap,
+        mesh.devices.size, interpret, cols_cap, programs,
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(spec,) * (16 + n_cols),
+        out_specs=(spec,) * 11 + ((spec,) * (3 + n_cols),),
+        check_vma=False,
+    )
+    return sentinel.SentinelJit("spatial_step_pallas_fused", jax.jit(mapped))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_spatial_drain_bits(
+    params: NeighborParams, mesh: Mesh, events_inline: int, cols_cap: int
+):
+    shard_map = resolve_shard_map()
+    body = functools.partial(
+        _spatial_drain_bits, params, events_inline, cols_cap
+    )
+    spec = P(SHARD_AXIS)
+    mapped = shard_map(
+        body, mesh=mesh, in_specs=(spec,) * 7, out_specs=(spec, spec),
+    )
+    return sentinel.SentinelJit("spatial_drain_bits", jax.jit(mapped))
+
+
 def plan_strips(
-    col_pop: np.ndarray, n_dev: int, min_cols: int = MIN_STRIP_COLS
+    col_pop: np.ndarray, n_dev: int, min_cols: int = MIN_STRIP_COLS,
+    max_cols: int | None = None,
 ) -> np.ndarray:
     """Equal-population strip boundaries from an observed column histogram.
 
@@ -380,11 +810,20 @@ def plan_strips(
     Each strip gets ≥ min_cols columns (the halo-correctness floor); the
     split otherwise walks the population cumsum so every strip carries
     ~1/D of the entities — hot columns get narrow strips, empty space gets
-    wide ones (the AoiZora-style density-aware placement seed)."""
+    wide ones (the AoiZora-style density-aware placement seed).
+
+    ``max_cols`` caps every strip's width (the Pallas tier's static slab
+    extent, cols_cap): sparse regions then spread over several capped
+    strips instead of one wide one. Requires n_dev * max_cols >= grid_x.
+    """
     gx = len(col_pop)
     if gx < n_dev * min_cols:
         raise ValueError(
             f"grid_x {gx} < {n_dev} shards * {min_cols} min columns"
+        )
+    if max_cols is not None and gx > n_dev * max_cols:
+        raise ValueError(
+            f"grid_x {gx} > {n_dev} shards * {max_cols} max columns"
         )
     cum = np.concatenate([[0], np.cumsum(col_pop, dtype=np.int64)])
     total = cum[-1]
@@ -394,11 +833,62 @@ def plan_strips(
         target = total * d // n_dev
         b = int(np.searchsorted(cum, target, side="left"))
         # Clamp so every strip (including the ones still to come) keeps
-        # its minimum width.
+        # its minimum width — and, under a width cap, so no strip placed
+        # OR remaining can exceed it.
         b = max(b, int(bounds[d - 1]) + min_cols)
         b = min(b, gx - (n_dev - d) * min_cols)
+        if max_cols is not None:
+            b = min(b, int(bounds[d - 1]) + max_cols)
+            b = max(b, gx - (n_dev - d) * max_cols)
         bounds[d] = b
     return bounds
+
+
+def ring_link_distance(coords: list, order: np.ndarray) -> int:
+    """Total interconnect distance of the strip ring under a device order:
+    sum of manhattan distances between consecutive (and wrap-around)
+    devices' mesh coordinates — the quantity every halo ``ppermute`` pays
+    per tick, which topology-aware placement minimizes."""
+    k = len(order)
+    total = 0
+    for i in range(k):
+        a = coords[int(order[i])]
+        b = coords[int(order[(i + 1) % k])]
+        total += sum(abs(int(x) - int(y)) for x, y in zip(a, b))
+    return total
+
+
+def plan_placement(devices: list) -> np.ndarray:
+    """Topology-aware strip→device placement (AoiZora, PAPERS.md): an
+    index permutation ``order`` such that ``devices[order[i]]`` hosts
+    strip i, chosen so ring-adjacent strips land on interconnect-adjacent
+    chips. Devices exposing mesh ``coords`` (TPU) are walked in a
+    boustrophedon (snake) over (z, y, x) — adjacent steps on a full grid
+    are single-hop — with same-chip cores kept consecutive; the snake is
+    adopted only when it strictly beats the given order's ring distance.
+    Devices without coords (CPU/GPU rigs) fall back to ring order
+    (identity)."""
+    k = len(devices)
+    ident = np.arange(k, dtype=np.int64)
+    coords = [getattr(d, "coords", None) for d in devices]
+    if k < 2 or any(c is None for c in coords):
+        return ident
+    coords = [tuple(int(v) for v in c) + (0, 0, 0) for c in coords]
+    coords = [c[:3] for c in coords]
+    ys = sorted({c[1] for c in coords})
+    yi = {v: i for i, v in enumerate(ys)}
+
+    def key(i: int):
+        x, y, z = coords[i]
+        core = int(getattr(devices[i], "core_on_chip", 0) or 0)
+        yr = yi[y] if z % 2 == 0 else len(ys) - 1 - yi[y]
+        xr = x if (z + yi[y]) % 2 == 0 else -x
+        return (z, yr, xr, core)
+
+    snake = np.asarray(sorted(range(k), key=key), dtype=np.int64)
+    if ring_link_distance(coords, snake) < ring_link_distance(coords, ident):
+        return snake
+    return ident
 
 
 class SpatialShardedNeighborEngine:
@@ -407,13 +897,22 @@ class SpatialShardedNeighborEngine:
     Interface parity with ShardedNeighborEngine: ``reset`` /
     ``step_async`` / ``step``, one packed readback per tick, paging past
     the per-shard inline budget. Extra observability attributes:
-    ``last_mode`` ("spatial" | "fallback:<reason>"), ``shard_population``
-    (np int64[D] active rows per shard at the last dispatch),
-    ``halo_bytes_per_tick`` (structural ppermute payload), and the
-    telemetry counters wired in ``__init__``.
-    """
+    ``last_mode`` ("spatial" | "fallback:<reason>"), ``last_fast_tick``
+    (the seam-free single-pass guard held on the last collected tick),
+    ``shard_population`` (np int64[D] active rows per shard at the last
+    dispatch), ``halo_bytes_per_tick`` (structural ppermute payload), and
+    the telemetry counters wired in ``__init__``.
 
-    backend = "jnp"  # paging is flat-index (rank_paging False)
+    ``backend``: "auto" = the strip-local Pallas kernel slab on TPU, the
+    jnp candidate math elsewhere; "pallas" / "pallas_interpret" / "jnp"
+    force a path. Both backends move the SAME halo bands — the Pallas
+    tier additionally keeps the kernel grid, table sort, and event drain
+    strip-local (``strip_cols`` caps a strip's width, the kernel slab's
+    static extent). ``placement``: "topology" reorders the mesh so
+    ring-adjacent strips land on interconnect-adjacent devices
+    (plan_placement; identity on rigs without device coords), "ring"
+    keeps the given mesh order.
+    """
 
     def __init__(
         self,
@@ -422,7 +921,18 @@ class SpatialShardedNeighborEngine:
         halo_cap: int | None = None,
         replan_interval: int = 64,
         prewarm_fallback: bool = True,
+        backend: str = "auto",
+        strip_cols: int | None = None,
+        placement: str = "topology",
     ) -> None:
+        if backend == "auto":
+            backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
+        if backend not in ("jnp", "pallas", "pallas_interpret"):
+            raise ValueError(f"unknown backend {backend!r}")
+        if placement not in ("topology", "ring"):
+            raise ValueError(
+                f"placement must be topology|ring, got {placement!r}"
+            )
         n_dev = int(mesh.devices.size)
         if n_dev < 2:
             raise ValueError("spatial sharding needs >= 2 devices")
@@ -440,11 +950,66 @@ class SpatialShardedNeighborEngine:
                 f"(each strip needs >= {MIN_STRIP_COLS} columns for the "
                 f"halo contract); raise [aoi] grid or lower mesh_shards"
             )
+        if backend != "jnp" and params.cell_capacity > LANES:
+            raise ValueError(
+                f"pallas path supports cell_capacity <= {LANES}, "
+                f"got {params.cell_capacity}"
+            )
+        # Topology-aware strip→device placement (tentpole a): strip i
+        # always lives at mesh position i, so placing strips IS ordering
+        # the mesh's devices. Re-plans move strip boundaries, never strip
+        # order, so the adjacency the placement buys survives them.
+        self.placement = placement
+        devs = list(mesh.devices.reshape(-1))
+        self.placement_order = plan_placement(devs)
+        if placement == "topology" and not np.array_equal(
+            self.placement_order, np.arange(n_dev)
+        ):
+            mesh = Mesh(
+                np.asarray([devs[i] for i in self.placement_order]),
+                (SHARD_AXIS,),
+            )
+        coords = [getattr(d, "coords", None) for d in devs]
+        if all(c is not None for c in coords):
+            _M_RING_DISTANCE.labels("ring").set(
+                ring_link_distance(coords, np.arange(n_dev)))
+            _M_RING_DISTANCE.labels("placed").set(
+                ring_link_distance(
+                    coords,
+                    self.placement_order if placement == "topology"
+                    else np.arange(n_dev)))
         self.params = params
         self.mesh = mesh
+        self.backend = backend
         self.n_devices = n_dev
         self.chunk = params.capacity // n_dev
         self.events_inline = params.max_events // n_dev
+        gx = params.grid_x
+        if backend != "jnp":
+            # Static kernel-slab width cap. Default: 2x the uniform strip,
+            # clamped to planner feasibility on both sides.
+            ceil_w = -(-gx // n_dev)
+            if strip_cols is None:
+                strip_cols = min(
+                    gx - (n_dev - 1) * MIN_STRIP_COLS, 2 * ceil_w
+                )
+            strip_cols = int(strip_cols)
+            if strip_cols < ceil_w:
+                raise ValueError(
+                    f"strip_cols {strip_cols} < ceil(grid_x/{n_dev}) = "
+                    f"{ceil_w}: {n_dev} capped strips cannot cover "
+                    f"{gx} columns"
+                )
+            if strip_cols + 4 > gx:
+                raise ValueError(
+                    f"strip_cols {strip_cols} + 4 ghost columns exceeds "
+                    f"grid_x {gx}; lower strip_cols (the strip slab must "
+                    f"not wrap onto itself)"
+                )
+            self._max_cols: int | None = strip_cols
+        else:
+            self._max_cols = None
+        self.strip_cols = self._max_cols
         if halo_cap is None:
             # ~6 band columns of the uniform-density column population,
             # doubled for clustering, clamped to the chunk (an overflow
@@ -458,18 +1023,32 @@ class SpatialShardedNeighborEngine:
         )
         # What the all-gather formulation moves instead: every OTHER
         # shard's rows, both epochs (pos 8B + act 1B + spc 4B + rad 4B
-        # each), received by each of the D devices.
+        # each), received by each of the D devices. The Pallas kernel
+        # tier's all-gather formulation (mesh._sharded_step_pallas) moves
+        # the same eight feature arrays, so one equivalent serves both.
         self.allgather_bytes_per_tick = (
             n_dev * (params.capacity - self.chunk) * 34
         )
-        self._jit_step = _jitted_spatial_step(
-            params, mesh, self.events_inline, self.halo_cap
-        )
-        self._jit_drain = _jitted_spatial_drain(
-            params, mesh, self.events_inline, self.chunk
-        )
+        if backend == "jnp":
+            self._jit_step = _jitted_spatial_step(
+                params, mesh, self.events_inline, self.halo_cap
+            )
+            self._jit_drain = _jitted_spatial_drain(
+                params, mesh, self.events_inline, self.chunk
+            )
+        else:
+            self._jit_step = _jitted_spatial_step_pallas(
+                params, mesh, self.events_inline, self.halo_cap,
+                backend == "pallas_interpret", self.strip_cols,
+            )
+            self._jit_drain = _jitted_spatial_drain_bits(
+                params, mesh, self.events_inline, self.strip_cols
+            )
         # Exact all-gather program for ticks the strip invariants cannot
         # cover (teleports past the halo, halo overflow, strip overflow).
+        # BOTH backends fall back to the jnp all-gather program: fallback
+        # ticks are rare by construction, and one exact program keeps the
+        # oracle surface single (the kernel tier's honesty note, README).
         self._jit_fallback = _jitted_sharded_step(
             params, mesh, self.events_inline
         )
@@ -481,6 +1060,8 @@ class SpatialShardedNeighborEngine:
         self._state: tuple | None = None
         self.last_grid_dropped = 0
         self.last_mode = "spatial"
+        self.last_fast_tick = False
+        self.total_fast_ticks = 0
         self.shard_population = np.zeros(n_dev, np.int64)
         self.total_migrations = 0
         self.total_fallbacks = 0
@@ -599,6 +1180,12 @@ class SpatialShardedNeighborEngine:
         # Hysteresis band columns, one per side of each strip.
         self._band_lo = (self.boundaries[:-1] - 1) % gx
         self._band_hi = self.boundaries[1:] % gx
+        # Per-shard strip origin for the Pallas slab's local-column map;
+        # a dynamic [D] input, so boundary moves never retrace the jit.
+        self._strip_lo_dev = jax.device_put(
+            np.ascontiguousarray(self.boundaries[:-1], dtype=np.int32),
+            self._sharding,
+        )
 
     def carried_epoch(self) -> tuple:
         """The last dispatched world in SLOT space (what the tier-growth
@@ -614,11 +1201,28 @@ class SpatialShardedNeighborEngine:
             | (cx == self._band_hi[shard])
         )
 
+    def _rehome_prev_only(self, prev_act, cur_act) -> int:
+        """Re-home rows active ONLY in the previous epoch onto the strip
+        owning their PREVIOUS cell (see step_async — keeps adopted
+        re-plans from stranding a despawned row's prev cell outside its
+        band). Returns the number of rows moved."""
+        prev_only = np.flatnonzero(prev_act & ~cur_act)
+        if not len(prev_only):
+            return 0
+        keep = self._in_strip_or_band(
+            self._prev_cx[prev_only], self.assign[prev_only]
+        )
+        movers = prev_only[~keep]
+        if len(movers):
+            self.assign[movers] = self._col_owner[self._prev_cx[movers]]
+            self._perm_dirty = True
+        return int(len(movers))
+
     def _replan(self, cx: np.ndarray, active: np.ndarray) -> bool:
         """Re-split strips from the observed column density; adopt only
         when the split meaningfully improves the worst strip load."""
         pop = np.bincount(cx[active], minlength=self.params.grid_x)
-        new = plan_strips(pop, self.n_devices)
+        new = plan_strips(pop, self.n_devices, max_cols=self._max_cols)
         if np.array_equal(new, self.boundaries):
             return False
         cum = np.concatenate([[0], np.cumsum(pop, dtype=np.int64)])
@@ -682,6 +1286,8 @@ class SpatialShardedNeighborEngine:
     ):
         assert self._state is not None, "call reset() first"
         check_radius(self.params, radius, active)
+        if self.backend != "jnp":
+            check_space_ids(space, active)
         p = self.params
         gx = p.grid_x
         # Copies, not views: these become the host prev mirror and must
@@ -703,6 +1309,7 @@ class SpatialShardedNeighborEngine:
 
         perm_rebuilt = False
         migrations = 0
+        prev_act = self._host_prev[1]
         # Slow-cadence density re-plan.
         if (
             self.replan_interval
@@ -719,9 +1326,15 @@ class SpatialShardedNeighborEngine:
             self.assign[movers] = self._col_owner[cx[movers]]
             migrations += len(movers)
             self._perm_dirty = True
+        # Prev-epoch-only rows (freshly despawned) re-home by their
+        # PREVIOUS column: their only remaining job is hosting their
+        # prev-epoch pairs, so an adopted re-plan that moved boundaries
+        # several columns must carry them to the new owner of that cell —
+        # otherwise the stranded prev cell trips the teleport guard and
+        # the tick pays the exact all-gather fallback for no reason.
+        migrations += self._rehome_prev_only(prev_act, cur_act)
 
         fallback_reason = None
-        prev_act = self._host_prev[1]
         # Row placement covers slots live in EITHER epoch: a slot that
         # just despawned still owns a row on its strip this tick so its
         # neighbors' leave events resolve there.
@@ -740,6 +1353,7 @@ class SpatialShardedNeighborEngine:
                 migrations += int((new_assign != self.assign[act_idx]).sum())
                 self.assign[act_idx] = new_assign
                 self._perm_dirty = True
+                migrations += self._rehome_prev_only(prev_act, cur_act)
                 counts = np.bincount(
                     self.assign[placed_idx], minlength=self.n_devices
                 ).astype(np.int64)
@@ -823,25 +1437,49 @@ class SpatialShardedNeighborEngine:
             ) + tuple(put(np.asarray(c)[perm]) for c in cols)
 
         if fallback_reason is None:
-            if logic is not None:
-                jit_fused = _jitted_spatial_step_fused(
-                    self.params, self.mesh, self.events_inline,
-                    self.halo_cap, tuple(logic[0]), len(logic[5]),
+            if self.backend != "jnp":
+                band_args = (
+                    self._perm_dev, put(send_lo), put(send_hi),
+                    self._strip_lo_dev,
                 )
-                enter_ids, leave_ids, out, fused_out = jit_fused(
-                    *self._state, *cur_dev, self._perm_dev,
-                    put(send_lo), put(send_hi), *logic_dev,
-                )
+                if logic is not None:
+                    jit_fused = _jitted_spatial_step_pallas_fused(
+                        self.params, self.mesh, self.events_inline,
+                        self.halo_cap, self.backend == "pallas_interpret",
+                        self.strip_cols, tuple(logic[0]), len(logic[5]),
+                    )
+                    res = jit_fused(
+                        *self._state, *cur_dev, *band_args, *logic_dev,
+                    )
+                    fused_out = res[11]
+                else:
+                    res = self._jit_step(*self._state, *cur_dev, *band_args)
+                enter_ctx = ("pallas",) + tuple(res[0:5]) + (self._perm_dev,)
+                leave_ctx = ("pallas",) + tuple(res[5:10]) + (self._perm_dev,)
+                out = res[10]
             else:
-                enter_ids, leave_ids, out = self._jit_step(
-                    *self._state, *cur_dev, self._perm_dev,
-                    put(send_lo), put(send_hi),
-                )
-            enter_ctx = ("spatial", enter_ids, self._perm_dev)
-            leave_ctx = ("spatial", leave_ids, self._perm_dev)
+                if logic is not None:
+                    jit_fused = _jitted_spatial_step_fused(
+                        self.params, self.mesh, self.events_inline,
+                        self.halo_cap, tuple(logic[0]), len(logic[5]),
+                    )
+                    enter_ids, leave_ids, out, fused_out = jit_fused(
+                        *self._state, *cur_dev, self._perm_dev,
+                        put(send_lo), put(send_hi), *logic_dev,
+                    )
+                else:
+                    enter_ids, leave_ids, out = self._jit_step(
+                        *self._state, *cur_dev, self._perm_dev,
+                        put(send_lo), put(send_hi),
+                    )
+                enter_ctx = ("spatial", enter_ids, self._perm_dev)
+                leave_ctx = ("spatial", leave_ids, self._perm_dev)
             self.last_mode = "spatial"
             self._m_halo_bytes.inc(self.halo_bytes_per_tick)
             pending = ShardedPendingStep(self, enter_ctx, leave_ctx, out)
+            # The strip-local bit drain pages by event RANK; everything
+            # else (jnp ids, the jnp all-gather fallback) by flat index.
+            pending.rank_paging = self.backend != "jnp"
         else:
             if logic is not None:
                 jit_fused = _jitted_sharded_step_fused(
@@ -864,6 +1502,9 @@ class SpatialShardedNeighborEngine:
             pending = _FallbackPendingStep(
                 self, enter_ctx, leave_ctx, out, perm.copy()
             )
+            # The fallback is the jnp all-gather program on EITHER backend:
+            # its cursors are flat matrix indices.
+            pending.rank_paging = False
 
         if fused_out is not None:
             from goworld_tpu.ops.neighbor import start_host_copy
@@ -886,6 +1527,7 @@ class SpatialShardedNeighborEngine:
         NeighborEngine.warmup_fused (restore-path prewarm)."""
         n = self.params.capacity
         d = self.n_devices
+        gx = self.params.grid_x
         put = lambda x: jax.device_put(x, self._sharding)  # noqa: E731
         zeros = (
             put(np.zeros((n, 2), np.float32)),
@@ -902,13 +1544,25 @@ class SpatialShardedNeighborEngine:
         ncols = len(col_dtypes)
         perm = put(np.arange(n, dtype=np.int32))
         empty_band = put(np.full(d * self.halo_cap, self.chunk, np.int32))
-        jit_sp = _jitted_spatial_step_fused(
-            self.params, self.mesh, self.events_inline, self.halo_cap,
-            tuple(programs), ncols,
-        )
-        jax.block_until_ready(
-            jit_sp(*zeros, *zeros, perm, empty_band, empty_band,
-                   *logic_dev)[2])
+        if self.backend != "jnp":
+            strip_lo = put(np.asarray(
+                [round(i * gx / d) for i in range(d)], np.int32))
+            jit_sp = _jitted_spatial_step_pallas_fused(
+                self.params, self.mesh, self.events_inline, self.halo_cap,
+                self.backend == "pallas_interpret", self.strip_cols,
+                tuple(programs), ncols,
+            )
+            jax.block_until_ready(
+                jit_sp(*zeros, *zeros, perm, empty_band, empty_band,
+                       strip_lo, *logic_dev)[10])
+        else:
+            jit_sp = _jitted_spatial_step_fused(
+                self.params, self.mesh, self.events_inline, self.halo_cap,
+                tuple(programs), ncols,
+            )
+            jax.block_until_ready(
+                jit_sp(*zeros, *zeros, perm, empty_band, empty_band,
+                       *logic_dev)[2])
         jit_fb = _jitted_sharded_step_fused(
             self.params, self.mesh, self.events_inline,
             tuple(programs), ncols,
@@ -919,14 +1573,29 @@ class SpatialShardedNeighborEngine:
         """Trace count of the fused SPATIAL jit for ``programs`` (the
         no-fresh-trace restore gate; the fallback jit is warmed alongside
         but not counted here)."""
-        jit_sp = _jitted_spatial_step_fused(
-            self.params, self.mesh, self.events_inline, self.halo_cap,
-            tuple(programs), self._warmed_ncols(programs),
-        )
+        if self.backend != "jnp":
+            jit_sp: object = _jitted_spatial_step_pallas_fused(
+                self.params, self.mesh, self.events_inline, self.halo_cap,
+                self.backend == "pallas_interpret", self.strip_cols,
+                tuple(programs), self._warmed_ncols(programs),
+            )
+        else:
+            jit_sp = _jitted_spatial_step_fused(
+                self.params, self.mesh, self.events_inline, self.halo_cap,
+                tuple(programs), self._warmed_ncols(programs),
+            )
         try:
             return int(jit_sp._cache_size())
         except Exception:  # pragma: no cover - private-API drift
             return -1
+
+    def _note_step_flags(self, flags: int) -> None:
+        """Header-flag hook (ShardedPendingStep.collect): bit 0 = the
+        seam-free single-pass guard held for the collected tick."""
+        self.last_fast_tick = bool(flags & 1)
+        if flags & 1:
+            self.total_fast_ticks += 1
+            _M_FAST_TICKS.inc()
 
     @staticmethod
     def _warmed_ncols(programs: tuple) -> int:
@@ -970,20 +1639,25 @@ class SpatialShardedNeighborEngine:
         return send_lo, send_hi, False
 
     def _page(self, ctx: tuple, deficit: np.ndarray, starts: np.ndarray):
-        """Per-shard chunked drain (flat-index paging, jnp semantics) for
-        events beyond the inline budget; ctx[0] picks the program."""
-        mode, ids = ctx[0], ctx[1]
+        """Per-shard chunked drain for events beyond the inline budget;
+        ctx[0] picks the program: "spatial" = jnp id-matrix drain (flat-
+        index paging), "pallas" = strip-local bit drain (event-RANK
+        paging), anything else = the jnp all-gather fallback drain."""
+        mode = ctx[0]
         chunks: list[np.ndarray] = []
         starts = starts.copy()
         deficit = deficit.copy()
+        rank_paging = mode == "pallas"
         while deficit.any():
             st = jax.device_put(
                 np.asarray(starts, np.int32), self._sharding
             )
-            if mode == "spatial":
-                pairs, aux = self._jit_drain(ids, ctx[2], st)
+            if mode == "pallas":
+                pairs, aux = self._jit_drain(*ctx[1:6], ctx[6], st)
+            elif mode == "spatial":
+                pairs, aux = self._jit_drain(ctx[1], ctx[2], st)
             else:
-                pairs, aux = self._jit_fallback_drain(ids, st)
+                pairs, aux = self._jit_fallback_drain(ctx[1], st)
             pairs = np.asarray(pairs)
             aux = np.asarray(aux)
             e = self.events_inline
@@ -993,7 +1667,9 @@ class SpatialShardedNeighborEngine:
                     continue
                 chunks.append(pairs[d * e:d * e + take])
                 deficit[d] -= take
-                if deficit[d] > 0:
+                if rank_paging:
+                    starts[d] += take
+                elif deficit[d] > 0:
                     starts[d] = aux[d, take - 1] + 1
                 else:
                     starts[d] = self._flat_end

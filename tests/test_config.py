@@ -185,6 +185,46 @@ def test_aoi_fuse_logic_knob(cfg, tmp_path):
         read_config.set_config_file(None)
 
 
+def test_aoi_strip_placement_and_pallas_strip_cols(cfg, tmp_path):
+    """[aoi] strip_placement / pallas_strip_cols parse and validate
+    (ISSUE 15: the Pallas strip tier's placement + slab-width knobs)."""
+    assert cfg.aoi.strip_placement == "topology"  # default
+    assert cfg.aoi.pallas_strip_cols == 0  # default: derive
+    good = SAMPLE.replace(
+        "backend = xzlist",
+        "backend = xzlist\nstrip_placement = ring\npallas_strip_cols = 24",
+    )
+    p = tmp_path / "strips.ini"
+    p.write_text(good)
+    read_config.set_config_file(str(p))
+    try:
+        got = read_config.get().aoi
+        assert got.strip_placement == "ring"
+        assert got.pallas_strip_cols == 24
+    finally:
+        read_config.set_config_file(None)
+    bad = SAMPLE.replace("backend = xzlist",
+                         "backend = xzlist\nstrip_placement = nearest")
+    p = tmp_path / "bad_placement.ini"
+    p.write_text(bad)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match="strip_placement"):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
+    neg = SAMPLE.replace("backend = xzlist",
+                         "backend = xzlist\npallas_strip_cols = -3")
+    p = tmp_path / "bad_cols.ini"
+    p.write_text(neg)
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match="pallas_strip_cols"):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
+
+
 def test_per_game_aoi_platform(cfg, tmp_path):
     """One game may ride the chip while the rest force CPU (single-client
     TPU transports); invalid values fail loudly like [aoi] platform."""

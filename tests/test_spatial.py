@@ -284,6 +284,46 @@ def test_density_replan_rebalances_mid_run():
     assert spatial.total_fallbacks == 0
 
 
+def test_seam_free_fast_path_parity_and_flag():
+    """ISSUE 15 tentpole (b) on the jnp tier: radius 40 with ~4-unit
+    drift keeps the replicated seam-free guard TRUE — the leave diff
+    rides the CURRENT grid in one combined pass — while parity with the
+    single-device engine must hold exactly. The engine reports the guard
+    via last_fast_tick / aoi_spatial_fast_ticks_total; a despawn tick
+    must break the guard (and the flag) without breaking parity."""
+    from goworld_tpu import telemetry
+
+    single, spatial = make_engines()
+    rng, pos, active, space, radius = make_world(420, seed=29)
+    radius = np.full(N, 40.0, np.float32)
+    fast0 = telemetry.counter("aoi_spatial_fast_ticks_total").value
+    spatial.step(pos, active, space, radius)  # enter storm
+    single.step(pos, active, space, radius)
+    saw_leaves = 0
+    for tick in range(4):
+        pos = pos + rng.normal(0, 3, pos.shape).astype(np.float32)
+        np.clip(pos[:, 0], 0, WORLD_X, out=pos[:, 0])
+        np.clip(pos[:, 1], 1.0, 1599.0, out=pos[:, 1])
+        pos = pos.astype(np.float32)
+        e1, l1 = assert_tick_parity(
+            single, spatial, pos, active, space, radius, f"@ fast {tick}"
+        )
+        assert spatial.last_fast_tick, f"guard broke @ tick {tick}"
+        saw_leaves += len(l1)
+    assert saw_leaves > 0, "fast-path trace produced no leaves"
+    assert telemetry.counter("aoi_spatial_fast_ticks_total").value >= (
+        fast0 + 4
+    )
+    # A despawn makes the single-pass ineligible: the guard must drop it
+    # back to the two-pass path, with the stream still exact.
+    active = active.copy()
+    active[:8] = False
+    assert_tick_parity(single, spatial, pos, active, space, radius,
+                       "@ despawn")
+    assert not spatial.last_fast_tick
+    assert spatial.total_fallbacks == 0
+
+
 def test_pipelined_matches_sync():
     """step_async pipelining parity (depth 2) across migration ticks."""
     mesh = make_mesh(8)
