@@ -1500,19 +1500,21 @@ def bench_trace_overhead() -> dict:
 # --- chaos: fault-injection suite over a live in-process cluster -------------
 
 CHAOS_CONFIG = {"dispatchers": 2, "bots": 12, "multigame_bots": 12,
-                "scenarios_per_transport": 7}
+                "scenarios_per_transport": 9}
 
 
 def bench_chaos() -> dict:
     """``bench.py --chaos``: the full chaos scenario suite — dispatcher
     kill+restart, severed link, stalled-past-heartbeat dispatcher, storage
-    outage, GAME kill+recreate, GATE kill (client reconnect wave), and
+    outage, GAME kill+recreate, GATE kill (client reconnect wave), the
+    battle-royale collapse under a game kill and under a freeze->restore
+    reload (scenario-matrix workloads on live avatars, ISSUE 16), and
     migrate-during-dispatcher-restart (on the 2-game multigame cluster) —
     run ONCE PER CLUSTER TRANSPORT (tcp, then uds): fault semantics must
     be transport-identical, and each scenario asserts zero bot errors /
     zero entity loss / in-deadline recovery either way.
 
-    Value = total scenarios passed across both transports (14 = all
+    Value = total scenarios passed across both transports (18 = all
     green). The headline carries a per-scenario map of recovery time and
     bot-error count; failures are named per scenario in ``failures`` and
     make the PROCESS exit non-zero (deviation from the headline-bench
@@ -1534,7 +1536,7 @@ def bench_chaos() -> dict:
             r = run_chaos(d, n_dispatchers=c["dispatchers"],
                           n_bots=c["bots"], transport=transport)
         scenarios = list(r["scenarios"])
-        # 7th scenario: commanded migrations crossing a dispatcher
+        # 9th scenario: commanded migrations crossing a dispatcher
         # restart — needs two REAL game processes (multigame harness).
         with tempfile.TemporaryDirectory(prefix="bench_chaos_mg_") as d:
             try:
@@ -1884,6 +1886,93 @@ def _pinned_floor_tier1_env() -> dict:
     return json.loads(r.stdout.strip().splitlines()[-1])
 
 
+# --- scenario matrix (ISSUE 16) ----------------------------------------------
+
+# The scenario subsystem owns its FIXED configs (goworld_tpu/scenarios/:
+# specs are never self-tuned, same comparable-by-construction rule as the
+# pinned floor); bench.py is just the gate-mode driver. The committed
+# floor is scenario_hotspot on the batched engine — worst-case AOI
+# density is the regression that matters most and the workload with the
+# least timing noise (no storage sleeps, no lifecycle churn).
+
+
+def bench_scenario(name: str | None = None,
+                   engine: str | None = None) -> dict:
+    """``bench.py --scenario <name> [--scenario-engine batched|sharded]``:
+    run one registered scenario in regression-gate mode — fixed config
+    from the registry, verify pass (interest-set oracle + per-tick
+    invariants) then timed measure pass, one JSON line, rc 0. The
+    ``sharded`` engine needs the forced multi-device mesh, so the flag
+    must land before the first jax import (fresh process, same rule as
+    --sharded)."""
+    argv = sys.argv[1:]
+    if name is None:
+        name = argv[argv.index("--scenario") + 1]
+    if engine is None:
+        engine = "batched"
+        if "--scenario-engine" in argv:
+            engine = argv[argv.index("--scenario-engine") + 1]
+    if engine == "sharded":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            from goworld_tpu.scenarios import get_scenario
+
+            shards = get_scenario(name).config["shards"]
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={shards}"
+            ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from goworld_tpu.scenarios.runner import run_scenario
+
+    result = run_scenario(name, engine=engine)
+    result["floor_file"] = PINNED_FLOOR_FILE
+    return result
+
+
+def _scenario_floor_tier1_env() -> dict:
+    """scenario_hotspot measured in the tier-1 environment (8-device
+    virtual mesh via XLA_FLAGS, like _pinned_floor_tier1_env — the gate
+    runs under tests/conftest.py's forced mesh, so the floor must be
+    measured under it too). Subprocess: device count fixes at first jax
+    init."""
+    env = dict(os.environ)
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--scenario", "hotspot"],
+        capture_output=True, text=True, env=env, timeout=600, check=True,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def list_scenarios() -> int:
+    """``bench.py --list-scenarios``: the registry, one JSON line per
+    scenario with its fixed config and committed-floor status."""
+    from goworld_tpu.scenarios import get_scenario, scenario_names
+
+    try:
+        floors = json.loads(open(PINNED_FLOOR_FILE).read())
+    except OSError:
+        floors = {}
+    for name in scenario_names():
+        spec = get_scenario(name)
+        entry = floors.get(f"scenario_{name}")
+        print(json.dumps({
+            "scenario": name,
+            "description": spec.description,
+            "config": dict(spec.config),
+            "committed_floor": entry["floor"] if entry else None,
+            "tolerance": entry["tolerance"] if entry else None,
+        }, separators=(",", ":")))
+    return 0
+
+
 def update_floor(allow_lower: bool = False) -> int:
     """``bench.py --update-floor``: re-measure every floor (best-of-N,
     twice each) and rewrite BENCH_FLOOR.json with the LOWER of the two
@@ -1906,7 +1995,8 @@ def update_floor(allow_lower: bool = False) -> int:
                  "convergence_s", "migrations_done",
                  "migrations_rolled_back", "zero_loss",
                  "clients", "gates", "bytes_per_client_s",
-                 "full_equiv_bytes_per_client_s", "bytes_reduction")
+                 "full_equiv_bytes_per_client_s", "bytes_reduction",
+                 "scenario", "engine", "seed", "invariants")
     # Per-floor default tolerance for NEW entries (existing entries keep
     # theirs): multigame is timing-quantized (planning rounds + report
     # cycles dominate its convergence time), so its gate is deliberately
@@ -1915,6 +2005,7 @@ def update_floor(allow_lower: bool = False) -> int:
     tolerances = {"multigame": 0.5, "fanout_massive": 0.4}
     for key, fn in (("pinned", _pinned_floor_tier1_env),
                     ("sharded", _sharded_floor_tier1_env),
+                    ("scenario_hotspot", _scenario_floor_tier1_env),
                     ("fanout", bench_fanout),
                     ("fanout_multi", bench_fanout_multi),
                     ("fanout_massive", bench_fanout_massive),
@@ -1952,6 +2043,7 @@ def update_floor(allow_lower: bool = False) -> int:
     print(json.dumps({"updated": PINNED_FLOOR_FILE,
                       "pinned": spec["pinned"]["floor"],
                       "sharded": spec["sharded"]["floor"],
+                      "scenario_hotspot": spec["scenario_hotspot"]["floor"],
                       "fanout": spec["fanout"]["floor"],
                       "fanout_multi": spec["fanout_multi"]["floor"],
                       "fanout_massive": spec["fanout_massive"]["floor"],
@@ -2080,6 +2172,21 @@ def bench_fused() -> dict:
 def main() -> int:
     if "--update-floor" in sys.argv[1:]:
         return update_floor(allow_lower="--allow-lower" in sys.argv[1:])
+    if "--list-scenarios" in sys.argv[1:]:
+        return list_scenarios()
+    if "--scenario" in sys.argv[1:]:
+        # Takes an argument, so it lives outside the flag table below;
+        # same regression-gate conventions (one JSON line, rc 0).
+        try:
+            result = bench_scenario()
+        except Exception:
+            result = {
+                "metric": "scenario_updates_per_sec", "value": 0.0,
+                "unit": "entity-updates/sec",
+                "error": traceback.format_exc(limit=4),
+            }
+        print(json.dumps(result, separators=(",", ":")))
+        return 0
     for flag, fn, metric, unit in (
         ("--fused", bench_fused,
          "fused_entity_logic_collapse", "x"),

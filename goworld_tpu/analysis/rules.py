@@ -478,6 +478,14 @@ HOT_PATHS: dict[str, set[str]] = {
     "goworld_tpu/parallel/mesh.py": {
         "_sharded_step_fused",
     },
+    # Scenario matrix (ISSUE 16): each scenario's per-tick world update
+    # runs every scenario tick and must stay vectorized numpy — the
+    # bounded per-op service loop lives in service_heavy._issue_ops,
+    # outside the guarded set by design (64 ops/tick by config, not
+    # O(entities)).
+    "goworld_tpu/scenarios/battle_royale.py": {"tick"},
+    "goworld_tpu/scenarios/hotspot.py": {"tick"},
+    "goworld_tpu/scenarios/service_heavy.py": {"tick"},
 }
 
 

@@ -23,6 +23,8 @@ from goworld_tpu.chaos.harness import (  # noqa: F401
     FlakyBackend,
     dropped_packet_count,
     run_chaos,
+    scenario_battle_royale_freeze_restore,
+    scenario_battle_royale_kill_game,
     scenario_dispatcher_restart,
     scenario_game_kill_recreate,
     scenario_gate_kill_reconnect,

@@ -751,6 +751,176 @@ async def scenario_gate_kill_reconnect(
             "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
 
 
+async def _royale_collapse(cluster: ChaosCluster, t_from: int, t_to: int,
+                           ticks: int, r0: float = 400.0,
+                           rf: float = 10.0) -> None:
+    """Drive every live ChaosAvatar along the battle-royale shrinking
+    ring (scenarios/battle_royale.py zone math — the SAME scenario
+    definition the bench engines run, here moving real entities through
+    real AOI).  Avatars are indexed by sorted eid so a respawned fleet
+    resumes the collapse deterministically."""
+    from goworld_tpu.entity import entity_manager as em
+    from goworld_tpu.scenarios.battle_royale import royale_ring_positions
+
+    for t in range(t_from, t_to):
+        avs = sorted(
+            (e for e in em.entities().values()
+             if e.typename == "ChaosAvatar"), key=lambda e: e.id)
+        ring = royale_ring_positions(
+            len(avs), t, ticks, (0.0, 0.0), r0, rf)
+        for a, (x, z) in zip(avs, ring):
+            a.set_position(Vector3(x, 0.0, z))
+        # One sync interval per zone tick: AOI diffs + position syncs
+        # flow to the strict bots between moves.
+        await asyncio.sleep(cluster.sync_interval)
+
+
+def _royale_edges(cluster: ChaosCluster) -> int:
+    """Directed interest-edge count across the live avatar fleet."""
+    from goworld_tpu.entity import entity_manager as em
+
+    return sum(len(e.interested_by) for e in em.entities().values()
+               if e.typename == "ChaosAvatar")
+
+
+async def scenario_battle_royale_kill_game(
+    cluster: ChaosCluster, ticks: int = 16, recovery_deadline: float = 20.0,
+) -> dict:
+    """The battle-royale workload on LIVE avatars crossed with a game
+    crash: the boot cluster (full mutual interest) scatters onto the wide
+    zone ring — a mass LEAVE wave, every edge dissolved — then the zone
+    collapse begins; mid-collapse the game is killed and recreated cold,
+    the clients reconnect onto fresh avatars, and the collapse resumes to
+    the endgame disc — the mass ENTER wave back to full mutual interest.
+    Census conserved at exactly n_bots, zero strict-bot errors, and the
+    aggregated /cluster view re-converges with zero alerts."""
+    n = cluster.n_bots
+    await cluster.assert_rpc_roundtrip()
+    assert _royale_edges(cluster) == n * (n - 1), (
+        "boot fleet not fully mutually interested")
+    # Scatter: ring spacing at the full zone exceeds AOI_DISTANCE.
+    await _royale_collapse(cluster, 0, 2, ticks)
+    scattered = _royale_edges(cluster)
+    assert scattered == 0, (
+        f"mass leave wave incomplete: {scattered} interest edges survive "
+        f"the scatter onto the wide ring")
+    await _royale_collapse(cluster, 2, ticks // 2, ticks)
+    await cluster.kill_game()
+    t0 = time.monotonic()
+    await cluster.restart_game()
+    # The dead incarnation's clients reconnect, exactly like a real crash.
+    await cluster.close_bots()
+    await cluster._spawn_bots()
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never recovered after game kill mid-royale")
+    # Resume the collapse on the fresh fleet, down to the endgame disc.
+    await _royale_collapse(cluster, ticks // 2, ticks, ticks)
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    recovery = time.monotonic() - t0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across royale game kill: {errors[:5]}"
+    assert cluster.live_avatars() == n, (
+        f"royale census broken: {cluster.live_avatars()} != {n}")
+    endgame = _royale_edges(cluster)
+    assert endgame == n * (n - 1), (
+        f"mass enter wave incomplete: {endgame} edges at the endgame disc, "
+        f"expected full mutual interest {n * (n - 1)}")
+    converge = await cluster.assert_cluster_view_converged()
+    _RECOVERY.labels("battle_royale_kill_game", cluster.transport).set(
+        recovery)
+    return {"scenario": "battle_royale_kill_game",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3),
+            "cluster_view_converge_s": round(converge, 3),
+            "endgame_edges": endgame, "bot_errors": len(errors)}
+
+
+async def scenario_battle_royale_freeze_restore(
+    cluster: ChaosCluster, ticks: int = 16, recovery_deadline: float = 20.0,
+) -> dict:
+    """The battle-royale collapse crossed with a freeze→restore reload
+    (the SIGHUP hot-reload path): mid-collapse the game freezes to
+    ``game<N>_freezed.dat`` and exits rc 2, the world is wiped (process
+    death analog, registry kept), and a ``restore=True`` GameService
+    resurrects every avatar — same eids, same positions, same column
+    attrs, client bindings reattached quietly while the bots stay
+    connected to the gate.  The collapse then resumes on the RESTORED
+    fleet to full endgame interest; census conserved, zero strict-bot
+    errors, /cluster re-converges alert-free."""
+    import os
+
+    from goworld_tpu.entity import entity_manager as em
+
+    n = cluster.n_bots
+    await cluster.assert_rpc_roundtrip()
+    await _royale_collapse(cluster, 0, ticks // 2, ticks)
+    frozen = {
+        e.id: (e.position.x, e.position.z, e.attrs.get_int("pings"))
+        for e in em.entities().values() if e.typename == "ChaosAvatar"}
+    assert len(frozen) == n
+    # The freeze file lands in cwd (game/service.py freeze_filename) —
+    # point cwd at the run dir for the freeze->restore window.
+    prev_cwd = os.getcwd()
+    os.chdir(cluster.run_dir)
+    try:
+        cluster.game.start_freeze()
+        rc = await asyncio.wait_for(cluster._game_task, timeout=15)
+        assert rc == 2, f"freeze exit code {rc} != 2"
+        t0 = time.monotonic()
+        # Process-death analog: wipe the world, keep the type registry.
+        em.reset_world()
+        _Holder.arena = None
+        _Holder.joined = 0
+        cluster.game = GameService(1, cluster.cfg, restore=True)
+        cluster._game_task = asyncio.get_running_loop().create_task(
+            cluster.game.run_async())
+        await cluster._wait(lambda: cluster.game.deployment_ready, 15.0,
+                            "restored game never became ready")
+    finally:
+        os.chdir(prev_cwd)
+    # Restore re-creates spaces without on_space_created: re-point the
+    # holder at the resurrected arena (and keep spawn offsets moving).
+    for e in em.entities().values():
+        if isinstance(e, ChaosSpace) and e.kind == 1:
+            _Holder.arena = e
+    assert _Holder.arena is not None, "arena space did not survive restore"
+    _Holder.joined = n
+    await cluster._wait(cluster.links_up, recovery_deadline,
+                        "links never recovered after freeze restore")
+    # Same avatars, not replacements: eids, positions and the pings
+    # column attr all survived the reload.
+    restored = {
+        e.id: (e.position.x, e.position.z, e.attrs.get_int("pings"))
+        for e in em.entities().values() if e.typename == "ChaosAvatar"}
+    assert restored.keys() == frozen.keys(), (
+        "avatar identity not conserved across freeze restore")
+    for eid, (x, z, pings) in frozen.items():
+        rx, rz, rpings = restored[eid]
+        assert abs(rx - x) < 1e-6 and abs(rz - z) < 1e-6, (
+            f"{eid}: position drifted across restore")
+        assert rpings == pings, f"{eid}: pings column lost across restore"
+    # Resume the collapse on the restored fleet.
+    await _royale_collapse(cluster, ticks // 2, ticks, ticks)
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    recovery = time.monotonic() - t0
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across freeze restore: {errors[:5]}"
+    assert cluster.live_avatars() == n, (
+        f"royale census broken: {cluster.live_avatars()} != {n}")
+    endgame = _royale_edges(cluster)
+    assert endgame == n * (n - 1), (
+        f"endgame interest incomplete after restore: {endgame} != "
+        f"{n * (n - 1)}")
+    converge = await cluster.assert_cluster_view_converged()
+    _RECOVERY.labels("battle_royale_freeze_restore", cluster.transport).set(
+        recovery)
+    return {"scenario": "battle_royale_freeze_restore",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3),
+            "cluster_view_converge_s": round(converge, 3),
+            "endgame_edges": endgame, "bot_errors": len(errors)}
+
+
 def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
               transport: str = "tcp") -> dict:
     """Run the single-cluster scenario suite (``bench.py --chaos``;
@@ -780,6 +950,11 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
             scenario_storage_outage,
             scenario_game_kill_recreate,
             scenario_gate_kill_reconnect,
+            # Scenario-matrix workloads (ISSUE 16) crossed with faults:
+            # the battle-royale collapse on live avatars under a game
+            # kill and under a freeze->restore reload.
+            scenario_battle_royale_kill_game,
+            scenario_battle_royale_freeze_restore,
         )
         try:
             for fn in scenario_fns:

@@ -347,6 +347,23 @@ class TelemetryConfig:
 
 
 @dataclasses.dataclass
+class ScenarioConfig:
+    """Scenario-matrix runner knobs (``[scenario]``; goworld_tpu/
+    scenarios/).  These parameterize DEVELOPMENT runs only — bench.py's
+    gate mode always passes the registry's fixed config + seed so
+    committed floors never drift with an operator's ini."""
+
+    # Seed for ad-hoc scenario runs (the registry's per-scenario fixed
+    # seed is used when < 0).
+    seed: int = -1
+    # Engine ad-hoc runs default to: batched | sharded.
+    default_engine: str = "batched"
+    # Multiplier on each scenario's tick count for ad-hoc soak/smoke
+    # runs (1.0 = the registered length; floors always use 1.0).
+    ticks_scale: float = 1.0
+
+
+@dataclasses.dataclass
 class LogConfig:
     """Process-wide logging knobs (``[log]``)."""
 
@@ -376,6 +393,7 @@ class GoWorldConfig:
     rebalance: RebalanceConfig = dataclasses.field(default_factory=RebalanceConfig)
     client: ClientConfig = dataclasses.field(default_factory=ClientConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
 
@@ -601,6 +619,13 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             cluster_snapshot_interval=float(
                 s.get("cluster_snapshot_interval", 1.0)),
             retrace_warm_ticks=int(s.get("retrace_warm_ticks", 32)),
+        )
+    if cp.has_section("scenario"):
+        s = cp["scenario"]
+        cfg.scenario = ScenarioConfig(
+            seed=int(s.get("seed", -1)),
+            default_engine=s.get("default_engine", "batched"),
+            ticks_scale=float(s.get("ticks_scale", 1.0)),
         )
     if cp.has_section("log"):
         cfg.log = LogConfig(
@@ -850,6 +875,14 @@ def _validate(cfg: GoWorldConfig) -> None:
             "(0 = no cluster collector)")
     if t.retrace_warm_ticks < 1:
         raise ValueError("[telemetry] retrace_warm_ticks must be >= 1")
+    sc = cfg.scenario
+    if sc.default_engine not in ("batched", "sharded"):
+        raise ValueError(
+            f"[scenario] default_engine must be batched|sharded, "
+            f"got {sc.default_engine!r}")
+    if not (0.0 < sc.ticks_scale <= 100.0):
+        raise ValueError(
+            "[scenario] ticks_scale must be in (0, 100]")
     if cfg.log.format not in ("text", "json"):
         raise ValueError(
             f"[log] format must be text|json, got {cfg.log.format!r}")

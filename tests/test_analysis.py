@@ -687,6 +687,19 @@ def test_r6_covers_sync_section():
         "near_ratio", "far_ratio", "retier_interval"}
 
 
+def test_r6_covers_scenario_keys():
+    """ISSUE 16 satellite: the [scenario] keys are documented in the
+    sample AND consumed by read_config — inside R6's coverage, so future
+    drift in either direction fails the gate."""
+    import os
+
+    from goworld_tpu.analysis.rules import _sample_keys
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fams, _lines = _sample_keys(root)
+    assert fams["scenario"] >= {"seed", "default_engine", "ticks_scale"}
+
+
 # --- suppression mechanics ---------------------------------------------------
 
 

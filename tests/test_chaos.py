@@ -17,6 +17,8 @@ import pytest
 
 from goworld_tpu.chaos import (
     ChaosCluster,
+    scenario_battle_royale_freeze_restore,
+    scenario_battle_royale_kill_game,
     scenario_dispatcher_restart,
     scenario_game_kill_recreate,
     scenario_gate_kill_reconnect,
@@ -134,6 +136,35 @@ def test_migrate_during_dispatcher_restart_uds(tmp_path):
     assert phase["bot_errors"] == 0
     assert (phase["migrations_done"]
             + phase["migrations_rolled_back"]) >= 0
+
+
+def test_battle_royale_kill_game(tmp_path):
+    """ISSUE 16: the battle-royale scenario (the SAME zone math the bench
+    engines run) driving live avatars through real AOI, crossed with a
+    game kill+recreate mid-collapse.  The scenario itself asserts the
+    mass leave wave (scatter dissolves every edge), the mass enter wave
+    (endgame restores full mutual interest on the reconnected fleet),
+    census == n_bots, zero strict-bot errors, and an alert-free
+    re-converged /cluster view."""
+    r = _run(scenario_battle_royale_kill_game, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["recovery_s"] < 20.0
+    assert r["endgame_edges"] == 12 * 11
+    assert r["cluster_view_converge_s"] < 20.0
+
+
+def test_battle_royale_freeze_restore(tmp_path):
+    """ISSUE 16: the battle-royale collapse crossed with the SIGHUP
+    freeze→restore reload.  The scenario asserts rc 2, then that the
+    RESTORED fleet is the same one — eids, positions and the pings slab
+    column conserved bit-for-bit — before resuming the collapse to full
+    endgame interest with the bots connected throughout; census
+    conserved, zero strict errors, /cluster alert-free."""
+    r = _run(scenario_battle_royale_freeze_restore, run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["recovery_s"] < 20.0
+    assert r["endgame_edges"] == 12 * 11
+    assert r["cluster_view_converge_s"] < 20.0
 
 
 def test_storage_outage_circuit(tmp_path):

@@ -92,6 +92,11 @@ SAMPLE = textwrap.dedent(
     near_ratio = 0.4
     far_ratio = 0.9
     retier_interval = 6
+
+    [scenario]
+    seed = 7
+    default_engine = sharded
+    ticks_scale = 0.5
     """
 )
 
@@ -327,6 +332,46 @@ def test_sync_validation_rejects(tmp_path, body, msg):
     p = tmp_path / "g.ini"
     p.write_text("[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
                  "[dispatcher1]\nport = 14001\n[sync]\n" + body + "\n")
+    read_config.set_config_file(str(p))
+    try:
+        with pytest.raises(ValueError, match=msg):
+            read_config.get()
+    finally:
+        read_config.set_config_file(None)
+
+
+def test_scenario_section(cfg):
+    """[scenario] ad-hoc scenario-run knobs (ISSUE 16) parse with exact
+    types; bench.py's gate mode never reads them."""
+    sc = cfg.scenario
+    assert sc.seed == 7
+    assert sc.default_engine == "sharded"
+    assert sc.ticks_scale == 0.5
+
+
+def test_scenario_defaults_when_absent(tmp_path):
+    p = tmp_path / "g.ini"
+    p.write_text("[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
+                 "[dispatcher1]\nport = 14001\n")
+    read_config.set_config_file(str(p))
+    try:
+        sc = read_config.get().scenario
+        assert sc.seed == -1  # negative = the registry's fixed seed
+        assert sc.default_engine == "batched"
+        assert sc.ticks_scale == 1.0
+    finally:
+        read_config.set_config_file(None)
+
+
+@pytest.mark.parametrize("body,msg", [
+    ("default_engine = pallas", "default_engine"),
+    ("ticks_scale = 0", "ticks_scale"),
+    ("ticks_scale = 200", "ticks_scale"),
+])
+def test_scenario_validation_rejects(tmp_path, body, msg):
+    p = tmp_path / "g.ini"
+    p.write_text("[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
+                 "[dispatcher1]\nport = 14001\n[scenario]\n" + body + "\n")
     read_config.set_config_file(str(p))
     try:
         with pytest.raises(ValueError, match=msg):
