@@ -1500,21 +1500,23 @@ def bench_trace_overhead() -> dict:
 # --- chaos: fault-injection suite over a live in-process cluster -------------
 
 CHAOS_CONFIG = {"dispatchers": 2, "bots": 12, "multigame_bots": 12,
-                "scenarios_per_transport": 9}
+                "scenarios_per_transport": 10}
 
 
 def bench_chaos() -> dict:
     """``bench.py --chaos``: the full chaos scenario suite — dispatcher
     kill+restart, severed link, stalled-past-heartbeat dispatcher, storage
-    outage, GAME kill+recreate, GATE kill (client reconnect wave), the
-    battle-royale collapse under a game kill and under a freeze->restore
-    reload (scenario-matrix workloads on live avatars, ISSUE 16), and
-    migrate-during-dispatcher-restart (on the 2-game multigame cluster) —
-    run ONCE PER CLUSTER TRANSPORT (tcp, then uds): fault semantics must
-    be transport-identical, and each scenario asserts zero bot errors /
-    zero entity loss / in-deadline recovery either way.
+    outage, the service-heavy storage outage UNDER a dispatcher restart
+    (ISSUE 18 catalog cross), GAME kill+recreate, GATE kill (client
+    reconnect wave), the battle-royale collapse under a game kill and
+    under a freeze->restore reload (scenario-matrix workloads on live
+    avatars, ISSUE 16), and migrate-during-dispatcher-restart (on the
+    2-game multigame cluster) — run ONCE PER CLUSTER TRANSPORT (tcp, then
+    uds): fault semantics must be transport-identical, and each scenario
+    asserts zero bot errors / zero entity loss / in-deadline recovery
+    either way.
 
-    Value = total scenarios passed across both transports (18 = all
+    Value = total scenarios passed across both transports (20 = all
     green). The headline carries a per-scenario map of recovery time and
     bot-error count; failures are named per scenario in ``failures`` and
     make the PROCESS exit non-zero (deviation from the headline-bench
@@ -1624,6 +1626,46 @@ def bench_multigame() -> dict:
         "config": dict(c),
         "platform": "cpu",
         "floor_file": PINNED_FLOOR_FILE,
+    }
+    out.update(r)
+    return out
+
+
+# FIXED config of the ISSUE 18 whole-space chaos run: 3 game
+# subprocesses, receivers booted ARENA-LESS (no same-kind space → the
+# planner can only balance by moving WHOLE spaces through the two-phase
+# handoff), the planner re-hosted in the sharded RebalancePlannerService,
+# and the three kill crosses — receiver mid-PREPARE, donor mid-COMMIT
+# (the in-flight payload is the space's one live copy), planner host
+# (evacuate → SIGKILL → kvreg failover → survivors resume). Not a
+# committed floor: the value is scenarios passed (robustness gate, like
+# --chaos), with recovery/failover timings in the headline.
+MULTIGAME_SPACES_CONFIG = {
+    "bots": 12, "games": 3, "dispatchers": 2, "transport": "tcp",
+}
+
+
+def bench_multigame_spaces() -> dict:
+    """``bench.py --multigame-spaces``: the whole-space migration chaos
+    run at the fixed config above. Exercised by tier-1
+    (tests/test_chaos.py::test_multigame_spaces_kill_crosses)."""
+    import tempfile
+
+    from goworld_tpu.chaos.multigame import run_multigame_spaces
+
+    c = MULTIGAME_SPACES_CONFIG
+    with tempfile.TemporaryDirectory(prefix="bench_multigame_sp_") as d:
+        r = run_multigame_spaces(d, n_bots=c["bots"], n_games=c["games"],
+                                 transport=c["transport"])
+    phases = r.get("phases", {})
+    passed = sum(1 for p in phases.values()
+                 if p.get("zero_loss") and not p.get("bot_errors"))
+    out = {
+        "metric": "multigame_space_kill_crosses_passed",
+        "value": float(passed),
+        "unit": "scenarios",
+        "config": dict(c),
+        "platform": "cpu",
     }
     out.update(r)
     return out
@@ -2200,6 +2242,8 @@ def main() -> int:
          "fanout_massive_sync_records_per_sec", "sync-records/sec"),
         ("--fanout", bench_fanout,
          "fanout_sync_records_per_sec", "sync-records/sec"),
+        ("--multigame-spaces", bench_multigame_spaces,
+         "multigame_space_kill_crosses_passed", "scenarios"),
         ("--multigame", bench_multigame,
          "multigame_rebalance_entities_per_sec", "entities/sec"),
         ("--chaos", bench_chaos,

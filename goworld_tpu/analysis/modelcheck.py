@@ -57,6 +57,12 @@ MUTANTS = (
     "no_sync_parking",    # syncs for a blocked (migrating) entity route anyway
     "skip_gen_check",     # gate-restart detach ignores the valid generation
     "drop_boot_no_game",  # boot with no connected game dropped, not buffered
+    # -- space-migration rules (SpaceMigrateModel) --
+    "no_space_bounce",    # dead-target SPACE_MIGRATE_DATA dropped, not bounced
+    "no_space_park",      # PREPARE skips parking the members' streams
+    "no_freeze_cancel_member",  # freeze keeps members' pending entity migrates
+    "no_unfreeze_on_abort",     # abort leaves the space FROZEN forever
+    "no_frozen_join_guard",     # a join lands in a FROZEN space instead of queueing
 )
 
 
@@ -749,6 +755,534 @@ class BootFlapModel(Model):
         return ()
 
 
+# --- the whole-space migration model -----------------------------------------
+#
+# One space "S" with one member "M" on game 1, one dispatcher, one
+# handoff toward game 2, one joiner "J" trying to enter mid-flight.  The
+# protocol is freeze-fence + fat transfer with bounce-home: the donor
+# freezes membership, broadcasts SPACE_MIGRATE_PREPARE so every owning
+# dispatcher parks the members' streams and acks on the SAME FIFO the
+# parked traffic rode (the freeze-ack fence of game/service.py), packs
+# the snapshot only after every ack (so nothing sent pre-park can be
+# lost), and ships one SPACE_MIGRATE_DATA that is routed exactly like
+# REAL_MIGRATE — buffer behind a grace window, bounce HOME to the donor
+# on a dead target.  COMMIT is successful restore + NOTIFY_CREATE
+# rerouting; ABORT is the donor deadline (or a dead-target reply)
+# unfreezing in place.  I1/I2/I3 extend verbatim to the space copy and
+# the member; I4 (gate generations) is untouched by this protocol.
+#
+# Scope honesty: one dispatcher stands in for the all-dispatcher
+# broadcast (per-dispatcher behavior is symmetric and the fence is
+# per-FIFO); the donor game never crashes (after DATA leaves, the donor
+# holds nothing — chaos covers donor kills); space-targeted RPC parking
+# is not modeled (members' sync traffic is the load-bearing case).
+
+S_PREP_M = ("SPACE_MIGRATE_PREPARE", "members=M")
+S_PREP_0 = ("SPACE_MIGRATE_PREPARE", "members=")
+S_PACKACK = ("SPACE_MIGRATE_PREPARE_ACK",)
+S_DATA = ("SPACE_MIGRATE_DATA",)
+S_ABORT_G = ("SPACE_MIGRATE_ABORT", "from_game")
+S_ABORT_D = ("SPACE_MIGRATE_ABORT", "from_dispatcher")
+S_CREATE = ("NOTIFY_CREATE_SPACE",)
+SM_CREATE = ("NOTIFY_CREATE_MEMBER",)
+SM_JOIN = ("JOIN_SPACE",)
+
+
+class SpaceMigState(NamedTuple):
+    g_alive: tuple[bool, bool]
+    g_space: tuple[str, str]   # none | live | frozen
+    sm: str        # donor handoff: idle|preparing|sent|aborted|rolled
+    mm: str        # member entity-migrate: idle|requested|cancelled|sent
+    m_members: bool            # M is in S's (frozen) membership/snapshot
+    m_solo: int                # 0, or the game hosting M standalone
+    links: tuple[str, str]
+    s_route: int
+    m_route: int
+    m_blocked: bool
+    m_parked: Chan
+    j: str   # out|pending|queued|in_frozen|in|dropped|destroyed
+    gpending: tuple[Chan, Chan]
+    to_g: tuple[Chan, Chan]
+    from_g: tuple[Chan, Chan]
+    crashes_left: int
+    restarts_left: int
+    syncs_left: int
+    joins_left: int
+    cancels_left: int
+    migrates_left: int
+    member_migrates_left: int
+    crash_lost: bool
+
+
+@dataclasses.dataclass(frozen=True)
+class SpaceMigConfig:
+    name: str = "space_handoff"
+    crashes: int = 1           # crash budget for game 2 (the receiver)
+    restarts: int = 1
+    syncs: int = 1             # member-position syncs injected at D
+    joins: int = 1             # joiner enter-space attempts
+    cancels: int = 1           # donor deadline-abort budget
+    migrates: int = 1          # whole-space handoff attempts
+    member_migrates: int = 0   # member's own entity-migrate attempts
+    mutants: frozenset[str] = frozenset()
+
+
+class SpaceMigrateModel(Model):
+    """rebalance/migrator.py space states + dispatcher parking +
+    entity_manager pack/restore, reduced to the fate of S, M and J
+    under every interleaving."""
+
+    def __init__(self, cfg: SpaceMigConfig) -> None:
+        bad = cfg.mutants - set(MUTANTS)
+        if bad:
+            raise ValueError(f"unknown mutants {sorted(bad)}")
+        self.cfg = cfg
+        self.name = cfg.name
+
+    def initial(self) -> SpaceMigState:
+        cfg = self.cfg
+        return SpaceMigState(
+            g_alive=(True, True), g_space=("live", "none"),
+            sm="idle", mm="idle", m_members=True, m_solo=0,
+            links=(LINK_CONN, LINK_CONN), s_route=1, m_route=1,
+            m_blocked=False, m_parked=(), j="out",
+            gpending=((), ()), to_g=((), ()), from_g=((), ()),
+            crashes_left=cfg.crashes, restarts_left=cfg.restarts,
+            syncs_left=cfg.syncs, joins_left=cfg.joins,
+            cancels_left=cfg.cancels, migrates_left=cfg.migrates,
+            member_migrates_left=cfg.member_migrates, crash_lost=False)
+
+    # -- shared sub-rules ---------------------------------------------------
+
+    def _deliver(self, s: SpaceMigState, gi: int, msg: Msg
+                 ) -> SpaceMigState:
+        link = s.links[gi]
+        if link == LINK_CONN:
+            return s._replace(to_g=_put(s.to_g, gi, msg))
+        if link in (LINK_GRACE, LINK_UNREG):
+            return s._replace(gpending=_put(s.gpending, gi, msg))
+        return s
+
+    def _flush_m(self, s: SpaceMigState, gi: int) -> SpaceMigState:
+        out = s
+        for msg in s.m_parked:
+            out = self._deliver(out, gi, msg)
+        return out._replace(m_parked=(), m_blocked=False)
+
+    def _s_copies(self, s: SpaceMigState) -> int:
+        chans: Iterable[Chan] = (*s.to_g, *s.from_g, *s.gpending)
+        in_flight = sum(1 for c in chans for m in c if m == S_DATA)
+        return sum(1 for g in s.g_space if g in ("live", "frozen")) \
+            + in_flight
+
+    def _m_copies(self, s: SpaceMigState) -> int:
+        chans: Iterable[Chan] = (*s.to_g, *s.from_g, *s.gpending)
+        rmig = sum(1 for c in chans for m in c if m == M_RMIG)
+        inside = self._s_copies(s) if s.m_members else 0
+        return inside + (1 if s.m_solo else 0) + rmig
+
+    def _m_hosted(self, s: SpaceMigState, gi: int) -> bool:
+        return (s.m_members and s.g_space[gi] in ("live", "frozen")) \
+            or s.m_solo == gi + 1
+
+    def _unfreeze(self, s: SpaceMigState) -> SpaceMigState:
+        """Abort-in-place: space back to live, queued joins replay
+        (Space._pending_enters replay on unfreeze)."""
+        if "no_unfreeze_on_abort" in self.cfg.mutants:
+            return s
+        nxt = s._replace(g_space=("live", s.g_space[1]))
+        if nxt.j in ("queued", "in_frozen"):
+            nxt = nxt._replace(j="in")
+        return nxt
+
+    # -- actions ------------------------------------------------------------
+
+    def actions(self, st: State) -> list[Step]:
+        assert isinstance(st, SpaceMigState)
+        s = st
+        cfg = self.cfg
+        steps: list[Step] = []
+
+        # planner command lands: freeze S, cancel members' pending
+        # entity migrates (frozen membership IS the pack list), send
+        # PREPARE to the dispatcher
+        if (s.migrates_left and s.sm == "idle" and s.g_alive[0]
+                and s.g_space[0] == "live"):
+            # PREPARE carries the freeze-time member list: a member
+            # that already migrated out is not parked
+            prep = S_PREP_M if s.m_members else S_PREP_0
+            nxt = s._replace(
+                sm="preparing", g_space=("frozen", s.g_space[1]),
+                migrates_left=s.migrates_left - 1,
+                from_g=_put(s.from_g, 0, prep))
+            if (s.mm == "requested"
+                    and "no_freeze_cancel_member" not in cfg.mutants):
+                nxt = nxt._replace(mm="cancelled")
+            steps.append(Step(
+                "game1: freeze S (cancel member migrates) -> "
+                "SPACE_MIGRATE_PREPARE", nxt))
+
+        # member M starts its own entity migrate (only while the space
+        # is live — migrator eligibility skips frozen-space members)
+        if (s.member_migrates_left and s.mm == "idle" and s.m_members
+                and s.g_space[0] == "live" and s.sm == "idle"
+                and s.g_alive[0]):
+            steps.append(Step(
+                "game1: member M sends MIGRATE_REQUEST",
+                s._replace(mm="requested",
+                           member_migrates_left=s.member_migrates_left - 1,
+                           from_g=_put(s.from_g, 0, M_MREQ))))
+
+        # donor deadline while awaiting acks -> ABORT: unfreeze in
+        # place + broadcast the abort so dispatchers unpark
+        if s.cancels_left and s.sm == "preparing":
+            nxt = self._unfreeze(s)._replace(
+                sm="aborted", cancels_left=s.cancels_left - 1,
+                from_g=_put(s.from_g, 0, S_ABORT_G))
+            steps.append(Step(
+                "game1: PREPARE deadline -> abort, unfreeze S in place",
+                nxt))
+
+        # a member-position sync record reaches the dispatcher
+        if s.syncs_left:
+            nxt = s._replace(syncs_left=s.syncs_left - 1)
+            if s.m_blocked:
+                nxt = nxt._replace(m_parked=nxt.m_parked + (M_SYNC,))
+            elif s.m_route == 0:
+                nxt = nxt._replace(m_parked=nxt.m_parked + (M_SYNC,))
+            else:
+                nxt = self._deliver(nxt, s.m_route - 1, M_SYNC)
+            steps.append(Step("gate: SYNC(M) reaches dispatcher", nxt))
+
+        # a joiner's enter-space request reaches the dispatcher and is
+        # routed by S's routing entry
+        if s.joins_left and s.j == "out":
+            nxt = s._replace(joins_left=s.joins_left - 1)
+            if s.s_route == 0 or s.links[s.s_route - 1] == LINK_DEAD:
+                nxt = nxt._replace(j="dropped")  # client retries (legal)
+            else:
+                nxt = self._deliver(
+                    nxt._replace(j="pending"), s.s_route - 1, SM_JOIN)
+            steps.append(Step("client: J requests to join S", nxt))
+
+        # deliver game -> dispatcher
+        for gi in (0, 1):
+            if s.from_g[gi]:
+                msg, from_g = _pop(s.from_g, gi)
+                steps.append(self._dispatcher_handle(
+                    s._replace(from_g=from_g), gi, msg))
+
+        # deliver dispatcher -> game
+        for gi in (0, 1):
+            if s.to_g[gi]:
+                msg, to_g = _pop(s.to_g, gi)
+                steps.append(self._game_handle(
+                    s._replace(to_g=to_g), gi, msg))
+
+        # crash game 2 (the receiver)
+        if s.crashes_left and s.g_alive[1]:
+            lost = (s.g_space[1] in ("live", "frozen")
+                    or s.m_solo == 2
+                    or any(m in (S_DATA, M_RMIG) for m in s.to_g[1]))
+            nxt = s._replace(
+                g_alive=(s.g_alive[0], False),
+                g_space=(s.g_space[0], "none"),
+                m_solo=0 if s.m_solo == 2 else s.m_solo,
+                crashes_left=s.crashes_left - 1,
+                to_g=(s.to_g[0], ()), from_g=(s.from_g[0], ()),
+                links=(s.links[0],
+                       LINK_GRACE if s.links[1] == LINK_CONN
+                       else s.links[1]),
+                crash_lost=s.crash_lost or lost)
+            if s.j == "pending" and SM_JOIN in s.to_g[1]:
+                nxt = nxt._replace(j="dropped")
+            steps.append(Step("game2: CRASH", nxt))
+
+        # cold restart of game 2
+        if s.restarts_left and not s.g_alive[1]:
+            steps.append(Step(
+                "game2: cold restart -> SET_GAME_ID(cold)",
+                s._replace(g_alive=(s.g_alive[0], True),
+                           restarts_left=s.restarts_left - 1,
+                           from_g=_put(s.from_g, 1, M_HSHAKE_COLD))))
+
+        # reconnect-grace expiry on game 2
+        if s.links[1] == LINK_GRACE:
+            steps.append(self._expire_game2(s))
+
+        # park-deadline sweep: parked traffic for a crash-lost member
+        # is dropped (the real block() window has a wall-clock deadline;
+        # a sync for an entity with no live copy is a legal drop)
+        if (s.m_blocked and s.crash_lost and self._m_copies(s) == 0
+                and not s.from_g[0] and not s.from_g[1]):
+            steps.append(Step(
+                "dispatcher: park deadline sweep (member crash-lost)",
+                s._replace(m_blocked=False, m_parked=())))
+
+        # unrouted sweep for M's parked packets (same rule as the
+        # entity model)
+        if (s.m_route == 0 and s.m_parked and not s.m_blocked
+                and not any(SM_CREATE in c for c in s.from_g)):
+            steps.append(Step(
+                "dispatcher: unrouted sweep drops M's parked packets",
+                s._replace(m_parked=())))
+
+        return steps
+
+    # -- dispatcher ---------------------------------------------------------
+
+    def _dispatcher_handle(self, s: SpaceMigState, gi: int, msg: Msg
+                           ) -> Step:
+        g = f"game{gi + 1}"
+        cfg = self.cfg
+        if msg in (S_PREP_M, S_PREP_0):
+            # park every LISTED member stream this dispatcher owns,
+            # then ack on the donor's FIFO — the ack fences all
+            # pre-park traffic
+            if s.links[1] == LINK_DEAD:
+                nxt = self._deliver(s, 0, S_ABORT_D)
+                return Step("dispatcher: PREPARE -> target dead, reply "
+                            "ABORT", nxt)
+            nxt = s
+            if msg == S_PREP_M and "no_space_park" not in cfg.mutants:
+                nxt = nxt._replace(m_blocked=True)
+            nxt = self._deliver(nxt, 0, S_PACKACK)
+            return Step("dispatcher: PREPARE -> park listed members, "
+                        "ack", nxt)
+        if msg == S_ABORT_G:
+            # donor aborted: unpark members, flush to their route
+            nxt = s
+            if s.m_route:
+                nxt = self._flush_m(s, s.m_route - 1)
+            nxt = nxt._replace(m_blocked=False)
+            return Step("dispatcher: space ABORT -> unpark M", nxt)
+        if msg == S_DATA:
+            return self._route_space_data(s)
+        if msg == S_CREATE:
+            return Step(f"dispatcher: {g} NOTIFY_CREATE(S) -> route S",
+                        s._replace(s_route=gi + 1))
+        if msg == SM_CREATE:
+            nxt = self._flush_m(s._replace(m_route=gi + 1), gi)
+            return Step(f"dispatcher: {g} NOTIFY_CREATE(M) -> route M, "
+                        f"flush parked", nxt)
+        if msg == SM_JOIN:
+            # a join bounced off a copy-less game: re-route by S's
+            # current entry (enter_space re-resolution)
+            if s.s_route == 0 or s.links[s.s_route - 1] == LINK_DEAD:
+                return Step("dispatcher: J's join has no routable S -> "
+                            "dropped (client retries)",
+                            s._replace(j="dropped"))
+            nxt = self._deliver(s, s.s_route - 1, SM_JOIN)
+            return Step("dispatcher: re-route J's join", nxt)
+        if msg == M_MREQ:
+            nxt = self._deliver(s._replace(m_blocked=True), 0, M_MACK)
+            return Step("dispatcher: M MIGRATE_REQUEST -> block M, ack",
+                        nxt)
+        if msg == M_RMIG:
+            if s.links[1] in (LINK_CONN, LINK_GRACE, LINK_UNREG):
+                nxt = self._deliver(s._replace(m_route=2), 1, M_RMIG)
+                return Step("dispatcher: REAL_MIGRATE(M) -> game2", nxt)
+            nxt = self._deliver(s._replace(m_route=1), 0, M_RMIG)
+            return Step("dispatcher: REAL_MIGRATE(M) -> target dead, "
+                        "bounce HOME", nxt)
+        if msg == M_HSHAKE_COLD:
+            nxt = s
+            if nxt.s_route == 2:
+                nxt = nxt._replace(s_route=0)
+            if nxt.m_route == 2:
+                nxt = nxt._replace(m_route=0)
+            links = (nxt.links[0], LINK_CONN)
+            flushed = nxt.gpending[1]
+            nxt = nxt._replace(
+                links=links, gpending=(nxt.gpending[0], ()),
+                to_g=_put(nxt.to_g, 1, *flushed))
+            return Step(f"dispatcher: {g} cold handshake -> purge "
+                        f"routes, flush {len(flushed)} buffered", nxt)
+        raise AssertionError(f"unmodeled dispatcher message {msg}")
+
+    def _route_space_data(self, s: SpaceMigState) -> Step:
+        """SPACE_MIGRATE_DATA routes exactly like REAL_MIGRATE: forward,
+        buffer behind grace, or bounce the whole space HOME."""
+        tlink = s.links[1]
+        if tlink in (LINK_CONN, LINK_GRACE, LINK_UNREG):
+            nxt = self._deliver(s._replace(s_route=2), 1, S_DATA)
+            return Step("dispatcher: SPACE_DATA(S) -> route to game2",
+                        nxt)
+        if "no_space_bounce" in self.cfg.mutants:
+            nxt = s._replace(s_route=0)
+            return Step("dispatcher: SPACE_DATA(S) -> target dead, "
+                        "payload DROPPED [mutant]", nxt,
+                        ("space S's last copy dropped at the dispatcher "
+                         "(dead target, no bounce)",))
+        nxt = self._deliver(s._replace(s_route=1), 0, S_DATA)
+        return Step("dispatcher: SPACE_DATA(S) -> target dead, bounce "
+                    "HOME to game1", nxt)
+
+    # -- games --------------------------------------------------------------
+
+    def _game_handle(self, s: SpaceMigState, gi: int, msg: Msg) -> Step:
+        g = f"game{gi + 1}"
+        if msg == S_PACKACK:
+            if gi == 0 and s.sm == "preparing":
+                return self._pack(s)
+            return Step(f"{g}: stale PREPARE_ACK ignored", s)
+        if msg == S_ABORT_D:
+            if gi == 0 and s.sm == "preparing":
+                nxt = self._unfreeze(s)._replace(
+                    sm="aborted", from_g=_put(s.from_g, 0, S_ABORT_G))
+                return Step(f"{g}: dispatcher ABORT -> unfreeze S in "
+                            f"place", nxt)
+            return Step(f"{g}: stale space ABORT ignored", s)
+        if msg == S_DATA:
+            spaces = list(s.g_space)
+            spaces[gi] = "live"
+            sm = "rolled" if gi == 0 else s.sm
+            creates = (S_CREATE,) + ((SM_CREATE,) if s.m_members else ())
+            nxt = s._replace(
+                g_space=(spaces[0], spaces[1]), sm=sm,
+                from_g=_put(s.from_g, gi, *creates))
+            kind = "bounced home (rollback + cooldown)" if gi == 0 \
+                else "arrives"
+            return Step(f"{g}: SPACE_DATA(S) {kind} -> restore live, "
+                        f"NOTIFY_CREATEs", nxt)
+        if msg == M_MACK:
+            if gi == 0 and s.mm == "requested":
+                # membership was fixed at freeze time: a frozen space
+                # still counts M in its snapshot (only reachable under
+                # the no_freeze_cancel_member mutant)
+                members = s.g_space[0] != "live"
+                nxt = s._replace(
+                    mm="sent", m_members=members,
+                    from_g=_put(s.from_g, 0, M_RMIG))
+                return Step(f"{g}: M MIGRATE_REQUEST_ACK -> send "
+                            f"REAL_MIGRATE(M), drop local copy", nxt)
+            return Step(f"{g}: stale MIGRATE_REQUEST_ACK ignored", s)
+        if msg == M_RMIG:
+            nxt = s._replace(m_solo=gi + 1,
+                             from_g=_put(s.from_g, gi, SM_CREATE))
+            return Step(f"{g}: REAL_MIGRATE(M) arrives -> restore, "
+                        f"NOTIFY_CREATE", nxt)
+        if msg == M_SYNC:
+            viols: tuple[str, ...] = ()
+            if not self._m_hosted(s, gi) and self._m_copies(s) >= 1:
+                viols = (f"sync record for M delivered to {g} while M's "
+                         f"live copy is elsewhere (stale-game delivery)",)
+            return Step(f"{g}: SYNC(M) delivered", s, viols)
+        if msg == SM_JOIN:
+            state = s.g_space[gi]
+            if state == "live":
+                return Step(f"{g}: J enters live S", s._replace(j="in"))
+            if state == "frozen":
+                if "no_frozen_join_guard" in self.cfg.mutants:
+                    return Step(f"{g}: J enters FROZEN S [mutant]",
+                                s._replace(j="in_frozen"))
+                return Step(f"{g}: S frozen -> queue J's enter",
+                            s._replace(j="queued"))
+            # no copy here (stale delivery window): bounce to re-route
+            nxt = s._replace(from_g=_put(s.from_g, gi, SM_JOIN))
+            return Step(f"{g}: no S here -> bounce J's join", nxt)
+        raise AssertionError(f"unmodeled game message {msg}")
+
+    def _pack(self, s: SpaceMigState) -> Step:
+        """All dispatcher acks in: pack the frozen membership, destroy
+        the local copies, ship SPACE_MIGRATE_DATA.  Queued joiners are
+        re-dispatched AFTER the data on the same FIFO."""
+        viols: list[str] = []
+        nxt = s._replace(
+            g_space=("none", s.g_space[1]), sm="sent",
+            from_g=_put(s.from_g, 0, S_DATA))
+        if s.j == "queued":
+            nxt = nxt._replace(j="pending",
+                               from_g=_put(nxt.from_g, 0, SM_JOIN))
+        elif s.j == "in_frozen":
+            viols.append(
+                "joiner J entered the FROZEN space and was destroyed by "
+                "the pack (absent from the freeze-time snapshot)")
+            nxt = nxt._replace(j="destroyed")
+        return Step("game1: all PREPARE acks in -> pack S(+M), destroy "
+                    "local, send SPACE_DATA", nxt, tuple(viols))
+
+    def _expire_game2(self, s: SpaceMigState) -> Step:
+        """Grace lapse on the receiver: bounce buffered space payloads
+        (and member migrates) home, drop the rest, purge routes."""
+        nxt = s
+        viols: list[str] = []
+        for msg in s.gpending[1]:
+            if msg == S_DATA:
+                if "no_space_bounce" in self.cfg.mutants:
+                    viols.append("space S's last copy dropped at grace "
+                                 "expiry (no bounce)")
+                    nxt = nxt._replace(s_route=0)
+                else:
+                    nxt = self._deliver(
+                        nxt._replace(s_route=1), 0, S_DATA)
+            elif msg == M_RMIG:
+                nxt = self._deliver(nxt._replace(m_route=1), 0, M_RMIG)
+            elif msg == SM_JOIN:
+                nxt = nxt._replace(j="dropped")
+            # parked syncs etc. drop with the window
+        nxt = nxt._replace(gpending=(nxt.gpending[0], ()),
+                           links=(nxt.links[0], LINK_DEAD))
+        if nxt.s_route == 2:
+            nxt = nxt._replace(s_route=0)
+        if nxt.m_route == 2:
+            nxt = nxt._replace(m_route=0)
+        return Step("dispatcher: game2 grace window expires -> declared "
+                    "dead", nxt, tuple(viols))
+
+    # -- invariants ---------------------------------------------------------
+
+    def state_invariants(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, SpaceMigState)
+        s = st
+        out: list[str] = []
+        sc = self._s_copies(s)
+        if sc > 1:
+            out.append(f"space S duplicated: {sc} live copies")
+        if sc == 0 and not s.crash_lost:
+            out.append("space S vanished with no crash to blame")
+        mc = self._m_copies(s)
+        if mc > 1:
+            out.append(f"member M duplicated: {mc} live copies")
+        if mc == 0 and not s.crash_lost:
+            out.append("member M vanished with no crash to blame")
+        return tuple(out)
+
+    def terminal_violations(self, st: State) -> tuple[str, ...]:
+        assert isinstance(st, SpaceMigState)
+        s = st
+        out: list[str] = []
+        if "frozen" in s.g_space:
+            out.append("terminal state: space S FROZEN forever — "
+                       "abort/commit never unfroze it")
+        hosted_live = any(s.g_space[i] == "live" and s.g_alive[i]
+                          for i in (0, 1))
+        if not hosted_live and not s.crash_lost:
+            out.append("terminal state: S is not live on any live game")
+        if s.sm == "preparing":
+            out.append("terminal state: handoff wedged in PREPARE")
+        if s.s_route and s.g_space[s.s_route - 1] == "none":
+            out.append(f"terminal state: stale routing-table entry — S "
+                       f"routed to game{s.s_route} which does not host "
+                       f"it")
+        if (s.m_route and not self._m_hosted(s, s.m_route - 1)
+                and not s.crash_lost):
+            out.append(f"terminal state: stale routing-table entry — M "
+                       f"routed to game{s.m_route} which does not host "
+                       f"it")
+        if any(S_DATA in gp or M_RMIG in gp for gp in s.gpending):
+            out.append("terminal state: migrate payload stuck in a "
+                       "dispatcher buffer forever")
+        if s.m_blocked and not s.crash_lost:
+            out.append("terminal state: M's stream parked forever")
+        if s.m_parked and not s.crash_lost:
+            out.append("terminal state: M's parked packets never "
+                       "flushed")
+        if s.j in ("pending", "queued", "in_frozen"):
+            out.append(f"terminal state: joiner J stuck ({s.j})")
+        return tuple(out)
+
+
 # --- entry points ------------------------------------------------------------
 
 
@@ -765,6 +1299,14 @@ def tier1_configs() -> list[Model]:
                                     restarts=0)),
         GateGenerationModel(GateGenConfig()),
         BootFlapModel(BootConfig()),
+        # whole-space handoff: crash/restart/expiry x abort-deadline x
+        # member sync parking x joiner queueing
+        SpaceMigrateModel(SpaceMigConfig()),
+        # the member-migrates-while-the-space-moves race (freeze must
+        # cancel the member's in-flight entity migrate)
+        SpaceMigrateModel(SpaceMigConfig(
+            name="space_member_race", crashes=0, restarts=0, joins=0,
+            member_migrates=1)),
     ]
 
 
@@ -778,6 +1320,8 @@ def deep_configs() -> list[Model]:
         MigrateCrashModel(MigConfig(
             name="migrate_unknown_deep", target_unregistered=True,
             crashes=1, restarts=2, syncs=2)),
+        SpaceMigrateModel(SpaceMigConfig(
+            name="space_handoff_deep", syncs=2, member_migrates=1)),
     ]
 
 

@@ -486,6 +486,17 @@ HOT_PATHS: dict[str, set[str]] = {
     "goworld_tpu/scenarios/battle_royale.py": {"tick"},
     "goworld_tpu/scenarios/hotspot.py": {"tick"},
     "goworld_tpu/scenarios/service_heavy.py": {"tick"},
+    # Whole-space handoff (ISSUE 18): the snapshot/restore bodies run with
+    # every member's dispatcher stream PARKED — wall-clock here is client
+    # stall, so per-member work must stay slab/struct ops (the per-member
+    # loops that remain are baselined with their boundedness reasons).
+    "goworld_tpu/entity/entity_manager.py": {
+        "pack_space", "restore_space_bundle",
+    },
+    "goworld_tpu/rebalance/migrator.py": {
+        "handle_space_command", "_pack_and_send", "on_space_data",
+        "_tick_spaces",
+    },
 }
 
 

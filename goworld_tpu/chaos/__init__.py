@@ -24,11 +24,13 @@ from goworld_tpu.chaos.harness import (  # noqa: F401
     dropped_packet_count,
     run_chaos,
     scenario_battle_royale_freeze_restore,
+    scenario_battle_royale_keyframe_storm,
     scenario_battle_royale_kill_game,
     scenario_dispatcher_restart,
     scenario_game_kill_recreate,
     scenario_gate_kill_reconnect,
     scenario_paused_dispatcher,
+    scenario_service_outage_dispatcher_restart,
     scenario_severed_link,
     scenario_storage_outage,
 )

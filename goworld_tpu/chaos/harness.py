@@ -37,6 +37,7 @@ from goworld_tpu.config.read_config import (
     GoWorldConfig,
     KVDBConfig,
     StorageConfig,
+    SyncConfig,
 )
 from goworld_tpu.dispatcher import DispatcherService
 from goworld_tpu.entity.entity import Entity
@@ -158,6 +159,7 @@ class ChaosCluster:
         reconnect_max_interval: float = 1.0,
         sync_interval: float = 0.05,
         storage_knobs: Optional[dict] = None,
+        sync_knobs: Optional[dict] = None,
         transport: str = "tcp",
     ) -> None:
         self.run_dir = run_dir
@@ -179,6 +181,10 @@ class ChaosCluster:
         )
         self.sync_interval = sync_interval
         self.storage_knobs = storage_knobs or {}
+        # [sync] overrides (tier cadences / quantize bits) — the
+        # keyframe-storm scenario needs the delta plane live so enter
+        # waves force attributable new_pair keyframes.
+        self.sync_knobs = sync_knobs or {}
         self.dispatchers: list[Optional[DispatcherService]] = []
         self.ports: list[int] = []
         self.game: Optional[GameService] = None
@@ -226,6 +232,8 @@ class ChaosCluster:
             **self.storage_knobs)
         cfg.kvdb = KVDBConfig(
             type="filesystem", directory=self.run_dir + "/kv")
+        if self.sync_knobs:
+            cfg.sync = SyncConfig(**self.sync_knobs)
         cfg.cluster = self.cluster_cfg
         self.cfg = cfg
 
@@ -669,6 +677,110 @@ async def scenario_storage_outage(
             "bot_errors": len(cluster.bot_errors())}
 
 
+async def scenario_service_outage_dispatcher_restart(
+    cluster: ChaosCluster, failures: int = 25, ops: int = 96,
+    recovery_deadline: float = 15.0,
+) -> dict:
+    """ISSUE 18 catalog cross: the service_heavy workload's storage
+    outage UNDER a dispatcher restart — both control planes sick at
+    once. Shard-routed service receipts + storage saves flow while (a)
+    the backend fails writes past the breaker threshold AND (b) a
+    dispatcher dies and restarts mid-outage. The circuit must open (not
+    wedge), pings issued through the dispatcher outage must all land
+    after the reconnect (replay rings), the routing trajectory must stay
+    exactly-once per shard, and once the backend heals every deferred
+    save must land: zero lost documents, zero bot errors, zero entity
+    loss."""
+    from goworld_tpu import service, storage
+    from goworld_tpu.storage.circuit import CircuitBreaker
+
+    flaky = FlakyBackend(storage.get_backend())
+    storage.set_backend(flaky)
+    kind_shards = {"chat": 4, "mail": 2, "ranking": 2}
+    kinds = tuple(kind_shards)
+    receipts: dict[str, list[int]] = {
+        k: [0] * s for k, s in kind_shards.items()}
+    expected: dict[str, dict] = {}
+    seq = 0
+
+    def issue(n: int) -> None:
+        nonlocal seq
+        for _ in range(n):
+            kind = kinds[seq % len(kinds)]
+            shard = service.shard_by_key(
+                f"user{seq}", kind_shards[kind])
+            receipts[kind][shard] += 1
+            doc = f"svc-{kind}-{shard}-{seq % 8}"
+            payload = {"seq": seq, "kind": kind}
+            expected[doc] = payload
+            storage.save("ChaosSvcDoc", doc, payload)
+            seq += 1
+
+    await cluster.assert_rpc_roundtrip()
+    issue(ops // 3)  # healthy baseline traffic
+    # The cross: storage outage and dispatcher kill land TOGETHER.
+    flaky.fail_writes = failures
+    await cluster.kill_dispatcher(0)
+    cluster._ping_seq += 1
+    mid = cluster._ping_seq
+    for b in cluster.bots:
+        b.player.call_server("Ping_Client", mid)  # parks in replay rings
+    issue(ops // 3)  # service traffic INTO the double fault
+    await cluster._wait(
+        lambda: storage.circuit_state() == CircuitBreaker.OPEN,
+        recovery_deadline,
+        "circuit never opened under the dispatcher-restart cross")
+    t0 = time.monotonic()
+    await cluster.restart_dispatcher(0)
+    await cluster._wait(
+        cluster.links_up, recovery_deadline,
+        "links never reconnected (storage outage + dispatcher restart)")
+    await cluster._wait(
+        lambda: all(mid in cluster._pongs[b.name] for b in cluster.bots),
+        recovery_deadline, "mid-cross pings were lost")
+    # Backend heals AFTER the cluster plane: saves keep probing the
+    # half-open circuit until it closes and the deferred queue drains.
+    flaky.fail_writes = 0
+    issue(ops - 2 * (ops // 3))
+    t1 = time.monotonic()
+    while (storage.deferred_count()
+           or storage.circuit_state() != CircuitBreaker.CLOSED):
+        if time.monotonic() - t1 > recovery_deadline:
+            raise AssertionError(
+                f"storage never recovered under the cross: "
+                f"state={storage.circuit_state()} "
+                f"deferred={storage.deferred_count()}")
+        issue(1)
+        await asyncio.sleep(0.1)
+    storage.wait_clear(10.0)
+    recovery = time.monotonic() - t0
+    # Exactly-once receipts: the shard routing trajectory is
+    # deterministic in seq, so a replayed/duplicated op would break the
+    # recomputed totals.
+    want: dict[str, list[int]] = {
+        k: [0] * s for k, s in kind_shards.items()}
+    for i in range(seq):
+        kind = kinds[i % len(kinds)]
+        want[kind][service.shard_by_key(f"user{i}", kind_shards[kind])] += 1
+    assert receipts == want, (
+        f"shard receipts not exactly-once: {receipts} != {want}")
+    missing = [d for d, payload in expected.items()
+               if flaky.inner.read("ChaosSvcDoc", d) != payload]
+    assert not missing, (
+        f"saves lost/stale across the cross: {missing[:5]}")
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    errors = cluster.bot_errors()
+    assert not errors, f"bot errors across the cross: {errors[:5]}"
+    assert cluster.live_avatars() == cluster.n_bots, "entity loss"
+    _RECOVERY.labels(
+        "service_outage_dispatcher_restart", cluster.transport).set(recovery)
+    return {"scenario": "service_outage_dispatcher_restart",
+            "recovery_s": round(recovery, 3),
+            "post_roundtrip_s": round(rt, 3),
+            "ops": seq, "failed_writes": flaky.failed,
+            "lost_saves": len(missing), "bot_errors": len(errors)}
+
+
 async def scenario_game_kill_recreate(
     cluster: ChaosCluster, downtime: float = 0.3,
     recovery_deadline: float = 20.0,
@@ -921,6 +1033,65 @@ async def scenario_battle_royale_freeze_restore(
             "endgame_edges": endgame, "bot_errors": len(errors)}
 
 
+def _kf_forced(reason: str) -> float:
+    """Current sync_keyframes_forced_total{reason=...} value."""
+    fam = telemetry.family("sync_keyframes_forced_total")
+    if fam is None:
+        return 0.0
+    return sum(child.value for labels, child in fam.children()
+               if reason in labels)
+
+
+async def scenario_battle_royale_keyframe_storm(
+    cluster: ChaosCluster, ticks: int = 16, waves: int = 2,
+    recovery_deadline: float = 30.0,
+) -> dict:
+    # recovery_deadline spans a possible heartbeat-dropped link reconnect
+    # (5s buffering window) on a loaded CI host, not just the sync lag.
+    """ISSUE 18 keyframe-storm assertion: battle-royale ENTER waves on a
+    cluster running the delta sync plane ([sync] quantize_bits > 0 — the
+    cluster must be built with sync_knobs). Each wave scatters the fleet
+    (every interest edge dissolves) then collapses it back to the endgame
+    disc (a mass enter wave re-forming full mutual interest); every
+    re-formed (subject, watcher) pair's FIRST record must be a forced
+    full-precision keyframe, so sync_keyframes_forced_total{reason=
+    new_pair} must grow in lockstep with the wave's edge census — at
+    least one keyframe per re-formed pair, every wave. The strict bots
+    independently prove the same contract from the wire: a delta record
+    before a keyframe is a protocol error."""
+    n = cluster.n_bots
+    await cluster.assert_rpc_roundtrip()
+    per_wave: list[int] = []
+    for _ in range(waves):
+        # Scatter: ring spacing at the full zone exceeds AOI_DISTANCE, so
+        # the NEXT collapse is a pure enter wave over invalid baselines.
+        await _royale_collapse(cluster, 0, 2, ticks)
+        await cluster._wait(
+            lambda: _royale_edges(cluster) == 0, recovery_deadline,
+            "scatter never dissolved the fleet's interest edges")
+        kf0 = _kf_forced("new_pair")
+        await _royale_collapse(cluster, 2, ticks, ticks)
+        await cluster._wait(
+            lambda: _royale_edges(cluster) == n * (n - 1),
+            recovery_deadline, "enter wave never re-formed full interest")
+        # Lockstep: one forced keyframe per re-formed directed pair (the
+        # emission may trail the edge census by a sync interval or two).
+        await cluster._wait(
+            lambda: _kf_forced("new_pair") - kf0 >= n * (n - 1),
+            recovery_deadline,
+            "enter wave did not force a keyframe per new pair")
+        per_wave.append(int(_kf_forced("new_pair") - kf0))
+    rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
+    errors = cluster.bot_errors()
+    assert not errors, (
+        f"strict bots saw sync errors in the keyframe storm: {errors[:5]}")
+    assert cluster.live_avatars() == n, "entity loss across the storm"
+    return {"scenario": "battle_royale_keyframe_storm",
+            "waves": waves, "edges_per_wave": n * (n - 1),
+            "keyframes_per_wave": per_wave,
+            "post_roundtrip_s": round(rt, 3), "bot_errors": len(errors)}
+
+
 def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
               transport: str = "tcp") -> dict:
     """Run the single-cluster scenario suite (``bench.py --chaos``;
@@ -948,6 +1119,9 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
             scenario_severed_link,
             scenario_paused_dispatcher,
             scenario_storage_outage,
+            # ISSUE 18 catalog cross: the service-heavy storage outage
+            # UNDER a dispatcher restart (both planes sick at once).
+            scenario_service_outage_dispatcher_restart,
             scenario_game_kill_recreate,
             scenario_gate_kill_reconnect,
             # Scenario-matrix workloads (ISSUE 16) crossed with faults:

@@ -306,6 +306,16 @@ class RebalanceConfig:
     # Seconds a just-moved (or just-rolled-back) entity is exempt from
     # re-selection; doubles per consecutive rollback of the same entity.
     cooldown: float = 5.0
+    # Cap on WHOLE-SPACE handoffs per planning round (ISSUE 18). 0 keeps
+    # the planner entity-granular: a donor space whose kind has no
+    # receiver-side twin simply stays put. Nonzero lets the bin-packer
+    # move the space itself through the two-phase SPACE_MIGRATE protocol.
+    max_space_moves_per_round: int = 0
+    # Host the planner in the sharded RebalancePlannerService entity
+    # instead of the driver dispatcher: the planner then fails over with
+    # the service plane (a dead host's shard is re-claimed by a surviving
+    # game and planning resumes from fresh GAME_LOAD_REPORT state).
+    planner_service: bool = False
 
 
 @dataclasses.dataclass
@@ -604,6 +614,10 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             max_moves_per_round=int(s.get("max_moves_per_round", 4)),
             migrate_timeout=float(s.get("migrate_timeout", 5.0)),
             cooldown=float(s.get("cooldown", 5.0)),
+            max_space_moves_per_round=int(
+                s.get("max_space_moves_per_round", 0)),
+            planner_service=s.get("planner_service", "false").lower()
+            in ("1", "true", "yes"),
         )
     if cp.has_section("client"):
         cfg.client = ClientConfig(
@@ -856,6 +870,10 @@ def _validate(cfg: GoWorldConfig) -> None:
         raise ValueError("[rebalance] migrate_timeout must be > 0 seconds")
     if rb.cooldown < 0:
         raise ValueError("[rebalance] cooldown must be >= 0 seconds")
+    if rb.max_space_moves_per_round < 0:
+        raise ValueError(
+            "[rebalance] max_space_moves_per_round must be >= 0 "
+            "(0 = whole-space moves disabled)")
     if cfg.client.rpc_timeout <= 0:
         raise ValueError("[client] rpc_timeout must be > 0 seconds")
     t = cfg.telemetry

@@ -217,6 +217,11 @@ class DispatcherService:
         # window lapses.
         self._pending_boots: list[tuple[Packet, float]] = []
         self.kvreg: dict[str, str] = {}
+        # Whole-space handoffs (ISSUE 18) this dispatcher parked member
+        # streams for: spaceid → (deadline, [parked eids]). Entries clear
+        # on SPACE_MIGRATE_ACK (receiver restored), SPACE_MIGRATE_ABORT
+        # (donor unfroze in place), or the deadline sweep.
+        self._space_handoffs: dict[str, tuple[float, list]] = {}
         self.deployment_ready = False
         self._boot_rr = 0
         self._lbc = LBCHeap()
@@ -328,8 +333,10 @@ class DispatcherService:
                 "enabled": self.rebalance_cfg.enabled,
                 "driver": (self.rebalance_cfg.driver_dispatcher
                            == self.dispid),
+                "planner_service": self.rebalance_cfg.planner_service,
                 "last_result": self.planner.last_result,
                 "reporting_games": self.planner.reports.games(),
+                "space_handoffs": len(self._space_handoffs),
             },
             "games": {
                 str(gid): {"connected": gi.connected,
@@ -569,6 +576,7 @@ class DispatcherService:
             self._sweep_dead_frozen_games()
             self._sweep_dead_gates()
             self._sweep_unrouted_entities()
+            self._sweep_space_handoffs()
             self._retry_pending_boots()
             self._heartbeat_tick()
             self._rebalance_tick()
@@ -592,21 +600,59 @@ class DispatcherService:
         if (not rb.enabled or self.dispid != rb.driver_dispatcher
                 or not self._rebalance_active):
             return
+        if rb.planner_service:
+            # The sharded RebalancePlannerService plans instead; its
+            # REBALANCE_PLAN pushes arrive at _handle_rebalance_plan.
+            return
         now = self._now()
         if now - self._last_plan < rb.interval:
             return
         self._last_plan = now
         connected = {gid for gid, gi in self.games.items() if gi.connected}
-        for move in self.planner.plan(connected, now):
+        self._dispatch_plan(self.planner.plan(connected, now), now)
+
+    def _dispatch_plan(self, plan: list, now: float) -> None:
+        """Turn a planning round's Move/SpaceMove list into dispatcher
+        commands toward each donor game."""
+        from goworld_tpu.rebalance.planner import Move
+
+        for move in plan:
             gi = self.games.get(move.from_game)
             if gi is None or not gi.connected:
                 continue  # link dropped since planning; next round re-sees
-            p = Packet()
-            p.append_entity_id(move.from_space)
-            p.append_entity_id(move.to_space)
-            p.append_uint16(move.to_game)
-            p.append_uint16(move.count)
-            gi.dispatch(MsgType.REBALANCE_MIGRATE, p, now)
+            if isinstance(move, Move):
+                p = Packet()
+                p.append_entity_id(move.from_space)
+                p.append_entity_id(move.to_space)
+                p.append_uint16(move.to_game)
+                p.append_uint16(move.count)
+                gi.dispatch(MsgType.REBALANCE_MIGRATE, p, now)
+            else:
+                p = Packet()
+                p.append_entity_id(move.spaceid)
+                p.append_uint16(move.to_game)
+                gi.dispatch(MsgType.REBALANCE_MIGRATE_SPACE, p, now)
+
+    def _handle_rebalance_plan(self, proxy: GoWorldConnection,
+                               packet: Packet) -> None:
+        """A plan computed by the sharded RebalancePlannerService (planner
+        failover, ISSUE 18). The dispatcher stays the authority on command
+        DISPATCH: it validates the config gate and per-game liveness, so a
+        stale service (e.g. one racing its own destruction after losing a
+        registration race) cannot move entities on a cluster that turned
+        rebalancing off."""
+        from goworld_tpu.rebalance.planner import plan_from_wire
+
+        plan = plan_from_wire(packet.read_data())
+        rb = self.rebalance_cfg
+        if not (rb.enabled and rb.planner_service
+                and self._rebalance_active):
+            gwlog.warnf(
+                "dispatcher %d: dropping REBALANCE_PLAN (%d commands) — "
+                "planner-service rebalancing not active here",
+                self.dispid, len(plan))
+            return
+        self._dispatch_plan(plan, self._now())
 
     # --- chaos/testing hooks -------------------------------------------------
 
@@ -670,22 +716,26 @@ class DispatcherService:
         for gameid, gi in list(self.games.items()):
             if gi.proxy is None and gi.block_until and not gi.blocked(now):
                 gi.block_until = 0.0
-                # Buffered REAL_MIGRATE payloads are entities' LAST
-                # copies: bounce each home before the buffer drops (the
-                # trailing source-gameid makes this possible without the
-                # long-gone forwarding proxy).
+                # Buffered REAL_MIGRATE / SPACE_MIGRATE_DATA payloads are
+                # entities' (or a whole space's) LAST copies: bounce each
+                # home before the buffer drops (the trailing source-gameid
+                # makes this possible without the long-gone forwarding
+                # proxy).
                 for msgtype, packet in gi.pending:
-                    if msgtype != MsgType.REAL_MIGRATE:
+                    if msgtype not in (MsgType.REAL_MIGRATE,
+                                       MsgType.SPACE_MIGRATE_DATA):
                         continue
                     eid = packet.read_entity_id()
                     packet.set_read_pos(0)
                     if not self._bounce_migrate_home(
                             eid, packet,
-                            self._real_migrate_source(packet), now):
+                            self._real_migrate_source(packet), now,
+                            msgtype=msgtype):
                         gwlog.errorf(
-                            "dispatcher %d: REAL_MIGRATE of %s buffered "
-                            "for dead game %d has no live source; entity "
-                            "state dropped", self.dispid, eid, gameid)
+                            "dispatcher %d: %s of %s buffered "
+                            "for dead game %d has no live source; "
+                            "state dropped", self.dispid,
+                            MsgType(msgtype).name, eid, gameid)
                 gi.pending.clear()
                 self._handle_game_down(gameid)
 
@@ -1225,20 +1275,23 @@ class DispatcherService:
         self._flush_entity_pending(info)
 
     def _bounce_migrate_home(self, eid: str, packet: Packet,
-                             source_game: int, now: float) -> bool:
-        """Redirect a REAL_MIGRATE payload back to its source game (which
-        restores the entity in place). False if the source is gone too."""
+                             source_game: int, now: float,
+                             msgtype: int = MsgType.REAL_MIGRATE) -> bool:
+        """Redirect a migrate payload (REAL_MIGRATE entity or
+        SPACE_MIGRATE_DATA space bundle) back to its source game, which
+        restores it in place. False if the source is gone too."""
         si = self.games.get(source_game) if source_game else None
         if si is None or not (si.connected or si.blocked(now)):
             return False
         gwlog.warnf(
-            "dispatcher %d: REAL_MIGRATE of %s targets a dead game; "
-            "bouncing home to game %d", self.dispid, eid, source_game)
+            "dispatcher %d: %s of %s targets a dead game; "
+            "bouncing home to game %d", self.dispid,
+            MsgType(msgtype).name, eid, source_game)
         info = self._entity(eid)
         info.gameid = source_game
         self._mig_bounced.inc()
         self.migrates_bounced += 1
-        si.dispatch(MsgType.REAL_MIGRATE, packet, now)
+        si.dispatch(msgtype, packet, now)
         self._flush_entity_pending(info)
         return True
 
@@ -1249,6 +1302,144 @@ class DispatcherService:
             self._mig_cancel.inc()
             self.migrates_cancelled += 1
             self._flush_entity_pending(info)
+
+    # --- whole-space handoff (ISSUE 18; modelcheck space_handoff) -------------
+
+    def _handle_space_migrate_prepare(self, proxy: GoWorldConnection,
+                                      packet: Packet) -> None:
+        """Donor game froze a space: park the LISTED member streams this
+        dispatcher routes to the donor, then ack on the donor's own FIFO.
+
+        Same fence contract as _handle_start_freeze_game: the ack is
+        written strictly after the blocks, on the same stream as every
+        packet already forwarded, so receiving it proves all of this
+        dispatcher's pre-park traffic has been delivered to the donor —
+        the pack after the last ack misses nothing.
+
+        The list is the freeze-time membership, and only eids CURRENTLY
+        routed to the donor park: a member that completed its own entity
+        migrate before the freeze must not have its stream at the NEW
+        game parked (modelcheck space_member_race found exactly this).
+
+        A dead target game refuses the PREPARE outright — SPACE_MIGRATE_
+        ABORT back to the donor, nothing parked — so the handoff fails in
+        one hop instead of timing out against a corpse."""
+        spaceid = packet.read_entity_id()
+        to_game = packet.read_uint16()
+        member_eids = packet.read_data()
+        donor_game = self._gameid_of(proxy)
+        now = self._now()
+        tgt = self.games.get(to_game)
+        if tgt is None or not (tgt.connected or tgt.blocked(now)):
+            p = Packet()
+            p.append_entity_id(spaceid)
+            p.append_varstr("target_game_down")
+            self._ack_requester(proxy, MsgType.SPACE_MIGRATE_ABORT, p)
+            gwlog.warnf(
+                "dispatcher %d: refusing SPACE_MIGRATE_PREPARE of %s — "
+                "target game %d is dead", self.dispid, spaceid, to_game)
+            return
+        parked: list = []
+        for eid in list(member_eids) + [spaceid]:
+            info = self.entities.get(eid)
+            if info is None or info.gameid != donor_game:
+                continue  # moved or destroyed since the freeze snapshot
+            info.block(now, consts.DISPATCHER_MIGRATE_TIMEOUT)
+            parked.append(eid)
+        self._space_handoffs[spaceid] = (
+            now + consts.DISPATCHER_MIGRATE_TIMEOUT, parked)
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(self.dispid)
+        self._ack_requester(proxy, MsgType.SPACE_MIGRATE_PREPARE_ACK, p)
+
+    def _handle_space_migrate_data(self, proxy: GoWorldConnection,
+                                   packet: Packet) -> None:
+        """Route the packed SPACE (with every member) to its target game —
+        or bounce it home. Exactly REAL_MIGRATE's three-state contract,
+        because the payload is the space's and members' last copy: route
+        through blocks, grace-buffer for an unknown target's handshake,
+        bounce home to the trailing source gameid when the target is
+        declared dead."""
+        spaceid = packet.read_entity_id()
+        target_game = packet.read_uint16()
+        packet.set_read_pos(0)
+        now = self._now()
+        info = self._entity(spaceid)
+        gi = self.games.get(target_game)
+        if gi is None:
+            gi = self._game(target_game)
+            gi.block_until = now + consts.DISPATCHER_RECONNECT_BUFFER_WINDOW
+            gwlog.warnf(
+                "dispatcher %d: SPACE_MIGRATE_DATA of %s targets unknown "
+                "game %d; buffering %.0fs for its handshake", self.dispid,
+                spaceid, target_game,
+                consts.DISPATCHER_RECONNECT_BUFFER_WINDOW)
+        elif not (gi.connected or gi.blocked(now)):
+            source_game = (self._gameid_of(proxy)
+                           or self._real_migrate_source(packet))
+            if self._bounce_migrate_home(
+                    spaceid, packet, source_game, now,
+                    msgtype=MsgType.SPACE_MIGRATE_DATA):
+                return
+            gwlog.errorf(
+                "dispatcher %d: SPACE_MIGRATE_DATA of %s targets dead "
+                "game %d and the source link is gone; space state dropped",
+                self.dispid, spaceid, target_game)
+            self.entities.pop(spaceid, None)
+            return
+        info.gameid = target_game
+        self._mig_routed.inc()
+        self.migrates_routed += 1
+        gi.dispatch(MsgType.SPACE_MIGRATE_DATA, packet, now)
+        self._flush_entity_pending(info)
+
+    def _handle_space_migrate_abort(self, proxy: GoWorldConnection,
+                                    packet: Packet) -> None:
+        """Donor broadcast: the handoff died (deadline, dead target, space
+        destroyed) and the space unfroze in place — unpark every member."""
+        spaceid = packet.read_entity_id()
+        reason = packet.read_varstr()
+        if self._release_space_handoff(spaceid):
+            gwlog.infof(
+                "dispatcher %d: space %s handoff aborted (%s); member "
+                "streams unparked", self.dispid, spaceid, reason)
+
+    def _handle_space_migrate_ack(self, proxy: GoWorldConnection,
+                                  packet: Packet) -> None:
+        """Receiver broadcast: the space restored. Member routes already
+        moved with each NOTIFY_CREATE (which also flushed their streams);
+        this clears the handoff entry and unparks any leftover parked eid
+        (a member destroyed mid-handoff never gets a NOTIFY_CREATE)."""
+        spaceid = packet.read_entity_id()
+        packet.read_uint16()  # receiver gameid (logged at the receiver)
+        self._release_space_handoff(spaceid)
+
+    def _release_space_handoff(self, spaceid: str) -> bool:
+        entry = self._space_handoffs.pop(spaceid, None)
+        if entry is None:
+            return False
+        for eid in entry[1]:
+            info = self.entities.get(eid)
+            if info is not None:
+                self._flush_entity_pending(info)
+        return True
+
+    def _sweep_space_handoffs(self) -> None:
+        """Backstop: a handoff whose donor died before broadcasting an
+        abort (or whose ack never reached us) must not park member streams
+        past the migrate window — the deadline unparks unconditionally
+        (modelcheck liveness: no stream stays parked forever)."""
+        if not self._space_handoffs:
+            return
+        now = self._now()
+        for spaceid, (deadline, _parked) in list(self._space_handoffs.items()):
+            if now >= deadline:
+                self._release_space_handoff(spaceid)
+                gwlog.warnf(
+                    "dispatcher %d: space %s handoff hit the dispatcher "
+                    "deadline; member streams unparked", self.dispid,
+                    spaceid)
 
     # --- position sync aggregation (DispatcherService.go:786-824) -------------
 
@@ -1347,6 +1538,15 @@ class DispatcherService:
         value = packet.read_varstr()
         force = packet.read_bool()
         packet.set_read_pos(0)
+        if value == "":
+            # Deletion convention (ISSUE 18 planner failover): a forced
+            # empty value POPS the key — the game-side reconcile must see
+            # the shard as unclaimed, not as owned by "". Replicated so
+            # every game's map drops it too.
+            if force and key in self.kvreg:
+                del self.kvreg[key]
+                self._broadcast_games(MsgType.KVREG_REGISTER, packet)
+            return
         if not force and key in self.kvreg:
             return  # first registration wins unless forced
         self.kvreg[key] = value
@@ -1456,7 +1656,40 @@ class DispatcherService:
         p = Packet()
         p.append_uint16(gameid)
         self._broadcast_games(MsgType.NOTIFY_GAME_DISCONNECTED, p, except_game=gameid)
+        self._purge_dead_game_services(gameid)
         gwlog.infof("dispatcher %d: game %d down, %d entities dropped", self.dispid, gameid, len(dead))
+
+    def _purge_dead_game_services(self, gameid: int) -> None:
+        """Release the dead game's service-shard claims (ISSUE 18 planner
+        failover): pop every ``Service/…`` key it owned — and the
+        ``/EntityID`` companion, or the reconcile would see a half-
+        registered shard — and replicate the deletions so every surviving
+        game's reconcile races to re-claim. Without this, a shard owned by
+        a corpse stays claimed forever and its service (e.g. the
+        RebalancePlannerService) never fails over."""
+        from goworld_tpu.service import SERVICE_KVREG_PREFIX
+
+        owner = f"game{gameid}"
+        owned = [
+            k for k, v in self.kvreg.items()
+            if v == owner and k.startswith(SERVICE_KVREG_PREFIX)
+            and "/" not in k[len(SERVICE_KVREG_PREFIX):]
+        ]
+        for k in owned:
+            for key in (k, k + "/EntityID"):
+                if self.kvreg.pop(key, None) is None:
+                    continue
+                p = Packet()
+                p.append_varstr(key)
+                p.append_varstr("")
+                p.append_bool(True)
+                self._broadcast_games(MsgType.KVREG_REGISTER, p,
+                                      except_game=gameid)
+        if owned:
+            gwlog.warnf(
+                "dispatcher %d: purged %d service shard claims of dead "
+                "game %d (%s); survivors will re-claim", self.dispid,
+                len(owned), gameid, ", ".join(sorted(owned)))
 
     _HANDLERS = {
         MsgType.SET_GAME_ID: _handle_set_game_id,
@@ -1474,6 +1707,11 @@ class DispatcherService:
         MsgType.MIGRATE_REQUEST: _handle_migrate_request,
         MsgType.REAL_MIGRATE: _handle_real_migrate,
         MsgType.CANCEL_MIGRATE: _handle_cancel_migrate,
+        MsgType.SPACE_MIGRATE_PREPARE: _handle_space_migrate_prepare,
+        MsgType.SPACE_MIGRATE_DATA: _handle_space_migrate_data,
+        MsgType.SPACE_MIGRATE_ABORT: _handle_space_migrate_abort,
+        MsgType.SPACE_MIGRATE_ACK: _handle_space_migrate_ack,
+        MsgType.REBALANCE_PLAN: _handle_rebalance_plan,
         MsgType.SYNC_POSITION_YAW_FROM_CLIENT: _handle_sync_position_yaw_from_client,
         MsgType.KVREG_REGISTER: _handle_kvreg_register,
         MsgType.GAME_LBC_INFO: _handle_game_lbc_info,

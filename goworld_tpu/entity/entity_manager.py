@@ -563,6 +563,65 @@ def restore_entity(eid: str, data: dict, is_migrate: bool) -> Entity:
     return e
 
 
+# --- whole-space migration (ISSUE 18; no reference analog) -------------------
+
+
+def pack_space(space: Space) -> tuple[dict, list]:
+    """Pack a FROZEN space and every member into one transferable bundle
+    and destroy the local copies (migrate semantics: no on_destroy hooks,
+    no NOTIFY_DESTROY — the receiver's restore re-announces everything).
+
+    Returns ``(bundle, queued_joins)``: the bundle is the one
+    SPACE_MIGRATE_DATA payload; ``queued_joins`` are the (entity, pos)
+    pairs that tried to enter while frozen — the caller re-dispatches each
+    via ``enter_space`` AFTER sending the bundle, so the re-routed join
+    rides the same dispatcher FIFO behind the data and finds the updated
+    space route. Membership is frozen, so every packed member is in the
+    PREPARE-time member list whose streams the dispatchers parked — no
+    member can slip into the snapshot unparked."""
+    if not space.frozen:
+        raise ValueError(f"pack_space: space {space.id} is not frozen")
+    members: dict[str, dict] = {}
+    # Deterministic order (by id): restore replays in sorted order too,
+    # so donor-side pack and receiver-side restore walk the same sequence.
+    for e in sorted(space.entities, key=lambda e: e.id):
+        gwutils.run_panicless(e.on_migrate_out)
+        members[e.id] = e.get_migrate_data()
+    sdata = space.get_migrate_data()
+    sdata["kind"] = space.kind
+    bundle = {"space": sdata, "members": members}
+    queued = list(space._pending_enters)
+    space._pending_enters = []
+    for e in sorted(space.entities, key=lambda e: e.id):
+        e._destroy(is_migrate=True)
+    space._destroy(is_migrate=True)
+    # Migrate-destroy skips on_destroy (user hooks must not fire for a
+    # move), which is also where a space normally drops its AOI manager
+    # and its _spaces index entry — do both explicitly.
+    if space.aoi_mgr is not None:
+        space.aoi_mgr.destroy()
+        space.aoi_mgr = None
+    _spaces.pop(space.id, None)
+    return bundle, queued
+
+
+def restore_space_bundle(spaceid: str, bundle: dict) -> Space:
+    """Receiver side of SPACE_MIGRATE_DATA (and the donor's bounce-home
+    rollback): restore the space FIRST — its NOTIFY_CREATE re-routes the
+    space id — then every member (whose ``space_id`` now resolves locally;
+    each member's NOTIFY_CREATE re-routes its eid and flushes the packets
+    its dispatcher parked at PREPARE)."""
+    sdata = bundle["space"]
+    space = restore_entity(spaceid, sdata, is_migrate=True)
+    if not isinstance(space, Space):
+        raise ValueError(
+            f"restore_space_bundle: {spaceid} restored as "
+            f"{type(space).__name__}, expected a Space")
+    for eid in sorted(bundle.get("members", {})):
+        restore_entity(eid, bundle["members"][eid], is_migrate=True)
+    return space
+
+
 # --- freeze / restore (EntityManager.go:554-656) -----------------------------
 
 

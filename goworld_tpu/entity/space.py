@@ -26,6 +26,13 @@ class Space(Entity):
         self.entities: set[Entity] = set()
         self.kind = SPACE_KIND_NIL
         self.aoi_mgr = None
+        # Whole-space migration (ISSUE 18): while frozen, membership is
+        # immutable — the freeze-time member list IS the handoff snapshot,
+        # so a join landing mid-handoff queues instead of entering (the
+        # modelcheck ``no_frozen_join_guard`` mutant shows the alternative:
+        # the joiner is absent from the snapshot and destroyed by the pack).
+        self.frozen = False
+        self._pending_enters: list[tuple[Entity, Vector3]] = []
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -91,6 +98,24 @@ class Space(Entity):
         if dist and self.aoi_mgr is None:
             self._create_aoi_manager(float(dist))
 
+    # --- whole-space migration freeze (ISSUE 18) ---------------------------
+
+    def freeze_space(self) -> None:
+        """Pin membership for a whole-space handoff: no entity may enter
+        or re-enter until :meth:`unfreeze_space` (abort) or the pack
+        destroys the space (commit). Joins queue in ``_pending_enters``."""
+        self.frozen = True
+
+    def unfreeze_space(self) -> None:
+        """Abort path: unfreeze in place and replay every queued join —
+        the space was never in zero places, so the joiners simply enter
+        late (bounded by the handoff deadline)."""
+        self.frozen = False
+        pending, self._pending_enters = self._pending_enters, []
+        for entity, pos in pending:
+            if not entity.is_destroyed():
+                self._enter(entity, pos)
+
     # --- membership (Space.go:188-261) -------------------------------------
 
     def _enter(self, entity: Entity, pos: Vector3) -> None:
@@ -99,6 +124,13 @@ class Space(Entity):
             # hooks, no AOI, no entities set (Space.go:197-199).
             entity.space = self
             entity.position = pos
+            return
+        if self.frozen:
+            # Mid-handoff join: queue it. unfreeze_space replays (abort);
+            # the pack re-dispatches each joiner's enter_space AFTER the
+            # SPACE_MIGRATE_DATA on the same dispatcher FIFO (commit), so
+            # the re-routed join finds the updated space route.
+            self._pending_enters.append((entity, pos))
             return
         entity.space = self
         entity.position = pos

@@ -239,6 +239,17 @@ class GameService:
         if not kvdb.initialized():
             kvdb.initialize(self.cfg.kvdb)
 
+        rbcfg = getattr(self.cfg, "rebalance", None)
+        if rbcfg is not None and rbcfg.enabled and rbcfg.planner_service:
+            # Planner failover (ISSUE 18): host planning in a sharded
+            # service entity — every game registers the type, exactly one
+            # wins the kvreg shard race and plans; survivors re-claim the
+            # shard when the host dies. Must happen before restore: a
+            # frozen planner entity needs its type in the registry.
+            from goworld_tpu.rebalance import planner_service
+
+            planner_service.register()
+
         if self.restore:
             self._restore_freezed_entities()
             # Pre-warm the per-class batched tick jits at the restored
@@ -273,7 +284,6 @@ class GameService:
         from goworld_tpu import service as service_mod
 
         service_mod.setup(self.gameid)  # service.go:78-81
-
         self._install_signal_handlers()
         from goworld_tpu.utils import gwvar
         from goworld_tpu.utils.debug_http import setup_http_server
@@ -403,6 +413,19 @@ class GameService:
                 continue
             clients += 1
             gate_gens.setdefault(str(c.gateid), set()).add(c.gate_gen)
+        # A locally-hosted RebalancePlannerService shard surfaces its
+        # planning state here: /cluster's REBAL view and the pause/
+        # failover alerts read exactly this row (the dispatcher's healthz
+        # only carries last_result in driver mode).
+        planner = None
+        for e in entity_manager.entities().values():
+            if (e.typename == "RebalancePlannerService"
+                    and not e.is_destroyed()):
+                planner = {
+                    "last_result": e.planner.last_result,
+                    "reporting_games": e.planner.reports.games(),
+                }
+                break
         return {
             "kind": "game",
             "id": self.gameid,
@@ -413,6 +436,7 @@ class GameService:
             "clients": clients,
             "queue_depth": self.queue_depth(),
             "client_gate_gens": {g: sorted(s) for g, s in gate_gens.items()},
+            "rebalance_planner": planner,
             "online_games": sorted(self.online_games),
             "dispatcher_links": (
                 self.cluster.link_states() if self.cluster is not None
@@ -765,6 +789,38 @@ class GameService:
             to_game = packet.read_uint16()
             count = packet.read_uint16()
             self._handle_rebalance_migrate(from_space, to_space, to_game, count)
+        elif msgtype == MsgType.REBALANCE_MIGRATE_SPACE:
+            spaceid = packet.read_entity_id()
+            to_game = packet.read_uint16()
+            self._handle_rebalance_migrate_space(spaceid, to_game)
+        elif msgtype == MsgType.SPACE_MIGRATE_PREPARE_ACK:
+            spaceid = packet.read_entity_id()
+            dispatcherid = packet.read_uint16()
+            self.migrator.on_space_prepare_ack(
+                spaceid, dispatcherid, time.monotonic())
+        elif msgtype == MsgType.SPACE_MIGRATE_DATA:
+            spaceid = packet.read_entity_id()
+            packet.read_uint16()
+            raw_len = packet.unread_len()
+            bundle = packet.read_data()
+            if not isinstance(bundle, dict):
+                raise ValueError(
+                    f"SPACE_MIGRATE_DATA body for {spaceid} is "
+                    f"{type(bundle).__name__}, expected dict")
+            # Trailing source_game (same convention as REAL_MIGRATE's):
+            # present so a dispatcher sweep can bounce the payload home.
+            source_game = (packet.read_uint16()
+                           if packet.unread_len() >= 2 else 0)
+            self._migrate_in_count += 1
+            self._migrate_in_bytes += raw_len
+            if raw_len > self._migrate_in_max:
+                self._migrate_in_max = raw_len
+            self.migrator.on_space_data(
+                spaceid, bundle, source_game, time.monotonic())
+        elif msgtype == MsgType.SPACE_MIGRATE_ABORT:
+            spaceid = packet.read_entity_id()
+            reason = packet.read_varstr()
+            self.migrator.on_space_abort(spaceid, reason, time.monotonic())
         elif msgtype == MsgType.CALL_NIL_SPACES:
             packet.read_uint16()
             method = packet.read_varstr()
@@ -817,6 +873,26 @@ class GameService:
             "game %d: rebalance command — migrating %d/%d entities of "
             "space %s to %s on game %d", self.gameid, moved, count,
             from_space, to_space, to_game)
+
+    def _handle_rebalance_migrate_space(self, spaceid: str,
+                                        to_game: int) -> None:
+        """Dispatcher rebalance command: hand the WHOLE space to
+        ``to_game`` through the two-phase SPACE_MIGRATE protocol. Same
+        staleness contract as the entity command: an unknown / already
+        in-flight / cooling-down space degrades to doing nothing."""
+        space = entity_manager.get_space(spaceid)
+        if space is None or space.is_destroyed():
+            gwlog.warnf(
+                "game %d: space-rebalance command for unknown space %s",
+                self.gameid, spaceid)
+            return
+        started = self.migrator.handle_space_command(
+            space, to_game, time.monotonic())
+        gwlog.infof(
+            "game %d: space-rebalance command — handoff of %s (%d members)"
+            " to game %d %s", self.gameid, spaceid,
+            space.get_entity_count(), to_game,
+            "started" if started else "refused")
 
     def _handle_client_connected(self, clientid: str, gateid: int,
                                  boot_eid: str, gate_gen: int = 0) -> None:
@@ -936,6 +1012,9 @@ class GameService:
         planner from this one packet."""
         from goworld_tpu.rebalance import build_load_report
 
+        rbcfg = getattr(self.cfg, "rebalance", None)
+        to_service = (rbcfg is not None and rbcfg.enabled
+                      and rbcfg.planner_service)
         last_cpu = time.process_time()
         last_wall = time.monotonic()
         while True:
@@ -947,6 +1026,18 @@ class GameService:
             report = build_load_report(self)
             for sender in dispatchercluster.select_all():
                 sender.send_game_load_report(report)
+            if to_service:
+                # Planner-service mode ALSO pushes the report to the
+                # sharded planner (deferred-call path: a report racing the
+                # failover window delivers to the NEW shard). Dispatchers
+                # keep receiving theirs — the LBC heap and /cluster load
+                # scores live there regardless of who plans.
+                from goworld_tpu import service as service_mod
+                from goworld_tpu.rebalance import planner_service as ps
+
+                service_mod.call_service_shard_key(
+                    ps.SERVICE_NAME, ps.REPORT_SHARD_KEY, "ReportLoad",
+                    self.gameid, report)
 
 
 def run(gameid: int | None = None, restore: bool | None = None) -> int:

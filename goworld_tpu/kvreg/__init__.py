@@ -38,8 +38,15 @@ def watch(callback: Callable[[str, str], None]) -> None:
 
 
 def on_registered(key: str, value: str) -> None:
-    """Apply one replicated registration (KVREG_REGISTER from a dispatcher)."""
-    _kvmap[key] = value
+    """Apply one replicated registration (KVREG_REGISTER from a dispatcher).
+
+    An empty value POPS the key (dispatcher game-down purge, ISSUE 18):
+    the service reconcile must see a dead owner's shard as UNCLAIMED —
+    storing ``""`` would instead parse as a malformed owner forever."""
+    if value == "":
+        _kvmap.pop(key, None)
+    else:
+        _kvmap[key] = value
     for cb in list(_watchers):
         cb(key, value)
 

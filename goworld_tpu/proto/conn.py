@@ -546,6 +546,84 @@ class GoWorldConnection:
         p.append_uint16(count)
         self.send(MsgType.REBALANCE_MIGRATE, p)
 
+    # --- whole-space migration (v7, ISSUE 18) ------------------------------
+
+    def send_space_migrate_prepare(
+        self, spaceid: str, to_game: int, member_eids: list
+    ) -> None:
+        """Donor game → EVERY dispatcher: the space froze; park the
+        LISTED member streams you own, then ack on this same link so the
+        ack fences all traffic you forwarded before parking.  The list
+        is the freeze-time membership — a member that already migrated
+        out must NOT be parked (modelcheck space_member_race)."""
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(to_game)
+        p.append_data(member_eids)
+        self.send(MsgType.SPACE_MIGRATE_PREPARE, p)
+
+    def send_space_migrate_prepare_ack(
+        self, spaceid: str, dispatcherid: int
+    ) -> None:
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(dispatcherid)
+        self.send(MsgType.SPACE_MIGRATE_PREPARE_ACK, p)
+
+    def send_space_migrate_data(
+        self, spaceid: str, target_game: int, space_data: dict,
+        source_game: int = 0
+    ) -> None:
+        """The whole-space snapshot (space + members + queued joins),
+        routed by the space-owner dispatcher exactly like REAL_MIGRATE.
+        ``source_game`` rides as a TRAILING u16 for the same reason as
+        REAL_MIGRATE's: a sweep-time bounce-home happens long after the
+        forwarding proxy is gone, and the packet is the space's only
+        copy."""
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(target_game)
+        p.append_data(space_data)
+        p.append_uint16(source_game)
+        self.send(MsgType.SPACE_MIGRATE_DATA, p)
+
+    def send_space_migrate_abort(self, spaceid: str, reason: str) -> None:
+        """Either direction: dispatcher→donor (target dead at PREPARE
+        time) or donor→dispatchers (deadline fired — unpark the members;
+        the donor has already unfrozen in place)."""
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_varstr(reason)
+        self.send(MsgType.SPACE_MIGRATE_ABORT, p)
+
+    def send_space_migrate_ack(self, spaceid: str, gameid: int) -> None:
+        """Receiver game → space-owner dispatcher: restore completed
+        (closes the dispatcher's handoff telemetry entry; member
+        routing rides each NOTIFY_CREATE_ENTITY, not this ack)."""
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(gameid)
+        self.send(MsgType.SPACE_MIGRATE_ACK, p)
+
+    def send_rebalance_migrate_space(
+        self, spaceid: str, to_game: int
+    ) -> None:
+        """Dispatcher→game: move the WHOLE space (members, slab columns,
+        interest edges) to ``to_game`` via the two-phase handoff
+        (rebalance/migrator.py space states)."""
+        p = Packet()
+        p.append_entity_id(spaceid)
+        p.append_uint16(to_game)
+        self.send(MsgType.REBALANCE_MIGRATE_SPACE, p)
+
+    def send_rebalance_plan(self, plan: dict) -> None:
+        """Planner-service game → its owner dispatcher: a rebalance plan
+        computed on the service plane (planner failover, ISSUE 18); the
+        dispatcher validates liveness and dispatches the commands."""
+        p = Packet()
+        p.append_data(plan)
+        self.send(MsgType.REBALANCE_PLAN, p)
+
     # --- redirect range: game → client via gate ----------------------------
     # Payloads start with [u16 gateid][clientid]; the dispatcher routes on the
     # gateid (DispatcherService.go:841-844) and the gate strips the prefix

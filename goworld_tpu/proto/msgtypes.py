@@ -49,7 +49,13 @@ import enum
 # blocks, proto/conn.py CLIENT_DELTA_SYNC_DTYPE). A v5 gate would drop
 # the type as unhandled and its clients would silently stop seeing
 # tiered neighbors move — fail the mixed pair at the handshake instead.
-PROTO_VERSION = 6
+# v7: whole-space migration + crash-survivable rebalance plane — the new
+# SPACE_MIGRATE_PREPARE / PREPARE_ACK / DATA / ABORT / ACK handoff types,
+# REBALANCE_MIGRATE_SPACE commands, and the planner-service REBALANCE_PLAN
+# push. A v6 peer would drop every one as unhandled, silently wedging a
+# space handoff mid-PREPARE (members parked until the deadline on every
+# round) — fail the mixed pair at the handshake instead.
+PROTO_VERSION = 7
 
 # High bit of the wire msgtype: a tracing trailer follows the payload.
 # Never a routing class — masked off before any msgtype comparison.
@@ -100,6 +106,35 @@ class MsgType(enum.IntEnum):
     # out of one space into a same-kind space on another game via the
     # hardened cross-game migration path (rebalance/migrator.py).
     REBALANCE_MIGRATE = 30
+    # --- whole-space migration (ISSUE 18; no reference analog — GoWorld
+    # never moves a live space).  The handoff is freeze-fence + fat
+    # transfer: PREPARE broadcast parks the listed members' streams on
+    # every owning dispatcher, each acks on its own FIFO (the freeze-ack
+    # fence), the donor packs only after every ack, and the one DATA
+    # payload routes exactly like REAL_MIGRATE — buffer behind a grace
+    # window, bounce HOME to the donor on a dead target.  Proved in
+    # analysis/modelcheck.py (space_handoff / space_member_race) BEFORE
+    # this implementation landed.
+    # Donor game → EVERY dispatcher: freeze announcement + member list.
+    SPACE_MIGRATE_PREPARE = 31
+    # Each dispatcher → donor game, after parking its listed members.
+    SPACE_MIGRATE_PREPARE_ACK = 32
+    # Donor → space-owner dispatcher → receiver game: the whole-space
+    # snapshot, with a source-game trailer for the bounce-home path.
+    SPACE_MIGRATE_DATA = 33
+    # Abort, either direction: dispatcher→donor (target dead at
+    # PREPARE) or donor→dispatchers (deadline fired; unpark members).
+    SPACE_MIGRATE_ABORT = 34
+    # Receiver game → space-owner dispatcher: restore completed
+    # (telemetry + handoff-entry cleanup; routing rides NOTIFY_CREATE).
+    SPACE_MIGRATE_ACK = 35
+    # Dispatcher → donor game: move one whole space to another game
+    # (the bin-packer's whole-space analog of REBALANCE_MIGRATE).
+    REBALANCE_MIGRATE_SPACE = 36
+    # Planner-service game → its owner dispatcher: an externally
+    # computed rebalance plan to validate and dispatch (planner
+    # failover rides the sharded-service plane, ISSUE 18).
+    REBALANCE_PLAN = 37
 
     # --- redirected to client via gate (proto.go:85-114) -------------------
     CREATE_ENTITY_ON_CLIENT = 1001

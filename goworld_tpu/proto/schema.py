@@ -194,6 +194,28 @@ SCHEMAS: tuple[MessageSchema, ...] = (
     schema(MsgType.REBALANCE_MIGRATE,
            ("from_space", "eid"), ("to_space", "eid"), ("to_game", "u16"),
            ("count", "u16")),
+    # v7 whole-space handoff (msgtypes.py:31-37 protocol notes).  The
+    # member list rides msgpack: dispatchers park exactly the LISTED
+    # eids (the freeze-time membership — a member that already migrated
+    # out is not parked; modelcheck space_member_race found the hole).
+    schema(MsgType.SPACE_MIGRATE_PREPARE,
+           ("spaceid", "eid"), ("to_game", "u16"),
+           ("member_eids", "data")),
+    schema(MsgType.SPACE_MIGRATE_PREPARE_ACK,
+           ("spaceid", "eid"), ("dispatcherid", "u16")),
+    # Mirrors REAL_MIGRATE: trailing source gameid readable without
+    # parsing the bson body, so the sweep-time bounce-home needs no
+    # proxy context.
+    schema(MsgType.SPACE_MIGRATE_DATA,
+           ("spaceid", "eid"), ("target_game", "u16"),
+           ("space_data", "data"), ("source_game", "u16")),
+    schema(MsgType.SPACE_MIGRATE_ABORT,
+           ("spaceid", "eid"), ("reason", "varstr")),
+    schema(MsgType.SPACE_MIGRATE_ACK,
+           ("spaceid", "eid"), ("gameid", "u16")),
+    schema(MsgType.REBALANCE_MIGRATE_SPACE,
+           ("spaceid", "eid"), ("to_game", "u16")),
+    schema(MsgType.REBALANCE_PLAN, ("plan", "data")),
     # --- redirect range (1001..1499): [u16 gateid][clientid] prefix --------
     _redirect(MsgType.CREATE_ENTITY_ON_CLIENT,
               ("is_player", "bool"), ("eid", "eid"), ("typename", "varstr"),
@@ -292,6 +314,7 @@ def schema_digest() -> str:
 SCHEMA_HISTORY: dict[int, str] = {
     5: "6707328a4b365972",
     6: "3f2d7dd284f1af13",
+    7: "08a4c48960727504",
 }
 
 
@@ -350,6 +373,12 @@ _FIELD_EXAMPLES: dict[tuple[int, str], object] = {
         "online_games": [1], "rejected": [], "kvreg": {}, "ready": True},
     (int(MsgType.GAME_LOAD_REPORT), "report"): {
         "cpu": 1.0, "entities": 1, "spaces": {}},
+    (int(MsgType.SPACE_MIGRATE_PREPARE), "member_eids"): [_EXAMPLE_EID],
+    (int(MsgType.SPACE_MIGRATE_DATA), "space_data"): {
+        "space": {}, "members": {}},
+    (int(MsgType.SPACE_MIGRATE_ABORT), "reason"): "deadline",
+    (int(MsgType.REBALANCE_PLAN), "plan"): {
+        "moves": [], "space_moves": []},
     (int(MsgType.NOTIFY_MAP_ATTR_CHANGE_ON_CLIENT), "path"): [],
     (int(MsgType.NOTIFY_MAP_ATTR_DEL_ON_CLIENT), "path"): [],
     (int(MsgType.NOTIFY_MAP_ATTR_CLEAR_ON_CLIENT), "path"): [],

@@ -63,16 +63,46 @@ PLANS = telemetry.counter(
     "Planner rounds by result (moved|balanced|paused_stale|paused_links|"
     "paused_few).",
     ("result",))
+# Whole-space handoffs (ISSUE 18; donor-side outcomes): done = the
+# SPACE_MIGRATE_DATA left and did not bounce back within the confirm
+# window; aborted = a dispatcher refused the PREPARE (dead target) or the
+# space died pre-pack; timeout = the per-space deadline fired mid-PREPARE
+# (unfrozen in place); rolled_back = the data bounced home and the space
+# restored where it was.
+SPACE_MIGRATIONS = telemetry.counter(
+    "rebalance_space_migrations_total",
+    "Whole-space handoffs by outcome "
+    "(done|aborted|timeout|rolled_back).",
+    ("outcome",))
+# Spaces currently mid-handoff on this game (preparing or in the bounce
+# window) — the gwtop REBAL column's "in flight" figure.
+SPACES_IN_FLIGHT = telemetry.gauge(
+    "rebalance_spaces_in_flight",
+    "Whole-space handoffs currently tracked by this game's migrator.")
+# Which game hosts the sharded planner service shard (0 on games not
+# hosting it; every game publishes its own view — the collector surfaces
+# the nonzero one). Dispatcher-local planning leaves this 0 everywhere.
+PLANNER_HOST = telemetry.gauge(
+    "rebalance_planner_host",
+    "1 when this game hosts the RebalancePlannerService shard, else 0.")
 
 from goworld_tpu.rebalance.migrator import RebalanceMigrator  # noqa: E402
-from goworld_tpu.rebalance.planner import Move, RebalancePlanner  # noqa: E402
+from goworld_tpu.rebalance.planner import (  # noqa: E402
+    Move,
+    RebalancePlanner,
+    SpaceMove,
+)
 from goworld_tpu.rebalance.report import build_load_report, load_score  # noqa: E402
 
 __all__ = [
     "MIGRATIONS",
     "LOAD_SCORE",
     "PLANS",
+    "SPACE_MIGRATIONS",
+    "SPACES_IN_FLIGHT",
+    "PLANNER_HOST",
     "Move",
+    "SpaceMove",
     "RebalancePlanner",
     "RebalanceMigrator",
     "build_load_report",

@@ -277,12 +277,38 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
     retraces = 0.0
     fused_classes = 0.0
     fused_slots = 0.0
+    # Rebalance plane (ISSUE 18): planner host + pause/failover state for
+    # the /cluster REBAL view and its alerts.
+    space_outcomes = {"done": 0.0, "aborted": 0.0, "timeout": 0.0,
+                      "rolled_back": 0.0}
+    paused_reasons = {"paused_stale": 0.0, "paused_links": 0.0,
+                      "paused_few": 0.0}
+    spaces_in_flight = 0.0
+    space_handoffs_parked = 0
+    planner_host = None
+    planner_last = None
+    planner_service = False
+    rebalance_enabled = False
     for name, row in processes.items():
         h = row["health"]
         kind = h.get("kind")
         if kind == "game":
             game_entities += int(h.get("entities", 0))
             game_clients += int(h.get("clients", 0))
+            ps = h.get("rebalance_planner")
+            if ps:
+                # This game hosts the sharded planner service right now.
+                planner_host = name
+                planner_last = ps.get("last_result")
+        elif kind == "dispatcher":
+            rb = h.get("rebalance") or {}
+            rebalance_enabled = rebalance_enabled or bool(rb.get("enabled"))
+            planner_service = planner_service or bool(
+                rb.get("planner_service"))
+            space_handoffs_parked += int(rb.get("space_handoffs", 0))
+            if rb.get("driver") and not rb.get("planner_service"):
+                planner_host = name
+                planner_last = rb.get("last_result")
         elif kind == "gate":
             gate_clients += int(h.get("clients", 0))
             gen = h.get("generation")
@@ -296,6 +322,18 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
         fused_classes = max(fused_classes,
                             _series_sum(m, "aoi_fused_classes"))
         fused_slots = max(fused_slots, _series_sum(m, "aoi_fused_slots"))
+        for outcome in space_outcomes:
+            space_outcomes[outcome] += _series_sum(
+                m, "rebalance_space_migrations_total", "outcome", outcome)
+        for reason in paused_reasons:
+            paused_reasons[reason] += _series_sum(
+                m, "rebalance_plans_total", "result", reason)
+        spaces_in_flight += _series_sum(m, "rebalance_spaces_in_flight")
+        if (planner_host is None
+                and _series_sum(m, "rebalance_planner_host") >= 1.0):
+            # Gauge fallback for hosts whose healthz row predates the
+            # rebalance_planner field (or non-game scrapes).
+            planner_host = name
     # Generation consistency: compare every binding against the gate's
     # own announced generation (only for gates that are reporting).
     for name, row in processes.items():
@@ -333,6 +371,17 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
         alerts.append(
             f"{int(retraces)} steady-state jit retrace(s) — see the "
             f"retrace WARN and /flight on the offending game")
+    # Rebalance-plane alerts (ISSUE 18): a paused planner names its guard
+    # reason, and an enabled planner service with NO live host is a
+    # failover in flight (or a wedged one — either way worth eyes).
+    if planner_last in paused_reasons:
+        alerts.append(
+            f"rebalance paused: {planner_last} (planner on "
+            f"{planner_host})")
+    if rebalance_enabled and planner_service and planner_host is None:
+        alerts.append(
+            "rebalance planner service has no live host "
+            "(failover in flight?)")
     return {
         "reporting": len(reporting),
         "expected": len(processes),
@@ -348,6 +397,17 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
             "stale": stale_gens,
         },
         "migrations": {k: int(v) for k, v in migrates.items()},
+        "rebalance": {
+            "enabled": rebalance_enabled,
+            "planner_service": planner_service,
+            "planner_host": planner_host,
+            "last_result": planner_last,
+            "rounds_paused": {k: int(v) for k, v in paused_reasons.items()},
+            "spaces_in_flight": int(spaces_in_flight),
+            "space_handoffs_parked": space_handoffs_parked,
+            "space_migrations": {
+                k: int(v) for k, v in space_outcomes.items()},
+        },
         "steady_state_retraces": int(retraces),
         "fused": {"classes": int(fused_classes), "slots": int(fused_slots)},
         "alerts": alerts,

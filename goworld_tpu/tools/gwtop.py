@@ -139,6 +139,7 @@ def _row(name: str, proc: dict[str, Any], tick_budget: float) -> list[str]:
         f"{int(backlog)}" if backlog is not None else "-",
         fused,
         _sync_col(m),
+        _rebal_col(h, m),
         f"{int(launches)}" if launches else "-",
         f"{int(retraces)}" if retraces else "0" if launches else "-",
     ]
@@ -158,8 +159,33 @@ def _sync_col(metrics: dict[str, Any]) -> str:
     return f"{counts}·{bpc:.0f}B/c" if bpc else counts
 
 
+def _rebal_col(h: dict[str, Any], metrics: dict[str, Any]) -> str:
+    """Rebalance column (ISSUE 18): ``P:<state>`` marks the process
+    hosting the planner (sharded service entity on a game, or the driver
+    dispatcher in non-service mode) with its last round's result; games
+    show their spaces mid-handoff (``Nsp→``), dispatchers their parked
+    member-stream count (``Npark``). '-' when the plane is quiet."""
+    kind = h.get("kind")
+    parts: list[str] = []
+    if kind == "game":
+        ps = h.get("rebalance_planner")
+        if ps:
+            parts.append(f"P:{ps.get('last_result', '?')}")
+        inflight = _gauge(metrics, "rebalance_spaces_in_flight")
+        if inflight:
+            parts.append(f"{int(inflight)}sp→")
+    elif kind == "dispatcher":
+        rb = h.get("rebalance") or {}
+        if rb.get("driver") and not rb.get("planner_service"):
+            parts.append(f"P:{rb.get('last_result', '?')}")
+        parked = int(rb.get("space_handoffs", 0))
+        if parked:
+            parts.append(f"{parked}park")
+    return " ".join(parts) if parts else "-"
+
+
 _HEADERS = ["PROCESS", "ST", "AGE", "UP", "CENSUS", "Q",
-            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "SYNC",
+            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "SYNC", "REBAL",
             "LAUNCH", "RETR"]
 
 
@@ -170,6 +196,17 @@ def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:
     summary = view.get("summary") or {}
     census = summary.get("census") or {}
     migrations = summary.get("migrations") or {}
+    rebal = summary.get("rebalance") or {}
+    rebal_line = ""
+    if rebal.get("enabled"):
+        sp = rebal.get("space_migrations") or {}
+        paused = sum((rebal.get("rounds_paused") or {}).values())
+        rebal_line = (
+            f" · rebal host={rebal.get('planner_host') or '-'}"
+            f" paused={paused}"
+            f" infl={rebal.get('spaces_in_flight', 0)}"
+            f" sp d{sp.get('done', 0)}/a{sp.get('aborted', 0)}"
+            f"/t{sp.get('timeout', 0)}/r{sp.get('rolled_back', 0)}")
     lines = [
         (f"goworld_tpu cluster · {summary.get('reporting', 0)}/"
          f"{summary.get('expected', 0)} reporting · "
@@ -178,7 +215,7 @@ def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:
          f"entities {census.get('game_entities', 0)} · "
          f"retraces {summary.get('steady_state_retraces', 0)} · "
          f"migr r{migrations.get('routed', 0)}/b{migrations.get('bounced', 0)}"
-         f"/c{migrations.get('cancel', 0)}"),
+         f"/c{migrations.get('cancel', 0)}" + rebal_line),
         (f"collector: {coll.get('targets', 0)} targets · poll "
          f"{coll.get('polls', 0)} @ {coll.get('interval_s', 0)}s · "
          f"stale>{coll.get('stale_after_s', 0)}s · heat="

@@ -18,11 +18,13 @@ import pytest
 from goworld_tpu.chaos import (
     ChaosCluster,
     scenario_battle_royale_freeze_restore,
+    scenario_battle_royale_keyframe_storm,
     scenario_battle_royale_kill_game,
     scenario_dispatcher_restart,
     scenario_game_kill_recreate,
     scenario_gate_kill_reconnect,
     scenario_paused_dispatcher,
+    scenario_service_outage_dispatcher_restart,
     scenario_severed_link,
     scenario_storage_outage,
 )
@@ -174,6 +176,70 @@ def test_storage_outage_circuit(tmp_path):
     r = _run(scenario_storage_outage, run_dir=str(tmp_path))
     assert r["lost_saves"] == 0
     assert r["recovery_s"] < 10.0
+
+
+def test_battle_royale_keyframe_storm(tmp_path):
+    """ISSUE 18: enter-wave keyframe storms under the delta sync plane.
+    Two scatter→collapse waves; each must force at least one new_pair
+    keyframe per re-formed interest edge (counter lockstep with the edge
+    census), with zero strict-bot errors — a delta record arriving before
+    its pair's keyframe would be flagged from the wire."""
+    r = _run(scenario_battle_royale_keyframe_storm, run_dir=str(tmp_path),
+             sync_knobs=dict(tier_cadences=(1, 4), quantize_bits=7))
+    assert r["bot_errors"] == 0
+    assert r["waves"] == 2
+    for kf in r["keyframes_per_wave"]:
+        assert kf >= r["edges_per_wave"]
+
+
+def test_service_outage_under_dispatcher_restart(tmp_path):
+    """ISSUE 18 catalog cross: service-heavy shard-routed saves while the
+    storage backend fails writes AND a dispatcher restarts — the circuit
+    opens (never wedges), mid-cross pings replay after the reconnect, the
+    shard-receipt trajectory stays exactly-once, and every deferred save
+    lands after the heal: zero lost documents, zero bot errors."""
+    r = _run(scenario_service_outage_dispatcher_restart,
+             run_dir=str(tmp_path))
+    assert r["bot_errors"] == 0
+    assert r["lost_saves"] == 0
+    assert r["failed_writes"] >= 3  # past the breaker threshold
+    assert r["recovery_s"] < 15.0
+
+
+def test_multigame_spaces_kill_crosses(tmp_path):
+    """ISSUE 18 acceptance: the 3-game whole-space chaos run. Receivers
+    boot ARENA-LESS so the sharded planner service can only balance by
+    whole-space handoffs; the three kill crosses then hit the protocol in
+    its windows — receiver killed mid-PREPARE (donor space unfreezes in
+    place or bounces home, outcome counted aborted/rolled_back/timeout,
+    never done), donor killed mid-COMMIT (the routed payload is the
+    space's one live copy and must be restored on the receiver), and the
+    planner HOST killed after evacuation (kvreg purge → a survivor
+    re-claims the shard and resumes rebalancing). Census conserved and
+    zero strict-bot errors throughout; the fleet ends balanced."""
+    from goworld_tpu.chaos.multigame import run_multigame_spaces
+
+    r = run_multigame_spaces(str(tmp_path), n_bots=12, n_games=3,
+                             transport="tcp")
+    assert r["bot_errors"] == 0
+    assert r["zero_loss"] is True
+    phases = r["phases"]
+    assert set(phases) == {"kill_receiver_mid_prepare",
+                           "kill_donor_mid_commit", "kill_planner_host"}
+    for name, p in phases.items():
+        assert p["bot_errors"] == 0, name
+        assert p["zero_loss"] is True, name
+    # Mid-PREPARE: the donor's outcome counters must classify the wreck
+    # as a failure (aborted/rolled_back/timeout) — never a false "done".
+    assert phases["kill_receiver_mid_prepare"]["donor_outcomes_failed"] >= 1
+    # Mid-COMMIT: the space landed whole on the receiver.
+    assert phases["kill_donor_mid_commit"]["moved_members"] > 0
+    # Planner failover: a DIFFERENT live game claimed the shard, and its
+    # own gauge agreed with the kvreg claim.
+    ph = phases["kill_planner_host"]
+    assert ph["new_host"] != ph["old_host"]
+    assert ph["new_host_gauge"] == 1.0
+    assert sum(r["census_final"]) == 12
 
 
 @pytest.mark.slow

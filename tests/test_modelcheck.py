@@ -31,6 +31,8 @@ from goworld_tpu.analysis.modelcheck import (
     GateGenerationModel,
     MigConfig,
     MigrateCrashModel,
+    SpaceMigConfig,
+    SpaceMigrateModel,
     deep_configs,
     explore,
     tier1_configs,
@@ -235,6 +237,8 @@ EXPECTED_STATES = {
     "migrate_no_return": 117,
     "gate_generation": 4,
     "boot_flap": 8,
+    "space_handoff": 1623,
+    "space_member_race": 220,
 }
 
 
@@ -276,6 +280,18 @@ _MUTANT_MODELS = {
     "no_sync_parking": lambda m: MigrateCrashModel(MigConfig(mutants=m)),
     "skip_gen_check": lambda m: GateGenerationModel(GateGenConfig(mutants=m)),
     "drop_boot_no_game": lambda m: BootFlapModel(BootConfig(mutants=m)),
+    # -- space-migration rules --
+    "no_space_bounce": lambda m: SpaceMigrateModel(SpaceMigConfig(mutants=m)),
+    "no_space_park": lambda m: SpaceMigrateModel(SpaceMigConfig(mutants=m)),
+    "no_unfreeze_on_abort": lambda m: SpaceMigrateModel(
+        SpaceMigConfig(mutants=m)),
+    "no_frozen_join_guard": lambda m: SpaceMigrateModel(
+        SpaceMigConfig(mutants=m)),
+    # keeping a member's in-flight entity migrate only bites when the
+    # member actually races the freeze — the space_member_race bounds
+    "no_freeze_cancel_member": lambda m: SpaceMigrateModel(SpaceMigConfig(
+        name="space_member_race", crashes=0, restarts=0, joins=0,
+        member_migrates=1, mutants=m)),
 }
 
 

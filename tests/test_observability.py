@@ -303,6 +303,50 @@ def test_summarize_counts_retraces_as_alert():
     assert any("retrace" in a for a in s["alerts"])
 
 
+def test_summarize_rebalance_plane_and_alerts():
+    """ISSUE 18: the /cluster rebalance section aggregates the planner
+    host (sharded-service healthz row), pause reasons, in-flight spaces,
+    parked streams and space-migration outcomes — and a paused planner /
+    a host-less enabled planner service each raise an alert."""
+    rows = _healthy_rows()
+    rows["dispatcher1"]["health"]["rebalance"] = {
+        "enabled": True, "driver": True, "planner_service": True,
+        "last_result": None, "reporting_games": [], "space_handoffs": 2}
+    rows["game1"]["health"]["rebalance_planner"] = {
+        "last_result": "paused_stale", "reporting_games": [1]}
+    rows["game1"]["metrics"].update({
+        "rebalance_plans_total": {"type": "counter", "series": [
+            {"labels": {"result": "paused_stale"}, "value": 3}]},
+        "rebalance_spaces_in_flight": {"type": "gauge", "series": [
+            {"labels": {}, "value": 1}]},
+        "rebalance_space_migrations_total": {"type": "counter", "series": [
+            {"labels": {"outcome": "done"}, "value": 5},
+            {"labels": {"outcome": "rolled_back"}, "value": 1}]},
+    })
+    s = summarize(rows)
+    rb = s["rebalance"]
+    assert rb["enabled"] is True and rb["planner_service"] is True
+    assert rb["planner_host"] == "game1"
+    assert rb["last_result"] == "paused_stale"
+    assert rb["rounds_paused"]["paused_stale"] == 3
+    assert rb["spaces_in_flight"] == 1
+    assert rb["space_handoffs_parked"] == 2
+    assert rb["space_migrations"] == {
+        "done": 5, "aborted": 0, "timeout": 0, "rolled_back": 1}
+    assert any("rebalance paused: paused_stale" in a for a in s["alerts"])
+    # Planner service enabled but NO live host anywhere reporting: the
+    # failover-in-flight alert (what a wedged kvreg re-claim looks like).
+    del rows["game1"]["health"]["rebalance_planner"]
+    s2 = summarize(rows)
+    assert s2["rebalance"]["planner_host"] is None
+    assert any("no live host" in a for a in s2["alerts"])
+    # A healthy moving planner raises neither alert.
+    rows["game1"]["health"]["rebalance_planner"] = {
+        "last_result": "moved", "reporting_games": [1]}
+    s3 = summarize(rows)
+    assert not any("rebalance" in a for a in s3["alerts"])
+
+
 def test_collector_poll_view_and_down_target():
     async def run():
         healthy = {"health": {"kind": "game", "id": 1, "entities": 2,
@@ -479,6 +523,48 @@ def test_gwtop_render_flags_trouble():
     assert "retraces 1" in page
     assert "processes not reporting: gate1" in page
     assert "10.0/20.0" in page  # tick p50/p95 ms of game1
+
+
+def test_gwtop_rebal_column_and_summary_line():
+    """ISSUE 18: the REBAL column marks the planner host (game service
+    entity or non-service driver dispatcher), spaces mid-handoff and
+    parked member streams; an enabled plane adds its segment to the
+    summary line."""
+    from goworld_tpu.tools import gwtop
+
+    game_h = {"kind": "game",
+              "rebalance_planner": {"last_result": "moved",
+                                    "reporting_games": [1, 2]}}
+    game_m = {"rebalance_spaces_in_flight": {
+        "type": "gauge", "series": [{"labels": {}, "value": 1}]}}
+    assert gwtop._rebal_col(game_h, game_m) == "P:moved 1sp→"
+    disp_h = {"kind": "dispatcher",
+              "rebalance": {"enabled": True, "driver": True,
+                            "planner_service": False,
+                            "last_result": "balanced",
+                            "space_handoffs": 2}}
+    assert gwtop._rebal_col(disp_h, {}) == "P:balanced 2park"
+    # Service mode: the dispatcher is just the conduit — no P: marker.
+    disp_h["rebalance"]["planner_service"] = True
+    assert gwtop._rebal_col(disp_h, {}) == "2park"
+    assert gwtop._rebal_col({"kind": "gate"}, {}) == "-"
+
+    view = {"collector": {}, "processes": {},
+            "summary": {"rebalance": {
+                "enabled": True, "planner_service": True,
+                "planner_host": "game2", "last_result": "moved",
+                "rounds_paused": {"paused_stale": 1},
+                "spaces_in_flight": 2, "space_handoffs_parked": 0,
+                "space_migrations": {"done": 5, "aborted": 0,
+                                     "timeout": 0, "rolled_back": 1}}}}
+    page = gwtop.render(view)
+    assert "REBAL" in page
+    assert "rebal host=game2" in page
+    assert "paused=1" in page and "infl=2" in page
+    assert "d5/a0/t0/r1" in page
+    # A disabled plane keeps the summary line quiet.
+    view["summary"]["rebalance"]["enabled"] = False
+    assert "rebal host" not in gwtop.render(view)
 
 
 def gwtop_render(view):

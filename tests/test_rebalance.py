@@ -30,7 +30,13 @@ from goworld_tpu.entity.slabs import SIF_SYNC_NEIGHBOR_CLIENTS, SIF_SYNC_OWN_CLI
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.vector import Vector3
 from goworld_tpu.rebalance import RebalanceMigrator, RebalancePlanner
-from goworld_tpu.rebalance.migrator import CONFIRM_GRACE
+from goworld_tpu.rebalance.migrator import CONFIRM_GRACE, SPACE_CONFIRM_GRACE
+from goworld_tpu.rebalance.planner import (
+    Move,
+    SpaceMove,
+    plan_from_wire,
+    plan_to_wire,
+)
 from goworld_tpu.rebalance.report import load_score
 
 
@@ -78,6 +84,19 @@ def stub_cluster(monkeypatch):
     rec = Recorder()
     monkeypatch.setattr(dc, "select_by_entity_id", lambda eid: rec)
     return rec
+
+
+@pytest.fixture
+def stub_cluster_all(monkeypatch):
+    """Two stub dispatchers: the space handoff broadcasts PREPARE/ABORT to
+    every dispatcher (select_all) and routes the data payload by space id
+    (select_by_entity_id → the first stub)."""
+    import goworld_tpu.dispatchercluster as dc
+
+    senders = [Recorder(), Recorder()]
+    monkeypatch.setattr(dc, "select_all", lambda: list(senders))
+    monkeypatch.setattr(dc, "select_by_entity_id", lambda eid: senders[0])
+    return senders
 
 
 # --- planner -----------------------------------------------------------------
@@ -176,6 +195,68 @@ def test_planner_splits_budget_across_donor_spaces():
     assert moves[0].from_space.startswith("a2")
 
 
+def test_planner_whole_space_when_receiver_lacks_kind():
+    """ISSUE 18: a receiver with NO same-kind space to absorb into gets a
+    WHOLE SPACE instead — largest-first-fit among donor spaces whose
+    population fits the 2c <= delta rule (s2 at 6 of delta 10 would land
+    past the midpoint and is skipped for s1 at 4)."""
+    p = _planner(min_entity_delta=4, max_moves_per_round=8,
+                 max_space_moves_per_round=1)
+    p.on_report(1, _report(10, [["s1".ljust(16, "0"), 1, 4],
+                                ["s2".ljust(16, "0"), 1, 6]]), now=1.0)
+    p.on_report(2, _report(0, []), now=1.0)
+    moves = p.plan({1, 2}, 1.1)
+    assert len(moves) == 1
+    m = moves[0]
+    assert isinstance(m, SpaceMove)
+    assert (m.from_game, m.to_game) == (1, 2)
+    assert m.spaceid.startswith("s1")
+    assert m.count == 4
+    assert "1 spaces" in p.last_result
+
+
+def test_planner_whole_space_fit_blocks_oscillation():
+    """The docstring case: a space of 4 with delta 4 would flip 8/4 into
+    4/8 forever — 2c <= delta refuses it; a space that fits still moves."""
+    p = _planner(min_entity_delta=4, max_moves_per_round=0,
+                 max_space_moves_per_round=2)
+    p.on_report(1, _report(8, [["a".ljust(16, "0"), 1, 4],
+                               ["b".ljust(16, "0"), 2, 4]]), now=1.0)
+    p.on_report(2, _report(4, []), now=1.0)
+    assert p.plan({1, 2}, 1.1) == []  # both spaces: 2*4 > 4
+    assert p.last_result == "balanced"
+    p2 = _planner(min_entity_delta=4, max_moves_per_round=0,
+                  max_space_moves_per_round=2)
+    p2.on_report(1, _report(8, [["a".ljust(16, "0"), 1, 2],
+                                ["b".ljust(16, "0"), 1, 6]]), now=1.0)
+    p2.on_report(2, _report(4, []), now=1.0)
+    moves = p2.plan({1, 2}, 1.1)
+    assert [m.count for m in moves] == [2]  # b (2*6 > 4) skipped for a
+
+
+def test_planner_whole_space_disabled_by_default():
+    """max_space_moves_per_round defaults to 0: a receiver with no
+    same-kind space simply absorbs nothing."""
+    p = _planner(min_entity_delta=4, max_moves_per_round=8)
+    p.on_report(1, _report(10, [["s1".ljust(16, "0"), 1, 4]]), now=1.0)
+    p.on_report(2, _report(0, []), now=1.0)
+    assert p.plan({1, 2}, 1.1) == []
+    assert p.last_result == "balanced"
+
+
+def test_plan_wire_roundtrip_and_rejection():
+    """plan_to_wire/plan_from_wire carry a mixed round losslessly; a
+    malformed payload raises (a bad plan must not half-execute)."""
+    plans = [Move(1, 2, "sa", "sb", 3), SpaceMove(2, 3, "sc", 5)]
+    assert plan_from_wire(plan_to_wire(plans)) == plans
+    with pytest.raises(ValueError):
+        plan_from_wire("nope")
+    with pytest.raises(ValueError):
+        plan_from_wire({"moves": [[1, 2, "sa"]]})  # short row
+    with pytest.raises(ValueError):
+        plan_from_wire({"space_moves": [[1, 2, "sc", "many"]]})
+
+
 def test_load_score_weighs_compute_beyond_population():
     flat = _report(10, [], cpu=0.0, p95=0.0, q=0)
     hot = _report(10, [], cpu=80.0, p95=40.0, q=50)
@@ -263,6 +344,163 @@ def test_migrator_arrival_cooldown_for_newcomers():
     m.on_arrived(a.id, now=10.0)  # normal receiver-side arrival
     assert m.eligible(space, now=12.0) == []
     assert m.eligible(space, now=16.0) == [a]
+
+
+# --- whole-space handoff units (ISSUE 18) ------------------------------------
+
+
+def test_space_handoff_deadline_aborts_and_unfreezes(stub_cluster_all):
+    """``preparing`` past the deadline → ABORT: the space unfreezes in
+    place, queued joins replay, the abort broadcast unparks every
+    dispatcher, and the space goes on failure cooldown (modelcheck I3:
+    never FROZEN forever)."""
+    space = em.create_space_locally(1)
+    a = em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(migrate_timeout=2.0, cooldown=1.0)
+    # A member with a pending ENTITY migrate: the freeze cancels it
+    # LOCALLY (no CANCEL_MIGRATE — the stream must stay parked).
+    m.migrate(a, "R" * 16, now=9.0)
+    assert m.handle_space_command(space, to_game=2, now=10.0) is True
+    assert space.frozen is True
+    assert a._enter_space_request is None and a.id not in m._pending
+    for s in stub_cluster_all:
+        assert "send_space_migrate_prepare" in s.names()
+        assert "send_cancel_migrate" not in s.names()
+    # A join while FROZEN queues — membership is the handoff snapshot.
+    d = em.create_entity_locally("RbAvatar", pos=Vector3())
+    space._enter(d, Vector3(1.0, 0.0, 2.0))
+    assert d not in space.entities
+    assert m.spaces_in_flight == 1
+    m.tick(11.0)
+    assert m.spaces_in_flight == 1  # inside the window
+    m.tick(12.5)
+    assert m.spaces_timeout == 1 and m.spaces_in_flight == 0
+    assert space.frozen is False
+    assert d in space.entities  # queued join replayed on unfreeze
+    for s in stub_cluster_all:
+        assert "send_space_migrate_abort" in s.names()
+    # Failure cooldown: the stale re-command degrades to nothing...
+    assert m.handle_space_command(space, to_game=2, now=12.6) is False
+    # ...until it expires.
+    assert m.handle_space_command(space, to_game=2, now=14.0) is True
+
+
+def test_space_handoff_refuses_stale_and_self_commands(stub_cluster_all):
+    space = em.create_space_locally(1)
+    m = RebalanceMigrator(migrate_timeout=5.0)
+    assert m.handle_space_command(
+        space, to_game=em.runtime.gameid, now=1.0) is False
+    assert m.handle_space_command(space, to_game=2, now=1.0) is True
+    # Already in flight (and frozen): refused, state untouched.
+    assert m.handle_space_command(space, to_game=3, now=1.1) is False
+    assert m._pending_spaces[space.id].to_game == 2
+
+
+def test_space_handoff_commits_after_all_acks(stub_cluster_all):
+    """The freeze-ack fence: the pack waits for EVERY dispatcher's
+    PREPARE ack; the data payload then routes by space id, queued joins
+    re-dispatch behind it, and the bounce window expiring counts done."""
+    space = em.create_space_locally(1)
+    sid = space.id
+    em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(migrate_timeout=5.0, cooldown=1.0)
+    assert m.handle_space_command(space, to_game=2, now=10.0) is True
+    d = em.create_entity_locally("RbAvatar", pos=Vector3())
+    space._enter(d, Vector3(3.0, 0.0, 4.0))  # queued mid-handoff join
+    m.on_space_prepare_ack(sid, 1, now=10.1)
+    assert m._pending_spaces[sid].state == "preparing"  # 1 of 2 acks
+    assert "send_space_migrate_data" not in stub_cluster_all[0].names()
+    m.on_space_prepare_ack(sid, 2, now=10.2)
+    p = m._pending_spaces[sid]
+    assert p.state == "sent"
+    assert p.deadline == pytest.approx(10.2 + SPACE_CONFIRM_GRACE)
+    # The local copies are GONE (the payload is the one live copy)...
+    assert em.get_space(sid) is None
+    data_calls = [a for n, a in stub_cluster_all[0].calls
+                  if n == "send_space_migrate_data"]
+    assert len(data_calls) == 1
+    args = data_calls[0]
+    assert args[0] == sid and args[1] == 2
+    assert len(args[2]["members"]) == 2
+    # ...and the queued joiner re-dispatched its enter toward the route.
+    assert d._enter_space_request is not None
+    assert d._enter_space_request[0] == sid
+    m.tick(10.2 + SPACE_CONFIRM_GRACE - 0.1)
+    assert m.spaces_done == 0
+    m.tick(10.3 + SPACE_CONFIRM_GRACE)
+    assert m.spaces_done == 1 and m.spaces_in_flight == 0
+
+
+def test_space_handoff_bounce_home_rolls_back(stub_cluster_all):
+    """SPACE_MIGRATE_DATA arriving back on the DONOR (dispatcher bounced
+    it off a dead target) restores the space in place with every member,
+    counts rolled_back, re-broadcasts the unpark, and cooldowns the
+    space against an instant re-donation."""
+    space = em.create_space_locally(1)
+    sid = space.id
+    for _ in range(3):
+        em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    m = RebalanceMigrator(migrate_timeout=5.0, cooldown=1.0)
+    assert m.handle_space_command(space, to_game=2, now=10.0) is True
+    m.on_space_prepare_ack(sid, 1, now=10.1)
+    m.on_space_prepare_ack(sid, 2, now=10.1)
+    bundle = next(a for n, a in stub_cluster_all[0].calls
+                  if n == "send_space_migrate_data")[2]
+    for s in stub_cluster_all:
+        s.calls.clear()
+    m.on_space_data(sid, bundle, source_game=2, now=11.0)
+    assert m.spaces_rolled_back == 1 and m.spaces_done == 0
+    assert m.spaces_in_flight == 0
+    restored = em.get_space(sid)
+    assert restored is not None and not restored.frozen
+    assert len(restored.entities) == 3
+    for s in stub_cluster_all:
+        assert "send_space_migrate_abort" in s.names()  # bounced_home
+    assert m.handle_space_command(restored, to_game=2, now=11.1) is False
+
+
+def test_space_handoff_receiver_acks_and_cooldowns(stub_cluster_all):
+    """Receiver side of SPACE_MIGRATE_DATA: restore live, announce
+    SPACE_MIGRATE_ACK to every dispatcher (clears their handoff entries),
+    and start the newcomer cooldown so this game doesn't re-donate it."""
+    space = em.create_space_locally(1)
+    sid = space.id
+    em.create_entity_locally("RbAvatar", space=space, pos=Vector3())
+    space.freeze_space()
+    bundle, queued = em.pack_space(space)
+    assert queued == []
+    recv = RebalanceMigrator(cooldown=5.0)
+    recv.on_space_data(sid, bundle, source_game=1, now=10.0)
+    restored = em.get_space(sid)
+    assert restored is not None and len(restored.entities) == 1
+    for s in stub_cluster_all:
+        assert "send_space_migrate_ack" in s.names()
+    assert recv.spaces_rolled_back == 0 and recv.spaces_done == 0
+    assert recv.handle_space_command(restored, to_game=2, now=12.0) is False
+    assert recv.handle_space_command(restored, to_game=2, now=16.0) is True
+
+
+def test_space_handoff_dispatcher_abort_and_stale_acks(stub_cluster_all):
+    """A dispatcher refusing the PREPARE (target dead) aborts the handoff
+    — unfreeze in place, count aborted — and every later ack or duplicate
+    abort of the resolved handoff is stale: ignored, state unchanged."""
+    space = em.create_space_locally(1)
+    sid = space.id
+    m = RebalanceMigrator(migrate_timeout=5.0, cooldown=1.0)
+    m.on_space_prepare_ack("no-such-space".ljust(16, "0"), 1, now=0.5)
+    assert m.handle_space_command(space, to_game=2, now=1.0) is True
+    m.on_space_abort(sid, "target_dead", now=1.5)
+    assert m.spaces_aborted == 1 and m.spaces_in_flight == 0
+    assert space.frozen is False
+    # Late PREPARE ack / duplicate abort of the resolved handoff: no-ops.
+    m.on_space_prepare_ack(sid, 1, now=1.6)
+    m.on_space_prepare_ack(sid, 2, now=1.6)
+    m.on_space_abort(sid, "target_dead", now=1.7)
+    assert m.spaces_aborted == 1
+    assert em.get_space(sid) is space  # never packed
+    assert "send_space_migrate_data" not in stub_cluster_all[0].names()
 
 
 # --- migration edge cases (the satellite checklist) --------------------------
