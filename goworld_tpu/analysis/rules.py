@@ -464,6 +464,10 @@ HOT_PATHS: dict[str, set[str]] = {
         "_step_packed_fused_jnp", "_step_packed_fused_pallas",
         # The [sync] tier pass rides the step launch: loop-free jnp.
         "_tier_pass",
+        # Device-resident tick (ISSUE 19): the Pallas event kernel body
+        # (trace-time Python must stay loop-free — in-kernel iteration is
+        # lax.fori_loop) and the edge-verdict pass that rides the step.
+        "_event_kernel", "_edge_verdicts",
     },
     "goworld_tpu/parallel/spatial.py": {
         "_spatial_step_fused_impl",
@@ -474,6 +478,17 @@ HOT_PATHS: dict[str, set[str]] = {
         # _spatial_step_impl, outside the guarded set).
         "_spatial_step_pallas_impl", "_spatial_step_pallas_fused_impl",
         "_spatial_drain_bits", "_build_table_strip", "_fast_guard_strip",
+        # In-kernel drain (ISSUE 19): the slot/own plane scatter feeding
+        # the kernel's pair emission runs every strip tick.
+        "_scatter_slotown",
+    },
+    # Fused interest-edge delivery (ISSUE 19): the decode split and the
+    # device edge-snapshot build run every delivery tick — vectorized
+    # numpy only; the thin guarded per-row loop lives in _apply_edge_rows,
+    # outside the guarded set by design (it is the contractual per-entity
+    # interest bookkeeping, already minimal).
+    "goworld_tpu/entity/aoi/batched.py": {
+        "_deliver_fused", "_build_device_edges",
     },
     "goworld_tpu/parallel/mesh.py": {
         "_sharded_step_fused",
@@ -492,6 +507,10 @@ HOT_PATHS: dict[str, set[str]] = {
     # loops that remain are baselined with their boundedness reasons).
     "goworld_tpu/entity/entity_manager.py": {
         "pack_space", "restore_space_bundle",
+        # Columnar batch persistence (ISSUE 19): the per-column gather
+        # core — loop-free; the per-entity cache stitch stays in
+        # primed_column_snapshot outside the guarded set (dict stores).
+        "_gather_column",
     },
     "goworld_tpu/rebalance/migrator.py": {
         "handle_space_command", "_pack_and_send", "on_space_data",

@@ -895,6 +895,21 @@ def _royale_edges(cluster: ChaosCluster) -> int:
                if e.typename == "ChaosAvatar")
 
 
+def _edge_table_eids() -> list:
+    """The slabs' device edge columns (the subj/wat slot pairs a batched
+    AOI dispatch ships for the tier/verdict passes) canonicalized to eid
+    space and sorted: slots are reassigned on restore, eids are the
+    identity, so equality of two snapshots is bit-identity of the edge
+    TABLE contents independent of slot numbering and row order."""
+    from goworld_tpu.entity import entity_manager as em
+
+    by_slot = {e._slot: e.id for e in em.entities().values()
+               if e._slot >= 0}
+    _ver, n, subj, wat = em.runtime.slabs.snapshot_edges_for_tiering()
+    return sorted((by_slot[int(s)], by_slot[int(w)])
+                  for s, w in zip(subj[:n], wat[:n]))
+
+
 async def scenario_battle_royale_kill_game(
     cluster: ChaosCluster, ticks: int = 16, recovery_deadline: float = 20.0,
 ) -> dict:
@@ -970,6 +985,10 @@ async def scenario_battle_royale_freeze_restore(
         e.id: (e.position.x, e.position.z, e.attrs.get_int("pings"))
         for e in em.entities().values() if e.typename == "ChaosAvatar"}
     assert len(frozen) == n
+    # Pre-freeze device edge columns in eid space (ISSUE 19): restore
+    # rebuilds interest from scratch (freeze data carries no edges), and
+    # identical positions must reconverge to a bit-identical edge table.
+    pre_edges = _edge_table_eids()
     # The freeze file lands in cwd (game/service.py freeze_filename) —
     # point cwd at the run dir for the freeze->restore window.
     prev_cwd = os.getcwd()
@@ -1011,6 +1030,13 @@ async def scenario_battle_royale_freeze_restore(
         assert abs(rx - x) < 1e-6 and abs(rz - z) < 1e-6, (
             f"{eid}: position drifted across restore")
         assert rpings == pings, f"{eid}: pings column lost across restore"
+    # Interest rebuilt from scratch must land on the SAME edge table the
+    # frozen world had: positions are bit-identical, so the rebuilt
+    # device edge columns must be too (eid space — slots renumber).
+    await cluster._wait(
+        lambda: _edge_table_eids() == pre_edges, recovery_deadline,
+        "post-restore edge table never reconverged bit-identical to the "
+        "pre-freeze device edge columns")
     # Resume the collapse on the restored fleet.
     await _royale_collapse(cluster, ticks // 2, ticks, ticks)
     rt = await cluster.assert_rpc_roundtrip(recovery_deadline)
@@ -1030,6 +1056,7 @@ async def scenario_battle_royale_freeze_restore(
             "recovery_s": round(recovery, 3),
             "post_roundtrip_s": round(rt, 3),
             "cluster_view_converge_s": round(converge, 3),
+            "restored_edge_table_rows": len(pre_edges),
             "endgame_edges": endgame, "bot_errors": len(errors)}
 
 

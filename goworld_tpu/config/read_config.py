@@ -191,6 +191,12 @@ class AOIConfig:
     # kernel slab. 0 = derive (2x the uniform strip width, clamped to
     # planner feasibility). Ignored by the jnp spatial backend.
     pallas_strip_cols: int = 0
+    # In-kernel event drain of the Pallas spatial tier: the kernel launch
+    # itself emits the compacted (slot, slot) event pairs through SMEM
+    # cursors, so a steady strip tick needs no XLA rank-select pass.
+    # Overflowing ticks repage wholly through the XLA drain (exact).
+    # Ignored by the jnp spatial backend.
+    pallas_inkernel_drain: bool = True
     # Grid geometry (0 = derive from max_entities; see params_from_config).
     grid: int = 0  # cells per side (grid_x = grid_z)
     cell_size: float = 0.0  # cell side length; must be >= max AOI distance
@@ -563,6 +569,9 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             strip_placement=s.get(
                 "strip_placement", "topology").strip().lower(),
             pallas_strip_cols=int(s.get("pallas_strip_cols", 0)),
+            pallas_inkernel_drain=s.get(
+                "pallas_inkernel_drain", "true").strip().lower()
+            in ("1", "true", "yes"),
             compilation_cache=s.get("compilation_cache", "auto").strip(),
             grid=int(s.get("grid", 0)),
             cell_size=float(s.get("cell_size", 0.0)),

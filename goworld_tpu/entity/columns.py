@@ -190,7 +190,7 @@ class ColumnBackedMapAttr(MapAttr):
     snapshot taken at release time — same contract as the entity's
     ``_final_pos_yaw``."""
 
-    __slots__ = ("_entity", "_slabs", "_colspecs", "_final")
+    __slots__ = ("_entity", "_slabs", "_colspecs", "_final", "_primed")
 
     def __init__(self, entity, slabs, colspecs: dict[str, ColumnSpec]) -> None:
         super().__init__()
@@ -198,10 +198,14 @@ class ColumnBackedMapAttr(MapAttr):
         self._slabs = slabs
         self._colspecs = colspecs
         self._final: dict[str, Any] | None = None
+        self._primed: dict[str, Any] | None = None
 
     # --- column cell access -------------------------------------------------
 
     def _col_get(self, key: str) -> Any:
+        primed = self._primed
+        if primed is not None and key in primed:
+            return primed[key]
         spec = self._colspecs[key]
         slot = self._entity._slot
         if slot < 0:
@@ -211,6 +215,10 @@ class ColumnBackedMapAttr(MapAttr):
         return spec.to_python(self._slabs.columns[key][slot])
 
     def _col_set(self, key: str, value: Any) -> None:
+        if self._primed is not None:
+            # A write inside a primed window (an overridden snapshot hook
+            # mutating state) must be visible to subsequent reads.
+            self._primed.pop(key, None)
         spec = self._colspecs[key]
         slot = self._entity._slot
         if slot < 0:
@@ -222,6 +230,21 @@ class ColumnBackedMapAttr(MapAttr):
         # Protect the write from an in-flight fused tick's writeback
         # (aoi/batched.py _consume_fused): host writes win.
         self._slabs.fused_dirty[slot] = True
+
+    def prime_columns(self, values: dict[str, Any]) -> None:
+        """Install a batch-gathered column value cache (columnar batch
+        persistence, entity/entity_manager.py): within the primed window
+        every column read returns the pre-gathered plain-Python value
+        instead of touching the slab row, so a per-type snapshot round
+        costs ONE fancy-index gather per column instead of one slab read
+        per entity per key. Values must be exactly what ``to_python``
+        would return (the gather uses ndarray.tolist(), which performs
+        the identical widening) — bit-identity of freeze/migrate blobs
+        is asserted by tests/test_columns.py."""
+        self._primed = values
+
+    def unprime_columns(self) -> None:
+        self._primed = None
 
     def _snapshot_columns(self) -> None:
         """Called by Entity._release_slab_slot just before the slot goes:

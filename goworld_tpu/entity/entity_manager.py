@@ -15,11 +15,14 @@ how reference entity tests run without a dispatcher (SURVEY.md §4.1).
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from typing import Optional, Type
+
+import numpy as np
 
 from goworld_tpu import consts, dispatchercluster, telemetry
 from goworld_tpu.common import gen_entity_id, gen_fixed_entity_id
-from goworld_tpu.entity.columns import make_attr_root
+from goworld_tpu.entity.columns import ColumnBackedMapAttr, make_attr_root
 from goworld_tpu.entity.entity import (
     Entity,
     EntityTypeDesc,
@@ -43,6 +46,15 @@ _HOP = telemetry.counter(
     ("hop",))
 _HOP_COLLECT = _HOP.labels("game_collect")
 _HOP_PACK = _HOP.labels("game_pack")
+
+# Host-phase attribution, persist half (the delivery half lives in
+# aoi/batched.py — telemetry.counter get-or-creates, so both modules share
+# one family): wall seconds spent building freeze/migrate/save snapshots,
+# including the columnar batch gather that feeds them.
+_PHASE_PERSIST = telemetry.counter(
+    "aoi_host_phase_seconds_total",
+    "Busy wall seconds per host-side tick phase (delivery|persist).",
+    ("phase",)).labels("persist")
 
 
 class Runtime:
@@ -70,6 +82,10 @@ class Runtime:
         # [aoi] pallas_strip_cols: static strip-width cap of the Pallas
         # spatial tier's kernel slab (0 = derive: 2x the uniform strip).
         self.aoi_pallas_strip_cols: int = 0
+        # [aoi] pallas_inkernel_drain: the Pallas spatial tier's kernel
+        # launch emits the compacted event pairs itself (steady strip
+        # ticks run no XLA rank-select pass).
+        self.aoi_pallas_inkernel_drain: bool = True
         # Multi-HOST (DCN) tier: True once this process has joined the
         # jax.distributed mesh ([aoi] multihost_coordinator; the game
         # service calls init_multihost before any jax use).
@@ -109,6 +125,7 @@ class Runtime:
                 fuse_logic=self.aoi_fuse_logic,
                 strip_placement=self.aoi_strip_placement,
                 pallas_strip_cols=self.aoi_pallas_strip_cols,
+                pallas_inkernel_drain=self.aoi_pallas_inkernel_drain,
             )
             self.aoi_service.delivery = self.aoi_delivery
             self.aoi_service.sync_wait_budget = self.aoi_sync_wait_budget
@@ -584,16 +601,24 @@ def pack_space(space: Space) -> tuple[dict, list]:
     members: dict[str, dict] = {}
     # Deterministic order (by id): restore replays in sorted order too,
     # so donor-side pack and receiver-side restore walk the same sequence.
-    for e in sorted(space.entities, key=lambda e: e.id):
+    # on_migrate_out hooks run BEFORE the primed window — they may mutate
+    # column attrs, which the batch gather must see.
+    ordered = sorted(space.entities, key=lambda e: e.id)
+    for e in ordered:
         gwutils.run_panicless(e.on_migrate_out)
-        members[e.id] = e.get_migrate_data()
+    with primed_column_snapshot(ordered):
+        for e in ordered:
+            members[e.id] = e.get_migrate_data()
     sdata = space.get_migrate_data()
     sdata["kind"] = space.kind
     bundle = {"space": sdata, "members": members}
     queued = list(space._pending_enters)
     space._pending_enters = []
-    for e in sorted(space.entities, key=lambda e: e.id):
-        e._destroy(is_migrate=True)
+    # The migrate-destroy's release-time column snapshot (_snapshot_columns)
+    # walks every declared column per entity — ride one primed gather too.
+    with primed_column_snapshot(ordered):
+        for e in ordered:
+            e._destroy(is_migrate=True)
     space._destroy(is_migrate=True)
     # Migrate-destroy skips on_destroy (user hooks must not fire for a
     # move), which is also where a space normally drops its AOI manager
@@ -622,6 +647,86 @@ def restore_space_bundle(spaceid: str, bundle: dict) -> Space:
     return space
 
 
+# --- columnar batch persistence (ISSUE 19) -----------------------------------
+
+
+def _gather_column(spec, arr, n_slots, slots):
+    """O(entities) core of the columnar snapshot gather (gwlint R2 hot
+    path — loop-free by design; the per-entity cache stitch stays in
+    ``primed_column_snapshot``, outside the guarded set, because it is
+    plain dict stores): one fancy-index + bulk ``tolist`` per (type,
+    column). ``ndarray.tolist()`` performs the identical numpy→Python
+    widening as ``ColumnSpec.to_python`` for every allowed column dtype,
+    so the gathered values are bit-identical to the per-entity slab-read
+    walk they replace."""
+    if arr is None:  # column never materialized: default everywhere
+        return [spec.to_python(spec.default)] * n_slots
+    return arr[slots].tolist()
+
+
+@contextmanager
+def primed_column_snapshot(entities):
+    """Columnar batch persistence: pre-gather every declared Column attr
+    for *entities* with ONE fancy-index gather per (entity type, column)
+    and prime each entity's attr root, so the per-entity snapshot walk
+    inside the ``with`` block (``get_freeze_data`` / ``get_migrate_data``
+    / ``persistent_attrs``) reads the pre-gathered plain-Python cache
+    instead of one slab-row read + scalar conversion per entity per key.
+
+    Exactness: ``ndarray.tolist()`` performs the identical numpy→Python
+    widening as ``ColumnSpec.to_python`` for every allowed column dtype,
+    so the produced blobs are bit-identical to the unprimed walk
+    (asserted by tests/test_columns.py and the chaos freeze→restore
+    scenario). Entities without Column attrs, or whose slot is already
+    released (reads fall back to the release-time ``_final`` snapshot),
+    pass through untouched; a host write inside the window invalidates
+    that key's primed value (columns.py ``_col_set``), so overridden
+    snapshot hooks that mutate state stay correct.
+
+    The whole window — gather plus the caller's walk — lands on
+    ``aoi_host_phase_seconds_total{phase=persist}``."""
+    t0 = time.perf_counter()
+    by_type: dict[int, list] = {}
+    for e in entities:
+        root = getattr(e, "attrs", None)
+        if isinstance(root, ColumnBackedMapAttr) and e._slot >= 0:
+            by_type.setdefault(id(root._colspecs), []).append(e)
+    primed: list[ColumnBackedMapAttr] = []
+    for ents in by_type.values():
+        colspecs = ents[0].attrs._colspecs
+        columns = ents[0].attrs._slabs.columns
+        slots = np.fromiter((e._slot for e in ents), np.int64, len(ents))
+        caches: list[dict] = [{} for _ in ents]
+        for name, spec in colspecs.items():
+            vals = _gather_column(spec, columns.get(name), len(ents), slots)
+            for cache, v in zip(caches, vals):
+                cache[name] = v
+        for e, cache in zip(ents, caches):
+            e.attrs.prime_columns(cache)
+            primed.append(e.attrs)
+    try:
+        yield
+    finally:
+        for root in primed:
+            root.unprime_columns()
+        _PHASE_PERSIST.inc(time.perf_counter() - t0)
+
+
+def save_entities_batch(entities=None) -> int:
+    """Save every persistent entity (default: all live entities) through
+    one primed-column snapshot round — the bulk analog of ``Entity.save``
+    for terminate/checkpoint sweeps. Returns the number saved."""
+    if entities is None:
+        entities = list(_entities.values())
+    saved = 0
+    with primed_column_snapshot(entities):
+        for e in entities:
+            if e.is_persistent() and not e.is_destroyed():
+                gwutils.run_panicless(e.save)
+                saved += 1
+    return saved
+
+
 # --- freeze / restore (EntityManager.go:554-656) -----------------------------
 
 
@@ -633,14 +738,18 @@ def freeze_entities(gameid: int) -> dict:
         raise RuntimeError("freeze requires the nil space to exist")
     frozen_spaces: dict[str, dict] = {}
     frozen_entities: dict[str, dict] = {}
+    # on_freeze hooks run OUTSIDE the primed window: they may mutate column
+    # attrs, and the batch gather must see those writes.
     for e in _entities.values():
         gwutils.run_panicless(e.on_freeze)
-        data = e.get_freeze_data()
-        if isinstance(e, Space):
-            data["kind"] = e.kind
-            frozen_spaces[e.id] = data
-        else:
-            frozen_entities[e.id] = data
+    with primed_column_snapshot(_entities.values()):
+        for e in _entities.values():
+            data = e.get_freeze_data()
+            if isinstance(e, Space):
+                data["kind"] = e.kind
+                frozen_spaces[e.id] = data
+            else:
+                frozen_entities[e.id] = data
     return {
         "gameid": gameid,
         "nil_space_id": nil_id,

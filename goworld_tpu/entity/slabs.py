@@ -376,6 +376,12 @@ class EntitySlabs:
         # bindings/flags): guards the device tier writeback — a tier
         # vector computed against a different edge layout is discarded.
         self._edge_version = 0
+        # Edge delta log for the fused interest-edge delivery (aoi/
+        # batched.py): while a list is installed here (swapped fresh at
+        # every AOI dispatch that ships device edge verdicts), every
+        # edge add/remove appends its key so the decode can tell which
+        # verdicts the pipelined delivery window made stale. None = off.
+        self.edge_log: list | None = None
         # Own-client delta baselines, per SLOT (an entity syncing to its
         # own client rides full rate but still delta-encodes).
         self.own_base = np.zeros((capacity, 4), np.float32)
@@ -593,6 +599,8 @@ class EntitySlabs:
         self._edge_refs[watcher] += 1
         self._topo_version += 1
         self._edge_version += 1
+        if self.edge_log is not None:
+            self.edge_log.append(key)
 
     def edge_remove(self, subj: int, watcher: int) -> None:
         key = (subj << 32) | watcher
@@ -617,6 +625,8 @@ class EntitySlabs:
         self._edge_refs[watcher] -= 1
         self._topo_version += 1
         self._edge_version += 1
+        if self.edge_log is not None:
+            self.edge_log.append(key)
 
     def edge_count(self) -> int:
         return self._e_n

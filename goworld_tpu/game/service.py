@@ -166,6 +166,7 @@ class GameService:
         rt.aoi_shard_mode = self.cfg.aoi.shard_mode
         rt.aoi_strip_placement = self.cfg.aoi.strip_placement
         rt.aoi_pallas_strip_cols = self.cfg.aoi.pallas_strip_cols
+        rt.aoi_pallas_inkernel_drain = self.cfg.aoi.pallas_inkernel_drain
         rt.aoi_delivery = self.cfg.aoi.delivery
         rt.aoi_sync_wait_budget = self.cfg.aoi.sync_wait_budget
         rt.aoi_fuse_logic = self.cfg.aoi.fuse_logic
@@ -942,9 +943,7 @@ class GameService:
 
     def _do_terminate(self) -> None:
         gwlog.infof("game %d terminating: saving and destroying all entities", self.gameid)
-        for e in list(entity_manager.entities().values()):
-            if e.is_persistent():
-                gwutils.run_panicless(e.save)
+        entity_manager.save_entities_batch()
         for e in list(entity_manager.entities().values()):
             if not e.is_space_entity():
                 gwutils.run_panicless(e.destroy)

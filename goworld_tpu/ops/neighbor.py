@@ -493,8 +493,8 @@ def _scatter_feats(p: NeighborParams, dst, order, feats_a, feats_b,
     return jnp.pad(cells, ((0, 0), (1, 1), pad_x, (0, 0), (0, 0)), mode="wrap")
 
 
-def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
-                  sem):
+def _event_kernel(p: NeighborParams, dual: bool, drain_inline: int,
+                  cells_hbm, *refs):
     """One program per grid cell: DMA the 3x3 halo block, evaluate
     valid_A ∧ ¬valid_B for all 128 × 1152 pairs, bit-pack the mask.
 
@@ -502,6 +502,25 @@ def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
     second half of the output words — the single-launch fast path when every
     epoch-B pair is guaranteed to sit inside epoch-A's 3x3 halo
     (_step_pallas's displacement guard).
+
+    ``drain_inline > 0`` additionally DRAINS the masked events inside the
+    same launch (ISSUE 19 leg b): a second input plane carries each tabled
+    lane's SLOT id and OWN flag, and the kernel appends the (query slot,
+    other slot) pair of every own-row event to a compacted pairs output
+    through SMEM cursors — exact because the TPU grid executes
+    SEQUENTIALLY on a core, so the cursors are plain scalar state. Region
+    layout of the pairs block (i32[2, cap+1], row 0 = query, row 1 =
+    other, sentinel ``capacity``): enters fill [0, drain_inline) and, when
+    dual, leaves fill [drain_inline, 2*drain_inline); writes past a
+    region's budget land in the trailing trash column, and the caller's
+    authoritative popcount header detects the overflow and repages the
+    whole tick from rank 0 (emission is cell-major, not the XLA drain's
+    row-major rank order, so a partial inline window cannot be resumed).
+    Per-event selection is VPU-shaped: masked-reduction scalar selects and
+    prefix-compare bit ranking — no gathers. Validated under interpret;
+    the scalar dynamic stores follow the TPU guide's dynamic-ref-store
+    idiom but have not been Mosaic-compiled on real hardware yet (the
+    kernel tier's standing honesty note).
 
     The halo DMA is double-buffered across grid steps: ~7.7k sequential
     73 KB copies at the headline config are latency-bound, and the serial
@@ -511,6 +530,13 @@ def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+
+    if drain_inline:
+        (so_hbm, out_ref, pairs_ref, scratch, sem, so_scratch, so_sem,
+         cur_ref) = refs
+    else:
+        out_ref, scratch, sem = refs
+        so_hbm = pairs_ref = so_scratch = so_sem = cur_ref = None
 
     s = pl.program_id(0)
     i = pl.program_id(1)
@@ -538,6 +564,22 @@ def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
     @pl.when(lin + 1 < total)
     def _():
         halo_copy(lin + 1, nslot).start()
+
+    if drain_inline:
+        # Slot/own plane of THIS cell's 3x3 block: latency hides under the
+        # pair math below (waited only at emission time).
+        so_copy = pltpu.make_async_copy(
+            so_hbm.at[s, pl.ds(i, 3), pl.ds(j, 3)], so_scratch, so_sem
+        )
+        so_copy.start()
+
+        @pl.when(lin == 0)
+        def _():
+            cur_ref[0, 0] = 0
+            cur_ref[1, 0] = drain_inline
+            pairs_ref[:, :] = jnp.full(
+                pairs_ref.shape, p.capacity, jnp.int32
+            )
 
     halo_copy(lin, slot).wait()
     c = scratch[slot]  # [3, 3, F, LANES]
@@ -599,18 +641,75 @@ def _event_kernel(p: NeighborParams, dual: bool, cells_hbm, out_ref, scratch,
     else:
         out_ref[0, 0, 0] = enter
 
+    if drain_inline:
+        so_copy.wait()
+        ctr = so_scratch[1, 1]  # [2, LANES]: this cell's slot ids + own flags
+        q_slots = ctr[0:1]  # [1, LANES]
+        own_col = jnp.transpose(ctr[1:2]) > 0  # [LANES, 1] query ownership
+        slots9 = so_scratch[:, :, 0].reshape(9, LANES)  # candidate slot ids
+        il = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+        i9 = jax.lax.broadcasted_iota(jnp.int32, (9, LANES), 0)
+        l9 = jax.lax.broadcasted_iota(jnp.int32, (9, LANES), 1)
+        irow = jax.lax.broadcasted_iota(jnp.int32, (LANES, 9 * LANES), 0)
+        trash = pairs_ref.shape[1] - 1
+
+        def emit(mask, ci, lim):
+            """Append every set bit of ``mask`` (pre-masked to OWN query
+            lanes) as a (query slot, other slot) pair: row by prefix-count
+            over the per-lane inclusive cumsum, bit by prefix-count within
+            the selected row, scalars by masked reductions."""
+            mi = mask.astype(jnp.int32)
+            rcnt = jnp.transpose(
+                jnp.sum(mi, axis=1, keepdims=True)
+            )  # [1, LANES]
+            rcum = jnp.cumsum(rcnt, axis=1)  # inclusive
+            count = jnp.sum(mi)
+
+            def body(jj, carry):
+                row = jnp.sum(jnp.where(rcum <= jj, 1, 0))
+                kk = jj - jnp.sum(jnp.where(il == row, rcum - rcnt, 0))
+                mrow = jnp.sum(
+                    jnp.where(irow == row, mi, 0), axis=0, keepdims=True
+                )  # [1, 9*LANES]
+                ccum = jnp.cumsum(mrow, axis=1)
+                col = jnp.sum(jnp.where(ccum <= kk, 1, 0))
+                hc = col // LANES
+                lane = jax.lax.rem(col, LANES)
+                other = jnp.sum(
+                    jnp.where((i9 == hc) & (l9 == lane), slots9, 0)
+                )
+                qs = jnp.sum(jnp.where(il == row, q_slots, 0))
+                cur = cur_ref[ci, 0]
+                idx = jnp.where(cur < lim, cur, trash)
+                pl.store(pairs_ref, (jnp.int32(0), idx), qs)
+                pl.store(pairs_ref, (jnp.int32(1), idx), other)
+                cur_ref[ci, 0] = cur + 1
+                return carry
+
+            jax.lax.fori_loop(0, count, body, 0)
+
+        emit(v_a & ~v_b & own_col, 0, drain_inline)
+        if dual:
+            emit(v_b & ~v_a & own_col, 1, 2 * drain_inline)
+
 
 @functools.lru_cache(maxsize=None)
 def _compiled_event_kernel(p: NeighborParams, interpret: bool,
                            rows: int | None = None, dual: bool = False,
-                           cols: int | None = None):
+                           cols: int | None = None, drain_inline: int = 0):
     """``rows`` limits the kernel to a slab of grid rows (cells input is then
     the slab plus its 2 halo rows): the sharded engine launches one slab per
     device (parallel/mesh.py). ``cols`` limits it to a slab of grid COLUMNS
     the same way — the spatially sharded Pallas tier launches one strip-
     local column slab per device (parallel/spatial.py); the kernel body is
     row/column symmetric, so both ride the same program. ``dual`` emits
-    enter+leave masks in one launch (words [0, W) enter, [W, 2W) leave)."""
+    enter+leave masks in one launch (words [0, W) enter, [W, 2W) leave).
+    ``drain_inline`` adds the in-kernel event drain (see _event_kernel): a
+    second input (the i32 slot/own plane, cells geometry with 2 planes in
+    place of the F features) and a second output, the compacted pairs
+    block i32[2, cap+1] with cap = drain_inline * (2 if dual else 1); its
+    constant index map keeps the block VMEM-resident across the whole
+    sequential grid."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -619,22 +718,53 @@ def _compiled_event_kernel(p: NeighborParams, interpret: bool,
     if cols is None:
         cols = p.grid_x
     w_words = (9 * LANES // _PACK) * (2 if dual else 1)
-    kernel = functools.partial(_event_kernel, p, dual)
+    kernel = functools.partial(_event_kernel, p, dual, drain_inline)
+    words_spec = pl.BlockSpec(
+        (1, 1, 1, LANES, w_words),
+        lambda s, i, j: (s, i, j, 0, 0),
+        memory_space=pltpu.VMEM,
+    )
+    words_shape = jax.ShapeDtypeStruct(
+        (p.space_slots, rows, cols, LANES, w_words), jnp.int32
+    )
+    if not drain_inline:
+        return pl.pallas_call(
+            kernel,
+            grid=(p.space_slots, rows, cols),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=words_spec,
+            out_shape=words_shape,
+            scratch_shapes=[
+                pltpu.VMEM((2, 3, 3, _F, LANES), jnp.float32),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+        )
+    cap = drain_inline * (2 if dual else 1)
     return pl.pallas_call(
         kernel,
         grid=(p.space_slots, rows, cols),
-        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(
-            (1, 1, 1, LANES, w_words),
-            lambda s, i, j: (s, i, j, 0, 0),
-            memory_space=pltpu.VMEM,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=(
+            words_spec,
+            pl.BlockSpec(
+                (2, cap + 1), lambda s, i, j: (0, 0),
+                memory_space=pltpu.VMEM,
+            ),
         ),
-        out_shape=jax.ShapeDtypeStruct(
-            (p.space_slots, rows, cols, LANES, w_words), jnp.int32
+        out_shape=(
+            words_shape,
+            jax.ShapeDtypeStruct((2, cap + 1), jnp.int32),
         ),
         scratch_shapes=[
             pltpu.VMEM((2, 3, 3, _F, LANES), jnp.float32),
             pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((3, 3, 2, LANES), jnp.int32),
+            pltpu.SemaphoreType.DMA,
+            pltpu.SMEM((2, 1), jnp.int32),
         ],
         interpret=interpret,
     )
@@ -1036,16 +1166,50 @@ def _tier_pass(pos, ppos, radius, subj, wat, n_tiers: int,
     return jnp.where(valid, tier, 0).astype(jnp.uint8)
 
 
+def _edge_verdicts(p: NeighborParams, out, subj, wat):
+    """uint8[2*max_events]: per INLINE event row of the packed ``out``,
+    1 = the event is a real edge-state change against the dispatched edge
+    snapshot (an enter whose (subj, wat) edge is absent / a leave whose
+    edge is present), 0 = a no-op the idempotent interest guards would
+    swallow. This is the device half of the fused interest-edge delivery:
+    the host decode applies verdict-1 rows through a thin bulk edge
+    update and drops verdict-0 rows wholesale (unless the edge churned
+    after the snapshot — the host-side delta log re-checks those).
+
+    Keys are ``subj * (capacity+1) + wat`` in int32, so the caller must
+    guarantee ``(capacity+1)**2 < 2**31`` (the batched service gates on
+    this and falls back to host verdicts otherwise). Pad rows of the
+    edge snapshot carry the slot sentinel ``capacity`` on both sides —
+    their key is the maximum, so real keys never collide with them."""
+    e = p.max_events
+    n = p.capacity
+    keys = jnp.sort(subj.astype(jnp.int32) * jnp.int32(n + 1)
+                    + wat.astype(jnp.int32))
+    rows = out[3:3 + 2 * e]
+    # Event pairs are (watcher, other); the edge table keys
+    # (subject=other, watcher) — see Entity._edge_update.
+    k = rows[:, 1] * jnp.int32(n + 1) + rows[:, 0]
+    idx = jnp.clip(jnp.searchsorted(keys, k), 0, keys.shape[0] - 1)
+    present = keys[idx] == k
+    return jnp.concatenate(
+        [~present[:e], present[e:]]).astype(jnp.uint8)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_step_packed_tiered(params: NeighborParams, backend: str,
-                               programs: tuple | None, tier_cfg: tuple,
-                               edge_cap: int):
-    """The step jit (plain or fused) with the tier pass attached as one
-    extra output — still exactly one launch. Keyed by ``edge_cap`` (the
-    padded edge-array size) ON PURPOSE: edge capacities grow in
-    power-of-two tiers, and a fresh lru instance per capacity makes the
-    growth compile a WARM trace on a new SentinelJit instead of a
-    steady-state retrace on a hot one (telemetry/sentinel.py)."""
+                               programs: tuple | None,
+                               tier_cfg: tuple | None,
+                               edge_cap: int, verdicts: bool = False):
+    """The step jit (plain or fused) with the edge-snapshot passes
+    attached as extra outputs — still exactly one launch. Two optional
+    passes ride here, in output order after the base step outputs:
+    the [sync] cadence tier pass (``tier_cfg`` a (n_tiers, near, far)
+    tuple; None skips it) and the fused-delivery edge-verdict pass
+    (``verdicts=True``). Keyed by ``edge_cap`` (the padded edge-array
+    size) ON PURPOSE: edge capacities grow in power-of-two tiers, and a
+    fresh lru instance per capacity makes the growth compile a WARM
+    trace on a new SentinelJit instead of a steady-state retrace on a
+    hot one (telemetry/sentinel.py)."""
     if programs is None:
         if backend == "jnp":
             base = functools.partial(_step_packed_jnp, params)
@@ -1062,16 +1226,21 @@ def _jitted_step_packed_tiered(params: NeighborParams, backend: str,
     # after the previous epoch's four: the pallas step additionally
     # carries 7 carried-grid artifacts first.
     off = 0 if backend == "jnp" else 7
-    n_tiers, near_ratio, far_ratio = tier_cfg
 
     def fn(subj, wat, ppos, pact, pspc, prad, *rest):
         outs = base(ppos, pact, pspc, prad, *rest)
-        tiers = _tier_pass(rest[off], ppos, rest[off + 3], subj, wat,
-                           n_tiers, near_ratio, far_ratio)
-        return outs + (tiers,)
+        if tier_cfg is not None:
+            n_tiers, near_ratio, far_ratio = tier_cfg
+            outs = outs + (_tier_pass(
+                rest[off], ppos, rest[off + 3], subj, wat,
+                n_tiers, near_ratio, far_ratio),)
+        if verdicts:
+            outs = outs + (_edge_verdicts(params, outs[2], subj, wat),)
+        return outs
 
-    return sentinel.SentinelJit(
-        f"aoi_step_tiered_{backend}", jax.jit(fn))
+    label = ("aoi_step_tiered_" if tier_cfg is not None
+             else "aoi_step_verdict_") + backend
+    return sentinel.SentinelJit(label, jax.jit(fn))
 
 
 def tier_edge_capacity(n_edges: int) -> int:
@@ -1160,7 +1329,7 @@ class PendingStep:
     """
 
     __slots__ = ("_engine", "_pager", "_out", "_collected", "fused",
-                 "tiers")
+                 "tiers", "verdicts", "edge_log")
 
     def __init__(self, engine: "NeighborEngine", pager, out) -> None:
         self._engine = engine
@@ -1177,6 +1346,11 @@ class PendingStep:
         # Consumed by BatchAOIService._consume_tiers before the next
         # dispatch; discarded there if the edge table churned meanwhile.
         self.tiers = None
+        # Fused-delivery payload: device edge-verdict uint8[2E] array (or
+        # None) and the edge delta log that was accumulating when this
+        # step's snapshot was taken (aoi/batched.py _deliver_fused).
+        self.verdicts = None
+        self.edge_log = None
         start_host_copy(out)
 
     def is_ready(self) -> bool:
@@ -1393,25 +1567,38 @@ class NeighborEngine:
                 jnp.array(sel, jnp.int32),
                 jnp.float32(dt),
             ) + tuple(jnp.array(c) for c in cols)
+        verdict_out = None
         if tiers is not None:
             # ``tiers = (edge_version, n_edges, subj_pad, wat_pad,
-            # (n_tiers, near_ratio, far_ratio))`` — the [sync] cadence
-            # tier pass rides the SAME launch as the step (+ any fused
-            # logic); its output is the step outputs plus one uint8
-            # tier vector.
-            t_ver, t_n, subj_pad, wat_pad, tcfg = tiers
+            # (n_tiers, near_ratio, far_ratio)[, want_verdicts])`` — the
+            # [sync] cadence tier pass and/or the fused-delivery edge
+            # verdict pass ride the SAME launch as the step (+ any fused
+            # logic); the outputs are the step outputs plus one uint8
+            # vector per requested pass. A 5-tuple is the legacy
+            # tiers-only payload; the 6-tuple may set the tier config to
+            # None for a verdicts-only launch.
+            if len(tiers) == 5:
+                t_ver, t_n, subj_pad, wat_pad, tcfg = tiers
+                want_verdicts = False
+            else:
+                t_ver, t_n, subj_pad, wat_pad, tcfg, want_verdicts = tiers
             tier_meta = (t_ver, t_n)
             jit_tiered = _jitted_step_packed_tiered(
-                self.params, self.backend, programs, tuple(tcfg),
-                len(subj_pad),
+                self.params, self.backend, programs,
+                tuple(tcfg) if tcfg is not None else None,
+                len(subj_pad), want_verdicts,
             )
             outs = jit_tiered(
                 jnp.array(subj_pad, jnp.int32),
                 jnp.array(wat_pad, jnp.int32),
                 *self._state, *cur, *extra,
             )
-            tier_out = outs[-1]
-            outs = outs[:-1]
+            if want_verdicts:
+                verdict_out = outs[-1]
+                outs = outs[:-1]
+            if tcfg is not None:
+                tier_out = outs[-1]
+                outs = outs[:-1]
         elif logic is not None:
             jit_fused = _jitted_step_packed_fused(
                 self.params, self.backend, programs
@@ -1451,6 +1638,9 @@ class NeighborEngine:
         if tier_out is not None:
             start_host_copy(tier_out)
             pending.tiers = tier_meta + (tier_out,)
+        if verdict_out is not None:
+            start_host_copy(verdict_out)
+            pending.verdicts = verdict_out
         return pending
 
     def warmup_fused(self, programs: tuple, col_dtypes: tuple) -> None:
@@ -1491,7 +1681,8 @@ class NeighborEngine:
         jax.block_until_ready(jit_fused(*state, *zeros, *extra)[2])
 
     def warmup_tiered(self, programs: tuple | None, col_dtypes: tuple,
-                      tier_cfg: tuple, edge_cap: int) -> None:
+                      tier_cfg: tuple | None, edge_cap: int,
+                      verdicts: bool = False) -> None:
         """Compile the tiered step jit (plain or fused variant) WITHOUT
         touching engine state — the warmup_fused analog for the [sync]
         tier pass. The batched service never dispatches an un-compiled
@@ -1528,8 +1719,9 @@ class NeighborEngine:
         pads = jnp.full((edge_cap,), n, jnp.int32)
         jit_tiered = _jitted_step_packed_tiered(
             self.params, self.backend,
-            tuple(programs) if programs else None, tuple(tier_cfg),
-            edge_cap,
+            tuple(programs) if programs else None,
+            tuple(tier_cfg) if tier_cfg is not None else None,
+            edge_cap, verdicts,
         )
         jax.block_until_ready(
             jit_tiered(pads, pads, *state, *zeros, *extra)[2])

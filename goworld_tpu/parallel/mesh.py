@@ -457,7 +457,7 @@ class ShardedPendingStep:
     stacked per-shard packed buffers, then (rare) storm paging."""
 
     __slots__ = ("_engine", "_enter_ctx", "_leave_ctx", "_out", "_collected",
-                 "fused", "rank_paging")
+                 "fused", "rank_paging", "full_repage")
 
     def __init__(self, engine, enter_ctx, leave_ctx, out) -> None:
         self._engine = engine
@@ -474,6 +474,12 @@ class ShardedPendingStep:
         # pallas-backend SPATIAL ticks page by rank while its jnp
         # all-gather FALLBACK ticks page by flat index.
         self.rank_paging = engine.backend != "jnp"
+        # In-kernel-drain ticks (parallel/spatial.py, ISSUE 19 leg b) emit
+        # inline pairs in cell-major order: a shard whose events overflow
+        # the inline budget cannot resume that window by rank — collect()
+        # then discards the shard's inline rows and repages it from rank 0
+        # through the XLA drain.
+        self.full_repage = False
         start_host_copy(out)
 
     def is_ready(self) -> bool:
@@ -505,19 +511,25 @@ class ShardedPendingStep:
         leave_starts = np.zeros(nd, np.int32)
         dropped = 0
         rank_paging = self.rank_paging
+        full_repage = self.full_repage
         for d in range(nd):
             o = out[d * block:(d + 1) * block]
             n_e, n_l = int(o[0, 0]), int(o[0, 1])
             dropped = int(o[1, 0])  # replicated diagnostic, same on all
-            enters.append(o[3 + nd:3 + nd + min(n_e, e)])
-            leaves.append(o[3 + nd + e:3 + nd + e + min(n_l, e)])
-            enter_deficit[d] = max(0, n_e - e)
-            leave_deficit[d] = max(0, n_l - e)
-            if rank_paging:  # resume by event rank
-                enter_starts[d] = leave_starts[d] = e
-            else:  # resume after the last drained flat index
-                enter_starts[d] = int(o[2, 0]) + 1
-                leave_starts[d] = int(o[2, 1]) + 1
+            if full_repage and n_e > e:
+                enter_deficit[d] = n_e  # whole shard through the XLA drain
+                enter_starts[d] = 0
+            else:
+                enters.append(o[3 + nd:3 + nd + min(n_e, e)])
+                enter_deficit[d] = max(0, n_e - e)
+                enter_starts[d] = e if rank_paging else int(o[2, 0]) + 1
+            if full_repage and n_l > e:
+                leave_deficit[d] = n_l
+                leave_starts[d] = 0
+            else:
+                leaves.append(o[3 + nd + e:3 + nd + e + min(n_l, e)])
+                leave_deficit[d] = max(0, n_l - e)
+                leave_starts[d] = e if rank_paging else int(o[2, 1]) + 1
         if enter_deficit.any():
             enters += eng._page(self._enter_ctx, enter_deficit, enter_starts)
         if leave_deficit.any():

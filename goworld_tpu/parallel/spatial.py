@@ -545,6 +545,27 @@ def _build_table_strip(
     return table, tpos, own_dropped, order, dst
 
 
+def _scatter_slotown(p: NeighborParams, dst, order, slot_all, chunk: int,
+                     gx_ext: int):
+    """Dense slot/own plane for the in-kernel drain (ISSUE 19 leg b):
+    the cells-slab geometry with two i32 planes per lane in place of the
+    F float features — plane 0 the tabled lane's SLOT id (sentinel
+    ``capacity``), plane 1 its OWN flag (row < chunk: ghost rows must not
+    emit events; their owner shard emits them). Same one-scatter build and
+    z-wrap halo ring as _scatter_feats; x ghost columns are physical."""
+    n_rows = slot_all.shape[0]
+    table_size = p.space_slots * p.grid_z * gx_ext * LANES
+    own = (jnp.arange(n_rows, dtype=jnp.int32) < chunk).astype(jnp.int32)
+    vals = jnp.stack([slot_all.astype(jnp.int32), own], axis=1)  # [N, 2]
+    flat = jnp.full((table_size, 2), p.capacity, jnp.int32).at[:, 1].set(0)
+    flat = flat.at[dst].set(vals[order], mode="drop")
+    plane = flat.reshape(p.space_slots, p.grid_z, gx_ext, LANES, 2)
+    plane = plane.transpose(0, 1, 2, 4, 3)  # [S, gz, gxe, 2, LANES]
+    return jnp.pad(
+        plane, ((0, 0), (1, 1), (0, 0), (0, 0), (0, 0)), mode="wrap"
+    )
+
+
 def _spatial_step_pallas_impl(
     p: NeighborParams,
     events_inline: int,
@@ -552,6 +573,7 @@ def _spatial_step_pallas_impl(
     n_dev: int,
     interpret: bool,
     cols_cap: int,
+    drain_inline: int,
     ppos_l, pact_l, pspc_l, prad_l,
     pos_l, act_l, spc_l, rad_l,
     slot_l,
@@ -572,9 +594,12 @@ def _spatial_step_pallas_impl(
     qcols = cols_cap + 2  # kernel grid columns (strip + hysteresis slack)
     nb_local = p.space_slots * gz * gxe
     w_words = 9 * LANES // _PACK
-    kernel = _compiled_event_kernel(p, interpret, rows=gz, cols=qcols)
+    kernel = _compiled_event_kernel(
+        p, interpret, rows=gz, cols=qcols, drain_inline=drain_inline
+    )
     kernel_dual = _compiled_event_kernel(
-        p, interpret, rows=gz, cols=qcols, dual=True
+        p, interpret, rows=gz, cols=qcols, dual=True,
+        drain_inline=drain_inline,
     )
 
     (pos_all, ppos_all, act_all, pact_all, spc_all, pspc_all, slot_all,
@@ -637,34 +662,72 @@ def _spatial_step_pallas_impl(
         dropped_total,
     )
 
-    def fast_fn():
-        pk2 = kernel_dual(cells_c)  # [S, gz, qcols, LANES, 2W]
-        return (pk2[..., :w_words], pk2[..., w_words:],
-                lxc, czc, smc, tpos_c, table_c)
+    if drain_inline:
+        # In-kernel drain (ISSUE 19 leg b): the launch itself emits the
+        # compacted (query slot, other slot) pairs — the XLA rank-select
+        # below never runs on these ticks. Both branches slice their pairs
+        # block to the [2, drain_inline] enter/leave regions so the cond
+        # unifies; emission is already slot-valued and own-masked.
+        so_c = _scatter_slotown(p, dst_c, order_c, slot_all, chunk, gxe)
 
-    def slow_fn():
-        pk_e = kernel(cells_c)
-        cells_p = _scatter_feats(p, dst_p, order_p, prev_feats, cur_feats,
-                                 gx_ext=gxe)
-        pk_l = kernel(cells_p)
-        return (pk_e, pk_l, lxp, czp, smp, tpos_p, table_p)
+        def fast_fn():
+            pk2, prs = kernel_dual(cells_c, so_c)
+            return (pk2[..., :w_words], pk2[..., w_words:],
+                    lxc, czc, smc, tpos_c, table_c,
+                    prs[:, :drain_inline],
+                    prs[:, drain_inline:2 * drain_inline])
 
-    pk_e, pk_l, l_lx, l_cz, l_sm, l_tpos, l_table = jax.lax.cond(
-        fast, fast_fn, slow_fn
-    )
+        def slow_fn():
+            pk_e, prs_e = kernel(cells_c, so_c)
+            cells_p = _scatter_feats(p, dst_p, order_p, prev_feats,
+                                     cur_feats, gx_ext=gxe)
+            so_p = _scatter_slotown(p, dst_p, order_p, slot_all, chunk, gxe)
+            # Epoch symmetry: the prev-grid launch's "enter" mask
+            # (valid_prev ∧ ¬valid_cur) IS the leave set.
+            pk_l, prs_l = kernel(cells_p, so_p)
+            return (pk_e, pk_l, lxp, czp, smp, tpos_p, table_p,
+                    prs_e[:, :drain_inline], prs_l[:, :drain_inline])
+
+        (pk_e, pk_l, l_lx, l_cz, l_sm, l_tpos, l_table, prs_e, prs_l
+         ) = jax.lax.cond(fast, fast_fn, slow_fn)
+    else:
+        def fast_fn():
+            pk2 = kernel_dual(cells_c)  # [S, gz, qcols, LANES, 2W]
+            return (pk2[..., :w_words], pk2[..., w_words:],
+                    lxc, czc, smc, tpos_c, table_c)
+
+        def slow_fn():
+            pk_e = kernel(cells_c)
+            cells_p = _scatter_feats(p, dst_p, order_p, prev_feats,
+                                     cur_feats, gx_ext=gxe)
+            pk_l = kernel(cells_p)
+            return (pk_e, pk_l, lxp, czp, smp, tpos_p, table_p)
+
+        pk_e, pk_l, l_lx, l_cz, l_sm, l_tpos, l_table = jax.lax.cond(
+            fast, fast_fn, slow_fn
+        )
+        prs_e = prs_l = None
     packed_e = extract(pk_e, lxc, czc, smc, tpos_c)
     packed_l = extract(pk_l, l_lx, l_cz, l_sm, l_tpos)
     n_enters = jnp.sum(jax.lax.population_count(packed_e)).astype(jnp.int32)
     n_leaves = jnp.sum(jax.lax.population_count(packed_l)).astype(jnp.int32)
 
-    ep, _ = _drain_bits(p, packed_e, lxc[:chunk], czc[:chunk], smc[:chunk],
-                        table_c, jnp.int32(0), max_events=events_inline,
-                        gx_ext=gxe, wrap_x=False)
-    lp, _ = _drain_bits(p, packed_l, l_lx[:chunk], l_cz[:chunk],
-                        l_sm[:chunk], l_table, jnp.int32(0),
-                        max_events=events_inline, gx_ext=gxe, wrap_x=False)
+    if drain_inline:
+        ep = jnp.transpose(prs_e)  # [events_inline, 2], already slot ids
+        lp = jnp.transpose(prs_l)
+    else:
+        ep, _ = _drain_bits(p, packed_e, lxc[:chunk], czc[:chunk],
+                            smc[:chunk], table_c, jnp.int32(0),
+                            max_events=events_inline, gx_ext=gxe,
+                            wrap_x=False)
+        lp, _ = _drain_bits(p, packed_l, l_lx[:chunk], l_cz[:chunk],
+                            l_sm[:chunk], l_table, jnp.int32(0),
+                            max_events=events_inline, gx_ext=gxe,
+                            wrap_x=False)
 
     def slotize(pairs):
+        if drain_inline:
+            return pairs  # kernel pairs are slot-valued already
         ent = pairs[:, 0]
         ent = jnp.where(ent < chunk, slot_l[jnp.minimum(ent, chunk - 1)], n)
         return jnp.stack([ent, pairs[:, 1]], axis=1)
@@ -716,6 +779,7 @@ def _spatial_step_pallas_fused_impl(
     n_dev: int,
     interpret: bool,
     cols_cap: int,
+    drain_inline: int,
     programs,
     ppos_l, pact_l, pspc_l, prad_l,
     pos_l, act_l, spc_l, rad_l,
@@ -730,6 +794,7 @@ def _spatial_step_pallas_fused_impl(
     permuted inputs, perm-snapshot writeback)."""
     res = _spatial_step_pallas_impl(
         p, events_inline, halo_cap, n_dev, interpret, cols_cap,
+        drain_inline,
         ppos_l, pact_l, pspc_l, prad_l,
         pos_l, act_l, spc_l, rad_l,
         slot_l, send_lo_idx, send_hi_idx, strip_lo,
@@ -743,12 +808,13 @@ def _spatial_step_pallas_fused_impl(
 @functools.lru_cache(maxsize=None)
 def _jitted_spatial_step_pallas(
     params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int,
-    interpret: bool, cols_cap: int,
+    interpret: bool, cols_cap: int, drain_inline: int = 0,
 ):
+    assert drain_inline in (0, events_inline)
     shard_map = resolve_shard_map()
     body = functools.partial(
         _spatial_step_pallas_impl, params, events_inline, halo_cap,
-        mesh.devices.size, interpret, cols_cap,
+        mesh.devices.size, interpret, cols_cap, drain_inline,
     )
     spec = P(SHARD_AXIS)
     mapped = shard_map(
@@ -768,11 +834,13 @@ def _jitted_spatial_step_pallas(
 def _jitted_spatial_step_pallas_fused(
     params: NeighborParams, mesh: Mesh, events_inline: int, halo_cap: int,
     interpret: bool, cols_cap: int, programs: tuple, n_cols: int,
+    drain_inline: int = 0,
 ):
+    assert drain_inline in (0, events_inline)
     shard_map = resolve_shard_map()
     body = functools.partial(
         _spatial_step_pallas_fused_impl, params, events_inline, halo_cap,
-        mesh.devices.size, interpret, cols_cap, programs,
+        mesh.devices.size, interpret, cols_cap, drain_inline, programs,
     )
     spec = P(SHARD_AXIS)
     mapped = shard_map(
@@ -924,6 +992,7 @@ class SpatialShardedNeighborEngine:
         backend: str = "auto",
         strip_cols: int | None = None,
         placement: str = "topology",
+        inkernel_drain: bool = True,
     ) -> None:
         if backend == "auto":
             backend = "pallas" if jax.default_backend() == "tpu" else "jnp"
@@ -1029,6 +1098,17 @@ class SpatialShardedNeighborEngine:
         self.allgather_bytes_per_tick = (
             n_dev * (params.capacity - self.chunk) * 34
         )
+        # In-kernel drain ([aoi] pallas_inkernel_drain, ISSUE 19 leg b):
+        # steady strip ticks emit their compacted event pairs from the
+        # kernel launch itself; the XLA rank-select stays compiled in as
+        # the storm-paging program (a tick whose events overflow the
+        # inline budget repages WHOLLY through it — kernel emission is
+        # cell-major, so its partial window cannot be rank-resumed).
+        self.inkernel_drain = bool(inkernel_drain)
+        self.drain_inline = (
+            self.events_inline if (backend != "jnp" and inkernel_drain)
+            else 0
+        )
         if backend == "jnp":
             self._jit_step = _jitted_spatial_step(
                 params, mesh, self.events_inline, self.halo_cap
@@ -1040,6 +1120,7 @@ class SpatialShardedNeighborEngine:
             self._jit_step = _jitted_spatial_step_pallas(
                 params, mesh, self.events_inline, self.halo_cap,
                 backend == "pallas_interpret", self.strip_cols,
+                self.drain_inline,
             )
             self._jit_drain = _jitted_spatial_drain_bits(
                 params, mesh, self.events_inline, self.strip_cols
@@ -1447,6 +1528,7 @@ class SpatialShardedNeighborEngine:
                         self.params, self.mesh, self.events_inline,
                         self.halo_cap, self.backend == "pallas_interpret",
                         self.strip_cols, tuple(logic[0]), len(logic[5]),
+                        self.drain_inline,
                     )
                     res = jit_fused(
                         *self._state, *cur_dev, *band_args, *logic_dev,
@@ -1480,6 +1562,10 @@ class SpatialShardedNeighborEngine:
             # The strip-local bit drain pages by event RANK; everything
             # else (jnp ids, the jnp all-gather fallback) by flat index.
             pending.rank_paging = self.backend != "jnp"
+            # In-kernel drain pairs are cell-major: an overflowing shard's
+            # inline window is order-incompatible with rank resume, so
+            # collect() discards it and repages that shard from rank 0.
+            pending.full_repage = self.drain_inline > 0
         else:
             if logic is not None:
                 jit_fused = _jitted_sharded_step_fused(
@@ -1550,7 +1636,7 @@ class SpatialShardedNeighborEngine:
             jit_sp = _jitted_spatial_step_pallas_fused(
                 self.params, self.mesh, self.events_inline, self.halo_cap,
                 self.backend == "pallas_interpret", self.strip_cols,
-                tuple(programs), ncols,
+                tuple(programs), ncols, self.drain_inline,
             )
             jax.block_until_ready(
                 jit_sp(*zeros, *zeros, perm, empty_band, empty_band,

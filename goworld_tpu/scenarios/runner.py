@@ -151,6 +151,30 @@ def _retrace_count() -> int:
     return int(sentinel.steady_state_retraces())
 
 
+# Every label a tick dispatch can launch its STEP under, across engines
+# and backends.  The fallback decision on the spatial engine is made on
+# the host BEFORE any launch (parallel/spatial.py step_async), so one
+# dispatch fires exactly one of these — never two.  Paging drains
+# (aoi_drain_*, *_drain_bits) are deliberately absent: a storm tick
+# pages through extra drain launches by design, and the one-launch pin
+# is about the step, not the overflow path.
+_STEP_LABELS = tuple(
+    f"aoi_step_{kind}{bk}"
+    for kind in ("", "fused_", "tiered_", "verdict_")
+    for bk in ("jnp", "pallas", "pallas_interpret")
+) + (
+    "sharded_step", "sharded_step_fused", "sharded_step_pallas",
+    "spatial_step", "spatial_step_fused",
+    "spatial_step_pallas", "spatial_step_pallas_fused",
+)
+
+
+def _step_launches() -> int:
+    from goworld_tpu.telemetry import sentinel
+
+    return int(sum(sentinel.launches_total(lb) for lb in _STEP_LABELS))
+
+
 def run_scenario(name: str, engine: Optional[str] = "batched",
                  seed: Optional[int] = -1,
                  ticks_scale: Optional[float] = 1.0) -> Dict[str, Any]:
@@ -201,6 +225,7 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
     # Pass 2: measure — fresh world, same seed, best-of-repeats timed.
     repeats = int(world.config.get("repeats", 1))
     ticks = int(world.config["ticks"])
+    launches0 = _step_launches()
     runs: List[float] = []
     for _rep in range(repeats):
         w = spec.make(seed=seed, ticks_scale=ticks_scale)
@@ -225,6 +250,18 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
         finally:
             w.teardown()
 
+    # One-launch pin (ISSUE 19): every measured tick must have cost
+    # exactly one step launch — enter/leave storms, hotspot fallbacks
+    # and strip re-plans included.  An extra launch means a hidden host
+    # round-trip crept onto the steady path; a missing one means a tick
+    # silently skipped the engine.  Hard gate, not a telemetry note.
+    step_launches = _step_launches() - launches0
+    ticks_dispatched = repeats * ticks
+    if step_launches != ticks_dispatched:
+        raise ScenarioInvariantError(
+            f"one-launch pin violated: {ticks_dispatched} measured ticks "
+            f"dispatched but {step_launches} step launches recorded")
+
     headline: Dict[str, Any] = {
         "metric": f"scenario_{name}_updates_per_sec",
         "value": round(max(runs), 1),
@@ -236,6 +273,9 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
         "seed": world.seed,
         "invariants": invariants,
         "steady_state_retraces": _retrace_count() - retraces0,
+        "step_launches": step_launches,
+        "ticks_dispatched": ticks_dispatched,
+        "one_launch_per_tick": True,
         "errors": 0,
     }
     headline.update(extra)

@@ -277,6 +277,11 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
     retraces = 0.0
     fused_classes = 0.0
     fused_slots = 0.0
+    # Device-resident tick (ISSUE 19): the delivery-class split and the
+    # host wall-clock the fused decode / columnar persist shrink.
+    fused_delivery_classes = 0.0
+    host_fallback_classes = 0.0
+    host_phase = {"delivery": 0.0, "persist": 0.0}
     # Rebalance plane (ISSUE 18): planner host + pause/failover state for
     # the /cluster REBAL view and its alerts.
     space_outcomes = {"done": 0.0, "aborted": 0.0, "timeout": 0.0,
@@ -322,6 +327,15 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
         fused_classes = max(fused_classes,
                             _series_sum(m, "aoi_fused_classes"))
         fused_slots = max(fused_slots, _series_sum(m, "aoi_fused_slots"))
+        fused_delivery_classes = max(
+            fused_delivery_classes,
+            _series_sum(m, "aoi_fused_delivery_classes"))
+        host_fallback_classes = max(
+            host_fallback_classes,
+            _series_sum(m, "aoi_host_fallback_classes"))
+        for ph in host_phase:
+            host_phase[ph] += _series_sum(
+                m, "aoi_host_phase_seconds_total", "phase", ph)
         for outcome in space_outcomes:
             space_outcomes[outcome] += _series_sum(
                 m, "rebalance_space_migrations_total", "outcome", outcome)
@@ -410,5 +424,11 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
         },
         "steady_state_retraces": int(retraces),
         "fused": {"classes": int(fused_classes), "slots": int(fused_slots)},
+        "delivery": {
+            "fused_classes": int(fused_delivery_classes),
+            "host_fallback_classes": int(host_fallback_classes),
+            "host_phase_seconds": {
+                k: round(v, 3) for k, v in host_phase.items()},
+        },
         "alerts": alerts,
     }

@@ -138,6 +138,7 @@ def _row(name: str, proc: dict[str, Any], tick_budget: float) -> list[str]:
         heat,
         f"{int(backlog)}" if backlog is not None else "-",
         fused,
+        _dlvr_col(m),
         _sync_col(m),
         _rebal_col(h, m),
         f"{int(launches)}" if launches else "-",
@@ -157,6 +158,23 @@ def _sync_col(metrics: dict[str, Any]) -> str:
             tiers, key=lambda s: int(s["labels"].get("tier", "0"))))
     bpc = _gauge(metrics, "sync_bytes_per_client_per_s")
     return f"{counts}·{bpc:.0f}B/c" if bpc else counts
+
+
+def _dlvr_col(metrics: dict[str, Any]) -> str:
+    """Device-resident delivery column (ISSUE 19): fused-delivery vs
+    host-fallback class census (``2f/1h``) plus the cumulative host wall
+    seconds still spent in the delivery+persist phases — the number the
+    fused edge decode and columnar persistence exist to shrink.  '-' for
+    processes without the batched AOI service."""
+    fused = _gauge(metrics, "aoi_fused_delivery_classes")
+    fb = _gauge(metrics, "aoi_host_fallback_classes")
+    if fused is None and fb is None:
+        return "-"
+    secs = sum(
+        float(s.get("value", 0.0))
+        for s in _series(metrics, "aoi_host_phase_seconds_total")
+        if s["labels"].get("phase") in ("delivery", "persist"))
+    return f"{int(fused or 0)}f/{int(fb or 0)}h·{secs:.1f}s"
 
 
 def _rebal_col(h: dict[str, Any], metrics: dict[str, Any]) -> str:
@@ -185,8 +203,8 @@ def _rebal_col(h: dict[str, Any], metrics: dict[str, Any]) -> str:
 
 
 _HEADERS = ["PROCESS", "ST", "AGE", "UP", "CENSUS", "Q",
-            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "SYNC", "REBAL",
-            "LAUNCH", "RETR"]
+            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "DLVR", "SYNC",
+            "REBAL", "LAUNCH", "RETR"]
 
 
 def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:

@@ -513,3 +513,153 @@ def test_fused_migrate_races_inflight_tick():
     rt.tick()
     # The restored entity re-joined the fused tick (hp keeps draining).
     assert restored.attrs["hp"] < hp_at_pack
+
+
+# --- columnar batch persistence (ISSUE 19 leg c) -----------------------------
+
+
+def make_persist_class(name="PersistAvatar"):
+    """Columnar class spanning every interesting persistence shape: all
+    allowed column dtypes (the tolist-widening corpus), a non-persistent
+    column, and a plain dict attr riding the same blob."""
+    class PersistAvatar(Entity):
+        @classmethod
+        def describe_entity_type(cls, desc):
+            desc.define_attr("hp", "Column", "Persistent", default=100.0)
+            desc.define_attr("gold", "Column", "Persistent",
+                             dtype="int64", default=7)
+            desc.define_attr("lvl", "Column", "Persistent",
+                             dtype="int32", default=1)
+            desc.define_attr("dead", "Column", "Persistent",
+                             dtype="bool")
+            desc.define_attr("wide", "Column", "Persistent",
+                             dtype="float64", default=0.5)
+            desc.define_attr("vx", "Column")  # non-persistent column
+            desc.define_attr("tag", "Persistent")  # plain dict attr
+
+    em.register_entity(PersistAvatar, name)
+    return PersistAvatar
+
+
+def _persist_world(n=8):
+    make_persist_class()
+    ents = []
+    for i in range(n):
+        e = em.create_entity_locally("PersistAvatar")
+        e.attrs["hp"] = 100.0 - i * 7.25
+        e.attrs["gold"] = 10**12 + i  # beyond float32 exactness
+        e.attrs["lvl"] = i - 3  # negative ints too
+        e.attrs["dead"] = bool(i % 2)
+        e.attrs["wide"] = 1.0 / 3.0 + i  # float64 precision
+        e.attrs["vx"] = i * 0.125
+        e.attrs["tag"] = f"bot-{i}"
+        ents.append(e)
+    return ents
+
+
+def _typed(d):
+    """Blob → comparable form that also pins value TYPES, not just
+    equality (bit-identity means 7 stays int, 0.5 stays float, True
+    stays bool — bool == 1 would slip through plain ==)."""
+    if isinstance(d, dict):
+        return {k: _typed(v) for k, v in d.items()}
+    return (type(d).__name__, d)
+
+
+def test_primed_snapshot_blobs_bit_identical_to_unprimed_walk():
+    """THE leg-c exactness oracle: persistent_attrs / get_migrate_data /
+    get_freeze_data inside a primed_column_snapshot window are
+    bit-identical (values AND Python types) to the per-entity slab-read
+    walk they replace, across every allowed column dtype."""
+    ents = _persist_world()
+    unprimed = [(_typed(e.persistent_attrs()), _typed(e.get_migrate_data()),
+                 _typed(e.get_freeze_data())) for e in ents]
+    with em.primed_column_snapshot(ents):
+        # The walk really rides the cache: every column key is primed.
+        assert all(set(e.attrs._primed) >= {"hp", "gold", "lvl", "dead",
+                                            "wide", "vx"} for e in ents)
+        primed = [(_typed(e.persistent_attrs()), _typed(e.get_migrate_data()),
+                   _typed(e.get_freeze_data())) for e in ents]
+    assert primed == unprimed
+    # Window closed: back on the slab path, still identical.
+    assert all(e.attrs._primed is None for e in ents)
+    sample = unprimed[3][0]
+    assert sample["hp"] == ("float", 100.0 - 3 * 7.25)
+    assert sample["gold"] == ("int", 10**12 + 3)
+    assert sample["dead"] == ("bool", True)
+
+
+def test_primed_snapshot_write_inside_window_stays_visible():
+    """A host write inside the window invalidates that key's primed
+    value (columns.py _col_set pops it), so snapshot hooks that mutate
+    state — and any later read — see the write, not the stale gather."""
+    ents = _persist_world(n=3)
+    e = ents[0]
+    with em.primed_column_snapshot(ents):
+        assert e.attrs["hp"] == pytest.approx(100.0)
+        e.attrs["hp"] = 7.25
+        assert e.attrs["hp"] == 7.25  # read-your-write inside the window
+        assert "hp" not in e.attrs._primed  # invalidated, not overwritten
+        assert e.persistent_attrs()["hp"] == 7.25
+        # Untouched keys still ride the primed cache.
+        assert "gold" in e.attrs._primed
+    assert e.attrs["hp"] == 7.25  # the write landed in the slab
+
+
+def test_freeze_restore_round_trip_through_primed_gather():
+    """freeze_entities (primed batch gather) → restore round-trips every
+    column value and dict attr exactly — the full-process analog of the
+    chaos scenario's edge-table bit-identity clause."""
+    ents = _persist_world()
+    em.register_space(Space)
+    em.create_nil_space(em.runtime.gameid)
+    want = {e.id: _typed(e.persistent_attrs()) for e in ents}
+    data = em.freeze_entities(em.runtime.gameid)
+    em.reset_world()  # registry survives; fresh runtime/slabs
+    em.restore_freezed_entities(data)
+    for eid, blob in want.items():
+        e = em.get_entity(eid)
+        assert e is not None
+        assert _typed(e.persistent_attrs()) == blob
+        assert isinstance(e.attrs["vx"], float)  # non-persistent column
+    # restored vx comes from the freeze blob too (freeze ≡ migrate data).
+    assert em.get_entity(ents[5].id).attrs["vx"] == pytest.approx(0.625)
+
+
+def test_pack_space_primed_bundle_matches_per_entity_migrate_data():
+    """pack_space's two primed windows (gather + migrate-destroy) pack
+    the same member blobs as the per-entity get_migrate_data walk, and
+    restore_space_bundle brings every member back with exact values —
+    the REAL_MIGRATE analog."""
+    ents = _persist_world(n=6)
+    em.register_space(Space)
+    space = em.create_space_locally(1)
+    for i, e in enumerate(ents):
+        space._enter(e, Vector3(float(i), 0, 0))
+    want = {e.id: _typed(e.get_migrate_data()) for e in ents}
+    space.freeze_space()
+    bundle, queued = em.pack_space(space)
+    assert queued == []
+    got = {eid: _typed(b) for eid, b in bundle["members"].items()}
+    assert got == want
+    restored = em.restore_space_bundle(space.id, bundle)
+    assert len(restored.entities) == len(ents)
+    for eid, blob in want.items():
+        e = em.get_entity(eid)
+        assert _typed(e.get_migrate_data())["attrs"] == blob["attrs"]
+
+
+def test_save_entities_batch_saves_every_persistent_entity():
+    """save_entities_batch: one primed window, every live persistent
+    entity saved with exactly its persistent_attrs, non-persistent
+    entities skipped, and the count reported."""
+    ents = _persist_world(n=5)
+    make_columnar_class()  # no Persistent flags: must be skipped
+    em.create_entity_locally("ColAvatar")
+    want = {e.id: e.persistent_attrs() for e in ents}
+    saved_blobs = {}
+    em.runtime.save_entity = (  # capture instead of storage
+        lambda typename, eid, blob: saved_blobs.__setitem__(eid, blob))
+    n = em.save_entities_batch()
+    assert n == len(ents)
+    assert saved_blobs == want

@@ -230,6 +230,31 @@ def test_aoi_strip_placement_and_pallas_strip_cols(cfg, tmp_path):
         read_config.set_config_file(None)
 
 
+def test_aoi_pallas_inkernel_drain(cfg, tmp_path):
+    """[aoi] pallas_inkernel_drain parses (ISSUE 19 leg b: the kill
+    switch that pins the Pallas tier's drain/table stage back to the
+    XLA path).  Defaults ON; any non-truthy spelling turns it off."""
+    assert cfg.aoi.pallas_inkernel_drain is True  # default
+    off = SAMPLE.replace("backend = xzlist",
+                         "backend = xzlist\npallas_inkernel_drain = false")
+    p = tmp_path / "drain_off.ini"
+    p.write_text(off)
+    read_config.set_config_file(str(p))
+    try:
+        assert read_config.get().aoi.pallas_inkernel_drain is False
+    finally:
+        read_config.set_config_file(None)
+    on = SAMPLE.replace("backend = xzlist",
+                        "backend = xzlist\npallas_inkernel_drain = yes")
+    p = tmp_path / "drain_on.ini"
+    p.write_text(on)
+    read_config.set_config_file(str(p))
+    try:
+        assert read_config.get().aoi.pallas_inkernel_drain is True
+    finally:
+        read_config.set_config_file(None)
+
+
 def test_per_game_aoi_platform(cfg, tmp_path):
     """One game may ride the chip while the rest force CPU (single-client
     TPU transports); invalid values fail loudly like [aoi] platform."""

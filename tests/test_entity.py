@@ -420,6 +420,27 @@ def test_batched_aoi_sharded_engine_wired():
     assert a.leave_events == [b]
 
 
+def test_batched_aoi_inkernel_drain_knob_threaded():
+    """[aoi] pallas_inkernel_drain rides Runtime -> BatchAOIService ->
+    SpatialShardedNeighborEngine (ISSUE 19 leg b: the kill switch must
+    actually reach the engine, not just parse)."""
+    _setup_batched()
+    em.runtime.aoi_mesh_shards = 2
+    em.runtime.aoi_pallas_inkernel_drain = False
+    svc = em.runtime.get_aoi_service()
+    assert svc.pallas_inkernel_drain is False
+    assert svc.engine.inkernel_drain is False
+    # The jnp backend never drains in-kernel, so the derived budget is 0
+    # either way; the flag itself must still thread through verbatim.
+    assert svc.engine.drain_inline == 0
+    em.cleanup_for_tests()
+    _setup_batched()
+    em.runtime.aoi_mesh_shards = 2
+    svc = em.runtime.get_aoi_service()
+    assert svc.pallas_inkernel_drain is True  # default: ON
+    assert svc.engine.inkernel_drain is True
+
+
 @pytest.mark.skipif(
     not __import__(
         "goworld_tpu.parallel.compat", fromlist=["shard_map_available"]
@@ -556,6 +577,95 @@ def test_aoi_backends_agree_on_random_trace():
     assert len(a) == len(b) == 6
     assert any(any(v for v in cp.values()) for cp in a), "trace had no AOI at all"
     assert a == b
+
+
+@pytest.mark.parametrize("shards", [1, 2])
+def test_fused_delivery_parity_random_trace(shards):
+    """ISSUE 19 tentpole (a) oracle: the SAME seeded random world —
+    spawns, despawns, movement and space-hop (migration-style leave +
+    enter) churn — played with the fused device-verdict interest-edge
+    decode and with every class FORCED onto the host ``on_aoi_batch``
+    path must produce identical interest sets at every settled
+    checkpoint, on the single-device engine (shards=1) AND the spatial
+    sharded engine (shards=2).  The fused run must also PROVE it fused:
+    Monster lands on the fused-class census and the applied-events
+    counter moves."""
+    import random
+
+    from goworld_tpu.entity.aoi import batched as batched_mod
+
+    real_predicate = batched_mod._class_fused_delivery
+
+    def play(fused: bool):
+        batched_mod._class_fused_delivery = (
+            real_predicate if fused else (lambda cls: False))
+        try:
+            em.cleanup_for_tests()
+            em.register_space(MySpace)
+            em.register_entity(Monster)
+            em.runtime.aoi_backend = "batched"
+            em.runtime.aoi_mesh_shards = shards
+            from goworld_tpu.ops.neighbor import NeighborParams
+
+            em.runtime.aoi_params = NeighborParams(
+                capacity=128, cell_size=100.0, grid_x=8, grid_z=8,
+                space_slots=4, cell_capacity=32, max_events=8192,
+            )
+            rng = random.Random(1907)
+            spaces = [_setup_space(), em.create_space_locally(kind=2)]
+            spaces[1].enable_aoi(100.0)
+            ents: list = []
+            seq: dict[str, int] = {}
+            checkpoints: list[dict] = []
+            for step in range(50):
+                roll = rng.random()
+                if roll < 0.30 and len(ents) < 40:
+                    e = em.create_entity_locally("Monster")
+                    seq[e.id] = len(seq)
+                    spaces[rng.randrange(2)]._enter(
+                        e, Vector3(rng.uniform(0, 700), 0,
+                                   rng.uniform(0, 700)))
+                    ents.append(e)
+                elif roll < 0.42 and ents:
+                    ents.pop(rng.randrange(len(ents))).destroy()
+                elif roll < 0.55 and ents:
+                    # Migration-style churn: leave one space, enter the
+                    # other at a fresh position (mass leave + enter wave
+                    # through one tick's event stream).
+                    e = ents[rng.randrange(len(ents))]
+                    src = e.space
+                    dst = spaces[0] if src is spaces[1] else spaces[1]
+                    src._leave(e)
+                    dst._enter(e, Vector3(rng.uniform(0, 700), 0,
+                                          rng.uniform(0, 700)))
+                elif ents:
+                    e = ents[rng.randrange(len(ents))]
+                    e.set_position(Vector3(rng.uniform(0, 700), 0,
+                                           rng.uniform(0, 700)))
+                em.runtime.tick()
+                em.runtime.tick()
+                if step % 10 == 9:
+                    checkpoints.append({
+                        seq[e.id]: sorted(seq[o.id] for o in e.interested_in)
+                        for e in ents
+                    })
+            census = set(em.runtime.aoi_service._fused_classes)
+            em.cleanup_for_tests()
+            return checkpoints, census
+        finally:
+            batched_mod._class_fused_delivery = real_predicate
+
+    applied = batched_mod._M_FUSED_DELIVERY_EVENTS.labels("applied")
+    applied0 = applied.value
+    fused_cp, fused_census = play(True)
+    assert Monster in fused_census, "Monster never classed fused-eligible"
+    assert applied.value > applied0, "fused decode never applied a row"
+    host_cp, host_census = play(False)
+    assert not host_census, "forced-host run still classed something fused"
+    assert len(fused_cp) == len(host_cp) == 5
+    assert any(any(v for v in cp.values()) for cp in fused_cp), (
+        "trace had no AOI at all")
+    assert fused_cp == host_cp
 
 
 def test_migrate_data_roundtrip():

@@ -111,6 +111,11 @@ def test_scenario_determinism_batched(name):
     assert a["errors"] == 0 and b["errors"] == 0
     assert a["steady_state_retraces"] == 0
     assert b["steady_state_retraces"] == 0
+    # ISSUE 19 acceptance: one step-family launch per dispatched tick,
+    # surfaced as headline fields (the runner hard-raises on violation).
+    for r in (a, b):
+        assert r["one_launch_per_tick"] is True
+        assert r["step_launches"] == r["ticks_dispatched"] > 0
     assert a["invariants"] == b["invariants"], (
         f"{name}: invariants differ across identical-seed runs")
     inv = a["invariants"]
@@ -162,6 +167,8 @@ def test_scenario_sharded_engine(name):
     assert r["steady_state_retraces"] == 0
     assert r["invariants"]["dropped"] == 0
     assert r["engine"] == "sharded"
+    assert r["one_launch_per_tick"] is True
+    assert r["step_launches"] == r["ticks_dispatched"] > 0
     if name == "hotspot":
         assert r["fallback_ticks"] > 0, (
             "the hotspot crowd must overflow a strip's row budget")

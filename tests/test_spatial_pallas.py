@@ -198,6 +198,82 @@ def test_pallas_strip_event_storm_pages_chunked_drain():
     assert len(e1) == len(e2)  # exactly-once across chunks
 
 
+def test_inkernel_drain_off_matches_on():
+    """[aoi] pallas_inkernel_drain = false keeps the XLA rank-select
+    drain as the ONLY event extraction: the same churny trace (spawn/
+    despawn flips, seam drift, a first-tick enter storm) on two strip
+    engines — kernel-emitted pairs vs XLA drain — must produce identical
+    event streams every tick.  The in-kernel drain stage is a pure
+    relocation of the same computation into the launch, never a
+    different answer."""
+    mesh = make_mesh(8)
+    on = SpatialShardedNeighborEngine(
+        PARAMS, mesh, backend="pallas_interpret", strip_cols=STRIP_COLS,
+        prewarm_fallback=False)
+    off = SpatialShardedNeighborEngine(
+        PARAMS, mesh, backend="pallas_interpret", strip_cols=STRIP_COLS,
+        prewarm_fallback=False, inkernel_drain=False)
+    assert on.inkernel_drain and on.drain_inline == on.events_inline
+    assert not off.inkernel_drain and off.drain_inline == 0
+    on.reset()
+    off.reset()
+    rng, pos, active, space, radius = make_world(400, seed=23)
+    for tick in range(4):
+        e1, l1, d1 = on.step(pos, active, space, radius)
+        e2, l2, d2 = off.step(pos, active, space, radius)
+        assert to_sets(e1) == to_sets(e2), f"enters differ @ tick {tick}"
+        assert to_sets(l1) == to_sets(l2), f"leaves differ @ tick {tick}"
+        assert len(e1) == len(e2) and len(l1) == len(l2)  # exactly-once
+        assert d1 == d2
+        pos = pos + rng.normal(0, 20, pos.shape).astype(np.float32)
+        np.clip(pos[:, 0], 0, WORLD_X, out=pos[:, 0])
+        np.clip(pos[:, 1], 1.0, WORLD_Z - 1.0, out=pos[:, 1])
+        pos = pos.astype(np.float32)
+        active = active.copy()
+        active[rng.integers(0, N, 12)] ^= True
+    assert on.last_mode == "spatial" and off.last_mode == "spatial"
+    assert on.total_fallbacks == 0 and off.total_fallbacks == 0
+
+
+def test_inkernel_drain_storm_full_repage_parity():
+    """A storm tick past the inline budget on the in-kernel drain engine
+    must repage WHOLLY through the XLA rank-select (kernel emission is
+    cell-major — a partial inline window is not rank-resumable) and
+    still deliver the exact single-device stream exactly once."""
+    p = NeighborParams(
+        capacity=1024, cell_size=100.0, grid_x=64, grid_z=8,
+        space_slots=2, cell_capacity=64, max_events=128,
+    )
+    single, spatial = make_engines(p)
+    assert spatial.drain_inline > 0  # in-kernel drain armed by default
+    rng, pos, active, space, radius = make_world(400, seed=11)
+    launches0 = sentinel.launches_total("spatial_step_pallas")
+    retr0 = sentinel.steady_state_retraces()
+    ticks = 3
+    saw_storms = 0
+    for tick in range(ticks):
+        pend = spatial.step_async(pos, active, space, radius)
+        assert pend.full_repage, "in-kernel pending not marked full_repage"
+        e2, l2, _ = pend.collect()
+        e1, l1, _ = single.step(pos, active, space, radius)
+        if len(e1) > p.max_events:
+            saw_storms += 1  # the storm really overflows the inline cap
+        assert to_sets(e1) == to_sets(e2), f"enters differ @ tick {tick}"
+        assert len(e1) == len(e2)  # exactly-once across the full repage
+        assert to_sets(l1) == to_sets(l2), f"leaves differ @ tick {tick}"
+        # Big scrambles inside each strip band keep every tick stormy.
+        pos = pos + rng.normal(0, 30, pos.shape).astype(np.float32)
+        np.clip(pos[:, 0], 0, WORLD_X, out=pos[:, 0])
+        np.clip(pos[:, 1], 1.0, WORLD_Z - 1.0, out=pos[:, 1])
+        pos = pos.astype(np.float32)
+    assert saw_storms >= 1, "no tick overflowed the inline budget"
+    # The acceptance pin: the storm pages through EXTRA drain launches,
+    # but the STEP stays one launch per tick with zero steady retraces.
+    assert (sentinel.launches_total("spatial_step_pallas") - launches0
+            == ticks)
+    assert sentinel.steady_state_retraces() - retr0 == 0
+
+
 def test_pallas_strip_fused_logic_oracle():
     """Fused entity logic on the Pallas strip engine: row-permuted
     inputs, perm-snapshot writeback, exact event parity AND bit-exact

@@ -409,7 +409,8 @@ def test_tiered_step_jit_one_trace_steady_state():
     ecap = tier_edge_capacity(slabs.edge_count())
     jit = _jitted_step_packed_tiered(
         svc.params, svc.engine.backend, None,
-        (3, slabs.sync.near_ratio, slabs.sync.far_ratio), ecap)
+        (3, slabs.sync.near_ratio, slabs.sync.far_ratio), ecap,
+        svc._verdicts_enabled)
     assert jit._cache_size() == 1, "steady-state tiered dispatch re-traced"
     # Edge churn between dispatch and writeback discards the stale tier
     # vector instead of misrouting it.
