@@ -1528,6 +1528,7 @@ def bench_chaos() -> dict:
     from goworld_tpu.chaos.multigame import run_multigame
 
     c = CHAOS_CONFIG
+    slo = _slo_from_argv()
     per_transport: dict = {}
     per_scenario: dict = {}
     failures: list = []
@@ -1536,7 +1537,7 @@ def bench_chaos() -> dict:
     for transport in ("tcp", "uds"):
         with tempfile.TemporaryDirectory(prefix="bench_chaos_") as d:
             r = run_chaos(d, n_dispatchers=c["dispatchers"],
-                          n_bots=c["bots"], transport=transport)
+                          n_bots=c["bots"], transport=transport, slo=slo)
         scenarios = list(r["scenarios"])
         # 9th scenario: commanded migrations crossing a dispatcher
         # restart — needs two REAL game processes (multigame harness).
@@ -1954,6 +1955,7 @@ def bench_scenario(name: str | None = None,
         engine = "batched"
         if "--scenario-engine" in argv:
             engine = argv[argv.index("--scenario-engine") + 1]
+    slo = _slo_from_argv()
     if engine == "sharded":
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
@@ -1969,9 +1971,22 @@ def bench_scenario(name: str | None = None,
     jax.config.update("jax_platforms", "cpu")
     from goworld_tpu.scenarios.runner import run_scenario
 
-    result = run_scenario(name, engine=engine)
+    result = run_scenario(name, engine=engine, slo=slo)
     result["floor_file"] = PINNED_FLOOR_FILE
     return result
+
+
+def _slo_from_argv():
+    """``--slo-config <ini>``: the optional SLO gate for --scenario and
+    --chaos — budgets come from the file's ``[slo]`` section (ISSUE 20);
+    no flag means no gate, exactly the pre-SLO behavior."""
+    argv = sys.argv[1:]
+    if "--slo-config" not in argv:
+        return None
+    from goworld_tpu.config.read_config import _load
+
+    slo = _load(argv[argv.index("--slo-config") + 1]).slo
+    return slo if slo.enabled() else None
 
 
 def _scenario_floor_tier1_env() -> dict:
@@ -2212,6 +2227,32 @@ def bench_fused() -> dict:
 
 
 def main() -> int:
+    """Entry wrapper: ``--history-dir <dir>`` gives the bench run its own
+    black box (ISSUE 20) — bench is a process too, so its counters,
+    gauges and histogram percentiles land in a crash-survivable history
+    ring like any service's. The run is synchronous, so the ring gets
+    one final frame at exit carrying every delta the run produced (plus
+    whatever a long-running mode's own cadence added)."""
+    argv = sys.argv[1:]
+    hist = None
+    if "--history-dir" in argv:
+        from goworld_tpu.telemetry import history as history_mod
+
+        hist = history_mod.HistoryWriter(
+            os.path.join(argv[argv.index("--history-dir") + 1], "bench"),
+            "bench")
+        history_mod.set_active_writer(hist)
+    try:
+        return _run_bench()
+    finally:
+        if hist is not None:
+            from goworld_tpu.telemetry import history as history_mod
+
+            hist.close()  # final frame: the whole run's telemetry deltas
+            history_mod.clear_active_writer(hist)
+
+
+def _run_bench() -> int:
     if "--update-floor" in sys.argv[1:]:
         return update_floor(allow_lower="--allow-lower" in sys.argv[1:])
     if "--list-scenarios" in sys.argv[1:]:

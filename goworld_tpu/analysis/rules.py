@@ -516,6 +516,12 @@ HOT_PATHS: dict[str, set[str]] = {
         "handle_space_command", "_pack_and_send", "on_space_data",
         "_tick_spaces",
     },
+    # Black-box history ring (ISSUE 20): the frame encode runs on every
+    # history cadence in every process — header pack + slice assign into
+    # a grow-only buffer, no loops, no per-frame object churn (the
+    # payload walk lives in _collect, off the guarded set: it is the
+    # snapshot-cadence collector, not the encode).
+    "goworld_tpu/telemetry/history.py": {"_encode_frame"},
 }
 
 

@@ -22,6 +22,7 @@ Invariants every scenario asserts (ISSUE 3 acceptance):
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import Optional
 
@@ -38,6 +39,7 @@ from goworld_tpu.config.read_config import (
     KVDBConfig,
     StorageConfig,
     SyncConfig,
+    TelemetryConfig,
 )
 from goworld_tpu.dispatcher import DispatcherService
 from goworld_tpu.entity.entity import Entity
@@ -235,6 +237,13 @@ class ChaosCluster:
         if self.sync_knobs:
             cfg.sync = SyncConfig(**self.sync_knobs)
         cfg.cluster = self.cluster_cfg
+        # Black boxes (ISSUE 20): every chaos service appends telemetry
+        # frames to a crash-survivable history ring under run_dir — after
+        # a kill the ring is the only record of the victim's final ticks,
+        # and emit_postmortem() bundles it.
+        self.history_dir = os.path.join(self.run_dir, "history")
+        cfg.telemetry = TelemetryConfig(
+            history_dir=self.history_dir, history_interval=0.2)
         self.cfg = cfg
 
         self.game = GameService(1, cfg, restore=False)
@@ -404,6 +413,37 @@ class ChaosCluster:
             lambda: all(n in self._pongs[b.name] for b in self.bots),
             deadline, f"ping {n}: not every bot got its pong")
         return time.monotonic() - t0
+
+    async def emit_postmortem(self, reason: str) -> str:
+        """ISSUE 20: bundle the cluster's black box — every history ring
+        under run_dir (dead incarnations included: their rings outlive
+        them), the live span ring and flight dump, plus one final
+        aggregated cluster view. Returns the bundle directory path."""
+        from goworld_tpu.telemetry import tracing
+        from goworld_tpu.telemetry.collector import ClusterCollector
+        from goworld_tpu.telemetry.postmortem import collect_bundle
+
+        view = None
+        try:
+            coll = ClusterCollector(self.collector_targets(), interval=0.05)
+            await coll.poll_once()
+            view = coll.view()
+        except Exception:
+            pass  # a half-dead cluster still gets its rings bundled
+        # One asyncio loop, one process-global span ring: the scrape is
+        # shared, like a whole cluster co-hosted on one box.
+        spans = {"chaos": tracing.snapshot()}
+        flights = {}
+        if self.game is not None and self.game.flight is not None:
+            flights["game1"] = self.game.flight.snapshot()
+        out = os.path.join(
+            self.run_dir, f"postmortem-{reason.replace('/', '_')}")
+        collect_bundle(out, reason=reason, history_dir=self.history_dir,
+                       cluster_view=view, process_spans=spans,
+                       flights=flights)
+        gwlog.infof("chaos: post-mortem bundle at %s (reason=%s)",
+                    out, reason)
+        return out
 
     # --- fault injectors ----------------------------------------------------
 
@@ -932,7 +972,36 @@ async def scenario_battle_royale_kill_game(
         f"mass leave wave incomplete: {scattered} interest edges survive "
         f"the scatter onto the wide ring")
     await _royale_collapse(cluster, 2, ticks // 2, ticks)
+    # Survivor-side census at the kill point: the aggregated view the
+    # rest of the cluster agrees on, held against the victim's black box
+    # after the crash (ISSUE 20 acceptance).
+    from goworld_tpu.telemetry.collector import ClusterCollector
+
+    coll = ClusterCollector(cluster.collector_targets(), interval=0.05)
+    await coll.poll_once()
+    pre_census = int(
+        coll.view()["processes"]["game1"]["health"]["entities"])
     await cluster.kill_game()
+    # The dead game can no longer serve /flight — its history ring is the
+    # only record of its final ticks. Bundle it and hold the black box to
+    # the survivor-side census: the newest flight rows must carry exactly
+    # the entity count the aggregated view reported before the crash.
+    bundle_dir = await cluster.emit_postmortem("battle_royale_kill_game")
+    from goworld_tpu.telemetry.postmortem import load_bundle
+
+    box = load_bundle(bundle_dir)["processes"].get("game1")
+    assert box is not None and box["frames"], (
+        "killed game left no history frames in the bundle")
+    assert box["frames"][-1].get("final"), (
+        "game ring missing its final (shutdown-path) frame")
+    flight_rows = [t for f in box["frames"]
+                   for t in (f.get("flight") or [])]
+    assert len(flight_rows) >= 3, (
+        f"bundle holds only {len(flight_rows)} of the victim's ticks")
+    tail = flight_rows[-3:]
+    assert all(int(t["entities"]) == pre_census for t in tail), (
+        f"black-box census {[t['entities'] for t in tail]} != "
+        f"survivor-side /cluster census {pre_census}")
     t0 = time.monotonic()
     await cluster.restart_game()
     # The dead incarnation's clients reconnect, exactly like a real crash.
@@ -959,7 +1028,9 @@ async def scenario_battle_royale_kill_game(
             "recovery_s": round(recovery, 3),
             "post_roundtrip_s": round(rt, 3),
             "cluster_view_converge_s": round(converge, 3),
-            "endgame_edges": endgame, "bot_errors": len(errors)}
+            "endgame_edges": endgame, "bot_errors": len(errors),
+            "bundle": bundle_dir,
+            "black_box_ticks": len(flight_rows)}
 
 
 async def scenario_battle_royale_freeze_restore(
@@ -1120,7 +1191,7 @@ async def scenario_battle_royale_keyframe_storm(
 
 
 def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
-              transport: str = "tcp") -> dict:
+              transport: str = "tcp", slo=None) -> dict:
     """Run the single-cluster scenario suite (``bench.py --chaos``;
     ``transport`` = "tcp" or "uds" — the fault semantics must be
     transport-identical and every scenario asserts its own invariants
@@ -1128,7 +1199,13 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
     times and bot-error counts; a scenario failure is CAPTURED (named in
     ``failures``) and aborts the remaining scenarios on this cluster —
     the caller decides the exit code, so one red scenario can never hide
-    the others' numbers."""
+    the others' numbers. A failed scenario also leaves a post-mortem
+    bundle (named in its failure entry) holding every history ring.
+
+    ``slo`` is an optional :class:`SLOConfig`: with a
+    ``bot_error_rate`` budget set, the suite's aggregate bot-error rate
+    (errors per bot per scenario) is judged at the end and a violation
+    lands in ``failures`` like any red scenario."""
 
     async def _run() -> dict:
         cluster = ChaosCluster(
@@ -1170,22 +1247,46 @@ def run_chaos(run_dir: str, n_dispatchers: int = 2, n_bots: int = 12,
                     results.append(r)
                 except Exception as exc:  # captured, not swallowed
                     gwlog.trace_error("chaos: scenario %s failed", name)
-                    failures.append({
+                    failure = {
                         "scenario": name,
                         "error": f"{type(exc).__name__}: {exc}",
                         "bot_errors": len(cluster.bot_errors()),
-                    })
+                    }
+                    # The black box outlives the failure: bundle every
+                    # history ring before tearing the cluster down.
+                    try:
+                        failure["bundle"] = await cluster.emit_postmortem(
+                            f"{name}-failed")
+                    except Exception:
+                        gwlog.trace_error(
+                            "chaos: post-mortem bundle failed for %s", name)
+                    failures.append(failure)
                     break  # cluster state is suspect; stop this transport
         finally:
             await cluster.stop()
-        return {
+        bot_errors = sum(r.get("bot_errors", 0) for r in results)
+        summary = {
             "scenarios": results,
             "failures": failures,
             "passed": len(results),
-            "bot_errors": sum(r.get("bot_errors", 0) for r in results),
+            "bot_errors": bot_errors,
             "dispatchers": n_dispatchers,
             "bots": n_bots,
             "transport": transport,
         }
+        if slo is not None and slo.enabled():
+            from goworld_tpu.telemetry.slo import judge_values, render_verdict
+
+            rate = (bot_errors / (n_bots * len(results))
+                    if results else 0.0)
+            verdict = judge_values(slo, bot_error_rate=rate)
+            summary["slo"] = verdict
+            if not verdict["ok"]:
+                failures.append({
+                    "scenario": "slo_gate",
+                    "error": f"SLOViolation: {render_verdict(verdict)}",
+                    "bot_errors": bot_errors,
+                })
+        return summary
 
     return asyncio.run(_run())

@@ -360,6 +360,53 @@ class TelemetryConfig:
     # steady-state retrace (jit_retrace_events_total + ONE structured
     # WARN naming the arg shape/dtype delta).
     retrace_warm_ticks: int = 32
+    # Crash-survivable history ring (telemetry/history.py): when
+    # history_dir is non-empty every process appends periodic telemetry
+    # frames to <history_dir>/<process-name>/ — the per-process black box
+    # post-mortem bundles collect. Empty = off (the default).
+    history_dir: str = ""
+    # Seconds between history frames (the writer rides its own asyncio
+    # cadence task, never the logic loop).
+    history_interval: float = 1.0
+    # On-disk ring geometry: fixed-size segments, drop-oldest. Disk use
+    # is bounded by history_segments * history_segment_bytes per process.
+    history_segment_bytes: int = 262144
+    history_segments: int = 8
+
+
+@dataclasses.dataclass
+class SLOConfig:
+    """Cluster SLO budgets (``[slo]``; telemetry/slo.py). Budgets left
+    unset (None) are not evaluated; ``enabled()`` is true when any budget
+    is set. The driver dispatcher's ClusterCollector judges every poll
+    against these and publishes per-budget compliance + multi-window burn
+    rate in ``GET /cluster`` (gwtop's SLO column); ``run_scenario`` and
+    the chaos harness accept the same object as a hard gate."""
+
+    # Game tick p99 wall-clock budget, seconds (game_tick_phase_seconds
+    # {phase=total} — the flight recorder's tick).
+    tick_p99_budget: Optional[float] = None
+    # Client delivery p99 budget, seconds: the sync_send phase p99 — the
+    # slice of the tick spent fanning updates out to gates/clients.
+    delivery_p99_budget: Optional[float] = None
+    # Max tolerated strict-bot error rate (errors per bot), chaos/bench
+    # gates only — there is no cluster-side metric for bot errors.
+    bot_error_rate: Optional[float] = None
+    # Max tolerated steady-state retraces, cluster-wide (the floor gates
+    # pin 0; None = don't judge).
+    steady_state_retraces: Optional[int] = None
+    # Fraction of polls allowed out of budget before burn rate hits 1.0
+    # (SRE error-budget convention: burn = violation_rate/error_budget).
+    error_budget: float = 0.01
+    # Burn-rate windows, in collector polls (short ≈ page-now, long ≈
+    # budget-trend; 12/120 polls at the default 1 s cadence).
+    burn_short_polls: int = 12
+    burn_long_polls: int = 120
+
+    def enabled(self) -> bool:
+        return any(v is not None for v in (
+            self.tick_p99_budget, self.delivery_p99_budget,
+            self.bot_error_rate, self.steady_state_retraces))
 
 
 @dataclasses.dataclass
@@ -409,6 +456,7 @@ class GoWorldConfig:
     rebalance: RebalanceConfig = dataclasses.field(default_factory=RebalanceConfig)
     client: ClientConfig = dataclasses.field(default_factory=ClientConfig)
     telemetry: TelemetryConfig = dataclasses.field(default_factory=TelemetryConfig)
+    slo: SLOConfig = dataclasses.field(default_factory=SLOConfig)
     scenario: ScenarioConfig = dataclasses.field(default_factory=ScenarioConfig)
     log: LogConfig = dataclasses.field(default_factory=LogConfig)
     debug: DebugConfig = dataclasses.field(default_factory=DebugConfig)
@@ -642,6 +690,27 @@ def _load(path: Optional[str]) -> GoWorldConfig:
             cluster_snapshot_interval=float(
                 s.get("cluster_snapshot_interval", 1.0)),
             retrace_warm_ticks=int(s.get("retrace_warm_ticks", 32)),
+            history_dir=s.get("history_dir", "").strip(),
+            history_interval=float(s.get("history_interval", 1.0)),
+            history_segment_bytes=int(s.get("history_segment_bytes", 262144)),
+            history_segments=int(s.get("history_segments", 8)),
+        )
+    if cp.has_section("slo"):
+        s = cp["slo"]
+
+        def _opt_f(v):
+            v = v.strip()
+            return float(v) if v else None  # "" = budget unset
+
+        retr = s.get("steady_state_retraces", "").strip()
+        cfg.slo = SLOConfig(
+            tick_p99_budget=_opt_f(s.get("tick_p99_budget", "")),
+            delivery_p99_budget=_opt_f(s.get("delivery_p99_budget", "")),
+            bot_error_rate=_opt_f(s.get("bot_error_rate", "")),
+            steady_state_retraces=int(retr) if retr else None,
+            error_budget=float(s.get("error_budget", 0.01)),
+            burn_short_polls=int(s.get("burn_short_polls", 12)),
+            burn_long_polls=int(s.get("burn_long_polls", 120)),
         )
     if cp.has_section("scenario"):
         s = cp["scenario"]
@@ -902,6 +971,29 @@ def _validate(cfg: GoWorldConfig) -> None:
             "(0 = no cluster collector)")
     if t.retrace_warm_ticks < 1:
         raise ValueError("[telemetry] retrace_warm_ticks must be >= 1")
+    if t.history_interval <= 0:
+        raise ValueError("[telemetry] history_interval must be > 0 seconds")
+    if t.history_segment_bytes < 4096:
+        raise ValueError(
+            "[telemetry] history_segment_bytes must be >= 4096")
+    if t.history_segments < 2:
+        raise ValueError(
+            "[telemetry] history_segments must be >= 2 (the ring needs a "
+            "previous segment to survive rotation)")
+    slo = cfg.slo
+    for key, v in (("tick_p99_budget", slo.tick_p99_budget),
+                   ("delivery_p99_budget", slo.delivery_p99_budget),
+                   ("bot_error_rate", slo.bot_error_rate)):
+        if v is not None and v < 0:
+            raise ValueError(f"[slo] {key} must be >= 0")
+    if slo.steady_state_retraces is not None and slo.steady_state_retraces < 0:
+        raise ValueError("[slo] steady_state_retraces must be >= 0")
+    if not (0.0 < slo.error_budget <= 1.0):
+        raise ValueError("[slo] error_budget must be in (0, 1]")
+    if slo.burn_short_polls < 1 or slo.burn_long_polls < slo.burn_short_polls:
+        raise ValueError(
+            "[slo] burn windows must satisfy 1 <= burn_short_polls "
+            "<= burn_long_polls")
     sc = cfg.scenario
     if sc.default_engine not in ("batched", "sharded"):
         raise ValueError(

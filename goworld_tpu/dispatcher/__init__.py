@@ -82,13 +82,34 @@ def run(dispid: int | None = None) -> int:
             if targets:
                 collector = ClusterCollector(
                     targets,
-                    interval=cfg.telemetry.cluster_snapshot_interval)
+                    interval=cfg.telemetry.cluster_snapshot_interval,
+                    slo=cfg.slo)
                 await collector.start()
                 debug_http.set_cluster_provider(collector.view)
                 gwlog.infof(
                     "cluster collector: aggregating %d processes on "
-                    "/cluster every %.1fs", len(targets),
-                    collector.interval)
+                    "/cluster every %.1fs%s", len(targets),
+                    collector.interval,
+                    " (SLO budgets active)" if cfg.slo.enabled() else "")
+        # Black-box history ring (telemetry/history.py).
+        hist_writer = None
+        hist_task = None
+        if cfg.telemetry.history_dir:
+            import os as _os
+
+            from goworld_tpu.telemetry import history as history_mod
+
+            hist_writer = history_mod.HistoryWriter(
+                _os.path.join(cfg.telemetry.history_dir,
+                              f"dispatcher{args.dispid}"),
+                f"dispatcher{args.dispid}",
+                interval=cfg.telemetry.history_interval,
+                segment_bytes=cfg.telemetry.history_segment_bytes,
+                segments=cfg.telemetry.history_segments,
+                health=svc._health)
+            history_mod.set_active_writer(hist_writer)
+            hist_task = asyncio.get_running_loop().create_task(
+                hist_writer.run())
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         try:
@@ -96,6 +117,13 @@ def run(dispid: int | None = None) -> int:
         except (NotImplementedError, RuntimeError):
             pass
         await stop.wait()
+        if hist_task is not None:
+            hist_task.cancel()
+        if hist_writer is not None:
+            from goworld_tpu.telemetry import history as history_mod
+
+            hist_writer.close()
+            history_mod.clear_active_writer(hist_writer)
         if collector is not None:
             debug_http.clear_cluster_provider(collector.view)
             await collector.stop()

@@ -291,6 +291,8 @@ class GameService:
 
         lbc_task = None
         debug_srv = None
+        hist_writer = None
+        hist_task = None
         try:
             # Debug HTTP server (binutil.SetupHTTPServer; game.go:107) + gwvar.
             gwvar.set_var("IsDeploymentReady", lambda: self.deployment_ready)
@@ -336,6 +338,24 @@ class GameService:
             ).labels(str(self.gameid)).set_function(
                 lambda: len(entity_manager.entities()))
             debug_srv = await setup_http_server(game_cfg.http_addr if game_cfg else "")
+            if tcfg is not None and tcfg.history_dir:
+                # Black-box history ring (telemetry/history.py): its own
+                # cadence task off the logic loop; the finally below
+                # writes the final frame — after a kill this ring is the
+                # only record of the process's last ticks.
+                from goworld_tpu.telemetry import history as history_mod
+                import os as _os
+
+                hist_writer = history_mod.HistoryWriter(
+                    _os.path.join(tcfg.history_dir, f"game{self.gameid}"),
+                    f"game{self.gameid}",
+                    interval=tcfg.history_interval,
+                    segment_bytes=tcfg.history_segment_bytes,
+                    segments=tcfg.history_segments,
+                    health=self._health, flight=self.flight)
+                history_mod.set_active_writer(hist_writer)
+                hist_task = asyncio.get_running_loop().create_task(
+                    hist_writer.run())
             lbc_task = asyncio.get_running_loop().create_task(self._lbc_loop())
             gwlog.infof("game %d starting (restore=%s)", self.gameid, self.restore)
             gwlog.infof(consts.GAME_STARTED_TAG)
@@ -343,6 +363,15 @@ class GameService:
         finally:
             if lbc_task is not None:
                 lbc_task.cancel()
+            if hist_task is not None:
+                hist_task.cancel()
+            if hist_writer is not None:
+                # Final frame: the ring's newest entry carries the last
+                # flight-recorder ticks + census this incarnation saw.
+                hist_writer.close()
+                from goworld_tpu.telemetry import history as history_mod
+
+                history_mod.clear_active_writer(hist_writer)
             if debug_srv is not None:
                 await debug_srv.stop()
             # IsDeploymentReady is guaranteed always-published (gwvar.go:27-29

@@ -206,6 +206,23 @@ class GateService:
         debug_http.set_health_provider(self._health)
         self._debug_srv = await setup_http_server(self.gate_cfg.http_addr)
         loop = asyncio.get_running_loop()
+        if tcfg is not None and getattr(tcfg, "history_dir", ""):
+            # Black-box history ring (telemetry/history.py) — the gate
+            # has no flight recorder, so frames carry health + metric
+            # deltas only.
+            import os as _os
+
+            from goworld_tpu.telemetry import history as history_mod
+
+            self._hist_writer = history_mod.HistoryWriter(
+                _os.path.join(tcfg.history_dir, f"gate{self.gateid}"),
+                f"gate{self.gateid}",
+                interval=tcfg.history_interval,
+                segment_bytes=tcfg.history_segment_bytes,
+                segments=tcfg.history_segments,
+                health=self._health)
+            history_mod.set_active_writer(self._hist_writer)
+            self._tasks.append(loop.create_task(self._hist_writer.run()))
         self._tasks.append(loop.create_task(self._logic_loop()))
         self._tasks.append(loop.create_task(self._tick_loop()))
         gwlog.infof("gate %d listening on %s:%d (tls=%s)",
@@ -260,6 +277,13 @@ class GateService:
 
         debug_http.clear_health_provider(self._health)
         self._unregister_metrics()
+        hist_writer = getattr(self, "_hist_writer", None)
+        if hist_writer is not None:
+            from goworld_tpu.telemetry import history as history_mod
+
+            hist_writer.close()
+            history_mod.clear_active_writer(hist_writer)
+            self._hist_writer = None
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:

@@ -77,6 +77,18 @@ _M_ALLGATHER_TOTAL = telemetry.counter(
     "sharded tier every tick; the spatial tier only on exact-fallback "
     "ticks).",
 )
+# Per-link transfer accounting (ROADMAP item 5): what each receiving
+# device/host/seam pulls per tick, attributable after the fact through
+# the history frames every process records. tier: ici-allgather (entity-
+# sharded within a host), dcn-allgather (multihost cross-host slice),
+# halo (the spatial tier's seam ppermute — OBSERVED band occupancy, not
+# the structural halo_cap envelope).
+_M_LINK_BYTES = telemetry.counter(
+    "aoi_link_bytes_total",
+    "Per-link device-comms bytes by tier (ici-allgather / dcn-allgather "
+    "/ halo) and link (receiving device, host slice, or strip seam).",
+    ("tier", "link"),
+)
 
 
 def make_mesh(n_devices: int | None = None, devices: list | None = None) -> Mesh:
@@ -591,6 +603,13 @@ class ShardedNeighborEngine:
             n_dev * (params.capacity - self.chunk) * 34
         )
         _M_ALLGATHER_EQUIV.set(self.allgather_bytes_per_tick)
+        # Per-link split of the same structural total: each device pulls
+        # every OTHER shard's rows (children prebuilt — label lookups
+        # stay out of the tick).
+        self._link_bytes = (params.capacity - self.chunk) * 34
+        self._link_children = tuple(
+            _M_LINK_BYTES.labels("ici-allgather", f"dev{d}")
+            for d in range(n_dev))
         if backend == "jnp":
             self._jit_step = _jitted_sharded_step(
                 params, mesh, self.events_inline
@@ -696,6 +715,8 @@ class ShardedNeighborEngine:
             enter_ctx, leave_ctx, out = res[0:5], res[5:10], res[10]
         self._state = cur
         _M_ALLGATHER_TOTAL.inc(self.allgather_bytes_per_tick)
+        for child in self._link_children:
+            child.inc(self._link_bytes)
         return ShardedPendingStep(self, enter_ctx, leave_ctx, out)
 
     def step(

@@ -55,6 +55,7 @@ from goworld_tpu.parallel.mesh import (
     SHARD_AXIS,
     _M_ALLGATHER_EQUIV,
     _M_ALLGATHER_TOTAL,
+    _M_LINK_BYTES,
     _jitted_sharded_drain,
     _jitted_sharded_drain_bits,
     _jitted_sharded_step,
@@ -255,6 +256,16 @@ class MultiHostNeighborEngine:
         self.local_capacity = len(owned) * self.chunk
         self._state: tuple | None = None
         self.last_grid_dropped = 0
+        # Per-link split of THIS process's slice of the all-gather: local
+        # devices pull each other's rows over ICI and every remote
+        # shard's rows over DCN (ROADMAP item 5 — the two link tiers of
+        # a pod, attributable per host after the fact).
+        n_local = len(owned)
+        host = f"host{jax.process_index()}"
+        self._ici_bytes = n_local * (n_local - 1) * self.chunk * 34
+        self._dcn_bytes = n_local * (n_dev - n_local) * self.chunk * 34
+        self._m_link_ici = _M_LINK_BYTES.labels("ici-allgather", host)
+        self._m_link_dcn = _M_LINK_BYTES.labels("dcn-allgather", host)
 
     # --- multi-controller array builders ------------------------------------
 
@@ -327,6 +338,10 @@ class MultiHostNeighborEngine:
             enter_ctx, leave_ctx, out = res[0:5], res[5:10], res[10]
         self._state = cur
         _M_ALLGATHER_TOTAL.inc(self.allgather_bytes_per_tick)
+        if self._ici_bytes:
+            self._m_link_ici.inc(self._ici_bytes)
+        if self._dcn_bytes:
+            self._m_link_dcn.inc(self._dcn_bytes)
         return MultiHostPendingStep(self, enter_ctx, leave_ctx, out)
 
     def step(self, pos, active, space, radius):

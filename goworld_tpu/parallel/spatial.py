@@ -1201,6 +1201,18 @@ class SpatialShardedNeighborEngine:
             "Density-driven strip re-plans adopted (equal-population "
             "boundary moves).",
         )
+        # Per-seam observed halo payload (ROADMAP item 5): the wire moves
+        # the structural halo_cap envelope, but the OCCUPIED rows of each
+        # band are what a comms regression shows up in — counted per
+        # directed seam link into the shared aoi_link_bytes_total family
+        # (children prebuilt; _build_bands records the occupancy).
+        from goworld_tpu.parallel.mesh import _M_LINK_BYTES
+
+        self._halo_link_children = tuple(
+            (_M_LINK_BYTES.labels("halo", f"{s}->{(s - 1) % n_dev}"),
+             _M_LINK_BYTES.labels("halo", f"{s}->{(s + 1) % n_dev}"))
+            for s in range(n_dev))
+        self._last_band_counts: np.ndarray | None = None
         if prewarm_fallback:
             # The fallback program compiles lazily on its (rare) first
             # tick otherwise — a synchronous XLA compile inside the game
@@ -1558,6 +1570,15 @@ class SpatialShardedNeighborEngine:
                 leave_ctx = ("spatial", leave_ids, self._perm_dev)
             self.last_mode = "spatial"
             self._m_halo_bytes.inc(self.halo_bytes_per_tick)
+            if self._last_band_counts is not None:
+                for s in range(self.n_devices):
+                    lo_n, hi_n = self._last_band_counts[s]
+                    if lo_n:
+                        self._halo_link_children[s][0].inc(
+                            int(lo_n) * HALO_ROW_BYTES)
+                    if hi_n:
+                        self._halo_link_children[s][1].inc(
+                            int(hi_n) * HALO_ROW_BYTES)
             pending = ShardedPendingStep(self, enter_ctx, leave_ctx, out)
             # The strip-local bit drain pages by event RANK; everything
             # else (jnp ids, the jnp all-gather fallback) by flat index.
@@ -1715,13 +1736,18 @@ class SpatialShardedNeighborEngine:
             high &= ~low
         send_lo = np.full(d * h, self.chunk, np.int32)
         send_hi = np.full(d * h, self.chunk, np.int32)
+        counts = np.zeros((d, 2), np.int64)
         for s in range(d):
-            for mask, buf in ((low, send_lo), (high, send_hi)):
+            for i, (mask, buf) in enumerate(((low, send_lo),
+                                             (high, send_hi))):
                 slots = rel[mask & (sh == s)]
                 if len(slots) > h:
+                    self._last_band_counts = None
                     return None, None, True
                 rows = np.sort(self.row_of[slots] - s * self.chunk)
                 buf[s * h:s * h + len(rows)] = rows
+                counts[s, i] = len(rows)
+        self._last_band_counts = counts
         return send_lo, send_hi, False
 
     def _page(self, ctx: tuple, deficit: np.ndarray, starts: np.ndarray):

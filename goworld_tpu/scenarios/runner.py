@@ -177,7 +177,8 @@ def _step_launches() -> int:
 
 def run_scenario(name: str, engine: Optional[str] = "batched",
                  seed: Optional[int] = -1,
-                 ticks_scale: Optional[float] = 1.0) -> Dict[str, Any]:
+                 ticks_scale: Optional[float] = 1.0,
+                 slo: Any = None) -> Dict[str, Any]:
     """Run a registered scenario end-to-end; returns the headline dict
     (bench.py prints it as the one JSON line).
 
@@ -186,6 +187,14 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
     bench.py's gate mode, which relies on them) never touch the ini, so
     committed floors cannot drift with an operator's config.  A negative
     seed — the default — means the registry's fixed per-scenario seed.
+
+    ``slo`` is an optional :class:`SLOConfig`: when it has budgets set,
+    the measure pass also records per-tick wall times and the run is
+    judged against ``tick_p99_budget`` / ``steady_state_retraces`` —
+    a violated budget raises :class:`SLOViolation` (the headline would
+    have shipped a number the operator declared unacceptable). The
+    per-tick clock reads happen ONLY under an active SLO gate, so the
+    pinned floors' measure loop is untouched.
 
     The ``invariants`` sub-dict holds ONLY seed-deterministic fields —
     the determinism gate asserts two back-to-back runs produce it
@@ -226,6 +235,8 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
     repeats = int(world.config.get("repeats", 1))
     ticks = int(world.config["ticks"])
     launches0 = _step_launches()
+    slo_active = slo is not None and slo.enabled()
+    tick_wall: List[float] = []
     runs: List[float] = []
     for _rep in range(repeats):
         w = spec.make(seed=seed, ticks_scale=ticks_scale)
@@ -237,13 +248,26 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
             eng.step(w.pos, w.active, w.space, w.radius)
             pending = None
             t0 = time.perf_counter()
-            for t in range(1, ticks):
-                dirty = w.tick(t)
-                nxt = eng.step_async(w.pos, w.active, w.space, w.radius,
-                                     meta_dirty=bool(dirty))
-                if pending is not None:
-                    pending.collect()
-                pending = nxt
+            if slo_active:
+                t_prev = t0
+                for t in range(1, ticks):
+                    dirty = w.tick(t)
+                    nxt = eng.step_async(w.pos, w.active, w.space, w.radius,
+                                         meta_dirty=bool(dirty))
+                    if pending is not None:
+                        pending.collect()
+                    pending = nxt
+                    now = time.perf_counter()
+                    tick_wall.append(now - t_prev)
+                    t_prev = now
+            else:
+                for t in range(1, ticks):
+                    dirty = w.tick(t)
+                    nxt = eng.step_async(w.pos, w.active, w.space, w.radius,
+                                         meta_dirty=bool(dirty))
+                    if pending is not None:
+                        pending.collect()
+                    pending = nxt
             if pending is not None:
                 pending.collect()
             runs.append((ticks - 1) / (time.perf_counter() - t0) * w.n)
@@ -262,6 +286,24 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
             f"one-launch pin violated: {ticks_dispatched} measured ticks "
             f"dispatched but {step_launches} step launches recorded")
 
+    retraces = _retrace_count() - retraces0
+    slo_verdict = None
+    if slo_active:
+        from goworld_tpu.telemetry.slo import (
+            SLOViolation,
+            judge_values,
+            render_verdict,
+        )
+
+        s = sorted(tick_wall)
+        tick_p99 = s[max(0, -(-len(s) * 99 // 100) - 1)] if s else 0.0
+        slo_verdict = judge_values(
+            slo, tick_p99=tick_p99, steady_state_retraces=retraces)
+        if not slo_verdict["ok"]:
+            raise SLOViolation(
+                f"scenario {name!r} violated its SLO: "
+                f"{render_verdict(slo_verdict)}")
+
     headline: Dict[str, Any] = {
         "metric": f"scenario_{name}_updates_per_sec",
         "value": round(max(runs), 1),
@@ -272,12 +314,14 @@ def run_scenario(name: str, engine: Optional[str] = "batched",
         "config": dict(spec.config),
         "seed": world.seed,
         "invariants": invariants,
-        "steady_state_retraces": _retrace_count() - retraces0,
+        "steady_state_retraces": retraces,
         "step_launches": step_launches,
         "ticks_dispatched": ticks_dispatched,
         "one_launch_per_tick": True,
         "errors": 0,
     }
+    if slo_verdict is not None:
+        headline["slo"] = slo_verdict
     headline.update(extra)
     # Engine-internal counters: structural, but timing-adjacent on the
     # sharded tier (replan cadence), so they ride OUTSIDE invariants —

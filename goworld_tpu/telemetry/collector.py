@@ -58,6 +58,7 @@ SNAPSHOT_FAMILY_PREFIXES: tuple[str, ...] = (
     "chaos_recovery_seconds",
     "net_packets_total",
     "net_bytes_total",
+    "history_",
 )
 
 
@@ -158,7 +159,8 @@ class ClusterCollector:
 
     def __init__(self, targets: list[tuple[str, Fetch]],
                  interval: float = 1.0,
-                 stale_after: Optional[float] = None) -> None:
+                 stale_after: Optional[float] = None,
+                 slo: Any = None) -> None:
         self.interval = max(0.05, float(interval))
         # A row older than this is stale even if the fetch "worked"
         # (default: three scrape cycles, mirroring [rebalance]
@@ -169,6 +171,12 @@ class ClusterCollector:
         self._rows: dict[str, dict[str, Any]] = {}
         self._task: Optional[asyncio.Task[None]] = None
         self._polls = 0
+        # SLO plane (telemetry/slo.py): judged once per poll so the burn
+        # windows advance at scrape cadence, not reader cadence.
+        self._judge = None
+        if slo is not None and slo.enabled():
+            from goworld_tpu.telemetry.slo import SLOJudge
+            self._judge = SLOJudge(slo)
 
     # --- lifecycle ----------------------------------------------------------
 
@@ -206,6 +214,8 @@ class ClusterCollector:
                 row["snapshot"] = prev.get("snapshot")
                 row["fetched_at"] = prev.get("fetched_at", 0.0)
             self._rows[name] = row
+        if self._judge is not None:
+            self._judge.judge_poll(self._process_rows())
 
     async def _fetch_one(self, name: str,
                          fetch: Fetch) -> tuple[str, dict[str, Any]]:
@@ -220,11 +230,7 @@ class ClusterCollector:
 
     # --- the aggregate view -------------------------------------------------
 
-    def view(self) -> dict[str, Any]:
-        """The ``GET /cluster`` object: one row per process + a cluster
-        summary (census conservation, generation consistency, migration
-        and retrace counters, alerts). Built on demand — the reader pays,
-        the scrape loop just stores."""
+    def _process_rows(self) -> dict[str, dict[str, Any]]:
         now = time.monotonic()
         processes: dict[str, dict[str, Any]] = {}
         for name, raw in sorted(self._rows.items()):
@@ -240,6 +246,18 @@ class ClusterCollector:
                 "health": snap.get("health") or {},
                 "metrics": snap.get("metrics") or {},
             }
+        return processes
+
+    def view(self) -> dict[str, Any]:
+        """The ``GET /cluster`` object: one row per process + a cluster
+        summary (census conservation, generation consistency, migration
+        and retrace counters, alerts). Built on demand — the reader pays,
+        the scrape loop just stores."""
+        processes = self._process_rows()
+        summary = summarize(processes)
+        if self._judge is not None:
+            summary["slo"] = self._judge.summary()
+            summary["alerts"].extend(self._judge.alerts())
         return {
             "collector": {
                 "interval_s": self.interval,
@@ -249,7 +267,7 @@ class ClusterCollector:
                 "ts": time.time(),
             },
             "processes": processes,
-            "summary": summarize(processes),
+            "summary": summary,
         }
 
 
@@ -290,6 +308,11 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
                       "paused_few": 0.0}
     spaces_in_flight = 0.0
     space_handoffs_parked = 0
+    # Device comms (ROADMAP item 5): per-link halo / allgather bytes
+    # rolled up by tier for the /cluster summary (the per-link series
+    # stay on each row's metrics and in its history frames).
+    comms_tiers: dict[str, float] = {}
+    comms_links: set = set()
     planner_host = None
     planner_last = None
     planner_service = False
@@ -339,6 +362,13 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
         for outcome in space_outcomes:
             space_outcomes[outcome] += _series_sum(
                 m, "rebalance_space_migrations_total", "outcome", outcome)
+        link_fam = m.get("aoi_link_bytes_total")
+        if link_fam:
+            for s in link_fam.get("series", []):
+                tier = s.get("labels", {}).get("tier", "")
+                comms_tiers[tier] = (comms_tiers.get(tier, 0.0)
+                                     + float(s.get("value", 0.0)))
+                comms_links.add((tier, s.get("labels", {}).get("link", "")))
         for reason in paused_reasons:
             paused_reasons[reason] += _series_sum(
                 m, "rebalance_plans_total", "result", reason)
@@ -423,6 +453,10 @@ def summarize(processes: dict[str, dict[str, Any]]) -> dict[str, Any]:
                 k: int(v) for k, v in space_outcomes.items()},
         },
         "steady_state_retraces": int(retraces),
+        "comms": {
+            "links": len(comms_links),
+            "bytes": {k: int(v) for k, v in sorted(comms_tiers.items())},
+        },
         "fused": {"classes": int(fused_classes), "slots": int(fused_slots)},
         "delivery": {
             "fused_classes": int(fused_delivery_classes),

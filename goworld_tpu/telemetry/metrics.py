@@ -177,12 +177,15 @@ class Histogram:
     def percentile(self, q: float) -> float:
         """Nearest-rank percentile over the bounded ring: ceil(q*n)-1, NOT
         int(q*n) — the latter returns the max (p100) for n in 100..101 and
-        overstates p99 generally (carried over from opmon)."""
+        overstates p99 generally (carried over from opmon). Rank arithmetic
+        is integer per-mille so p999 is distinct from p99 and no float-ceil
+        precision leaks in (0.95 * 100 is not 95 in binary)."""
         with self._lock:
             s = sorted(self._ring)
         if not s:
             return 0.0
-        return s[max(0, -(-len(s) * int(q * 100) // 100) - 1)]
+        q1000 = int(round(q * 1000))
+        return s[max(0, -(-len(s) * q1000 // 1000) - 1)]
 
     def cumulative_buckets(self) -> list[tuple[float, int]]:
         """[(upper_bound, cumulative_count)], ending with (+Inf, count)."""
@@ -350,6 +353,10 @@ class Registry:
                     sfx = f"{{{base[:-1]}}}" if base else ""
                     lines.append(f"{fam.name}_sum{sfx} {_fmt(child.sum)}")
                     lines.append(f"{fam.name}_count{sfx} {child.count}")
+                    lines.append(
+                        f"{fam.name}_p999{sfx} "
+                        f"{_fmt(child.percentile(0.999))}"
+                    )
                 else:
                     sfx = f"{{{base[:-1]}}}" if base else ""
                     lines.append(f"{fam.name}{sfx} {_fmt(child.value)}")
@@ -357,7 +364,7 @@ class Registry:
 
     def snapshot(self) -> dict:
         """JSON-able structured dump (the ``/opmon`` superset: every family,
-        every series; histograms carry count/avg/max/p50/p95/p99)."""
+        every series; histograms carry count/avg/max/p50/p95/p99/p999)."""
         out: dict = {}
         for fam in self._families_snapshot():
             series = []
@@ -374,6 +381,7 @@ class Registry:
                         "p50": child.percentile(0.50),
                         "p95": child.percentile(0.95),
                         "p99": child.percentile(0.99),
+                        "p999": child.percentile(0.999),
                     })
                 else:
                     series.append({"labels": labels, "value": child.value})

@@ -76,7 +76,8 @@ def _phase_stats(metrics: dict[str, Any]) -> dict[str, dict[str, float]]:
     for s in _series(metrics, "game_tick_phase_seconds"):
         phase = s["labels"].get("phase", "")
         out[phase] = {"p50": float(s.get("p50", 0.0)),
-                      "p95": float(s.get("p95", 0.0))}
+                      "p95": float(s.get("p95", 0.0)),
+                      "p999": float(s.get("p999", 0.0))}
     return out
 
 
@@ -134,7 +135,8 @@ def _row(name: str, proc: dict[str, Any], tick_budget: float) -> list[str]:
         f"{uptime:.0f}" if isinstance(uptime, (int, float)) else "-",
         census,
         queue_s,
-        f"{_fmt_ms(total.get('p50'))}/{_fmt_ms(total.get('p95'))}",
+        (f"{_fmt_ms(total.get('p50'))}/{_fmt_ms(total.get('p95'))}"
+         f"/{_fmt_ms(total.get('p999'))}"),
         heat,
         f"{int(backlog)}" if backlog is not None else "-",
         fused,
@@ -203,8 +205,8 @@ def _rebal_col(h: dict[str, Any], metrics: dict[str, Any]) -> str:
 
 
 _HEADERS = ["PROCESS", "ST", "AGE", "UP", "CENSUS", "Q",
-            "TICK p50/p95ms", "HEAT", "AOIBL", "FUSED", "DLVR", "SYNC",
-            "REBAL", "LAUNCH", "RETR"]
+            "TICK p50/p95/p999ms", "HEAT", "AOIBL", "FUSED", "DLVR",
+            "SYNC", "REBAL", "LAUNCH", "RETR"]
 
 
 def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:
@@ -239,6 +241,23 @@ def render(view: dict[str, Any], tick_budget: float = 0.1) -> str:
          f"stale>{coll.get('stale_after_s', 0)}s · heat="
          f"{'·'.join(_PHASES)} vs {tick_budget * 1000:.0f}ms budget"),
     ]
+    slo = summary.get("slo") or {}
+    if slo.get("enabled"):
+        # The SLO column (ISSUE 20): per-budget observed/budget,
+        # compliance over the long window, and the burn-rate multiple
+        # (1.0 = consuming the error budget exactly at the sustainable
+        # rate; sustained > 1.0 raises an alert below).
+        parts = []
+        for bname, b in (slo.get("budgets") or {}).items():
+            obs = b.get("observed")
+            obs_s = "-" if obs is None else f"{obs:.4g}"
+            parts.append(
+                f"{bname} {obs_s}/{b.get('budget'):.4g}"
+                f" c={b.get('compliance', 0.0):.2f}"
+                f" burn={b.get('burn_long', 0.0):.2f}"
+                + ("" if b.get("ok") else " VIOLATED"))
+        lines.append("slo: " + ("OK" if slo.get("ok") else "VIOLATED")
+                     + " · " + " | ".join(parts))
     alerts = summary.get("alerts") or []
     lines.append("alerts: " + ("; ".join(alerts) if alerts else "(none)"))
     stale = (summary.get("generations") or {}).get("stale") or []

@@ -304,6 +304,17 @@ class DebugHTTPServer:
                  "note": "no tick loop in this process"},
                 default=str)
             return "200 OK", "application/json", body.encode()
+        if path == "/history":
+            from goworld_tpu.telemetry import history
+
+            w = history.active_writer()
+            body = json.dumps(
+                w.snapshot() if w is not None else
+                {"dir": None,
+                 "note": "no history writer in this process "
+                         "([telemetry] history_dir unset)"},
+                default=str)
+            return "200 OK", "application/json", body.encode()
         if path == "/heap/start":
             # Live heap profiling (pprof's /heap slot, via tracemalloc):
             # start tracing, then GET /heap for the top Python growth

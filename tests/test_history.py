@@ -1,0 +1,505 @@
+"""Black-box telemetry: crash-survivable history rings, the SLO plane,
+and post-mortem bundles (ISSUE 20).
+
+Covers the on-disk ring's frame format and delta encoding, drop-oldest
+rotation, the satellite-3 crash-recovery contract (kill -9 mid-append →
+every complete frame readable, exactly one torn tail counted on
+``history_frames_truncated_total``), the /history debug route, p999 in
+the percentile plumbing, [telemetry]/[slo] config parsing + validation,
+judge_values / SLOJudge burn windows, the ClusterCollector's SLO
+publication, run_scenario's SLO gate (including the required negative
+test), and bundle collect → gwpost/tracecat --bundle offline render.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import signal
+import struct
+import subprocess
+import sys
+import textwrap
+import urllib.request
+import zlib
+from pathlib import Path
+
+import pytest
+
+from goworld_tpu.config.read_config import SLOConfig
+from goworld_tpu.telemetry.collector import ClusterCollector
+from goworld_tpu.telemetry.history import (
+    MAGIC,
+    HistoryWriter,
+    clear_active_writer,
+    list_segments,
+    read_frames,
+    read_segment,
+    set_active_writer,
+)
+from goworld_tpu.telemetry.metrics import REGISTRY, Registry
+from goworld_tpu.telemetry.postmortem import (
+    bundle_process_spans,
+    collect_bundle,
+    flight_ticks_to_spans,
+    load_bundle,
+    merge_spans,
+)
+from goworld_tpu.telemetry.slo import (
+    SLOJudge,
+    SLOViolation,
+    judge_values,
+    render_verdict,
+)
+
+_REPO = Path(__file__).resolve().parents[1]
+_HEADER = struct.Struct("<III")
+
+
+def _module_counter(name: str) -> float:
+    fam = REGISTRY.snapshot().get(name)
+    if not fam or not fam["series"]:
+        return 0.0
+    return float(fam["series"][0]["value"])
+
+
+# --- the ring itself ----------------------------------------------------------
+
+
+def test_history_ring_roundtrip_deltas_and_p999(tmp_path):
+    reg = Registry()
+    work = reg.counter("work_total")
+    depth = reg.gauge("depth")
+    lat = reg.histogram("lat_seconds")
+    d = str(tmp_path / "game1")
+    w = HistoryWriter(d, "game1", registry=reg)
+
+    work.inc(3)
+    depth.set(7)
+    for _ in range(200):
+        lat.observe(0.0002)
+    lat.observe(0.5)
+    f1 = w.write_frame()
+    work.inc(2)
+    w.write_frame()
+    w.close()  # writes one last frame marked final
+
+    frames, truncated = read_frames(d)
+    assert truncated == 0
+    assert len(frames) == 3
+    assert [f["seq"] for f in frames] == [0, 1, 2]
+    assert frames[0]["process"] == "game1"
+    # Counters are deltas against the previous frame; gauges are values.
+    assert frames[0]["counters"]["work_total"] == [[{}, 3.0]]
+    assert frames[1]["counters"]["work_total"] == [[{}, 2.0]]
+    assert frames[0]["gauges"]["depth"] == [[{}, 7.0]]
+    # Histogram series carry bucket deltas plus live percentiles (p999
+    # included — satellite 2) and are omitted when nothing was observed.
+    hist = frames[0]["hist"]["lat_seconds"][0][1]
+    assert hist["count_d"] == 201
+    assert hist["buckets_d"][-1] == 201  # cumulative +Inf bucket delta
+    assert hist["p999"] >= hist["p99"] >= hist["p50"] > 0
+    assert "lat_seconds" not in frames[1]["hist"]  # no new observations
+    assert frames[2].get("final") is True
+    # The in-memory frame equals the one read back off disk.
+    assert frames[0] == json.loads(json.dumps(f1))
+
+
+def test_history_ring_rotation_drop_oldest(tmp_path):
+    reg = Registry()
+    d = str(tmp_path / "bench")
+    pad = {"pad": "x" * 2000}  # ~2 KB/frame → 2 frames per 4 KB segment
+    before = _module_counter("history_segment_rotations_total")
+    w = HistoryWriter(d, "bench", segment_bytes=4096, segments=2,
+                      registry=reg, health=lambda: pad)
+    for _ in range(12):
+        w.write_frame()
+    w.close(final=False)
+
+    assert len(list_segments(d)) <= 2  # disk bound held
+    frames, truncated = read_frames(d)
+    assert truncated == 0
+    assert frames[-1]["seq"] == 11
+    assert frames[0]["seq"] > 0  # oldest frames were dropped
+    seqs = [f["seq"] for f in frames]
+    assert seqs == list(range(seqs[0], 12))  # contiguous survivors
+    assert _module_counter("history_segment_rotations_total") > before
+
+
+def test_history_ring_survives_kill9_mid_append(tmp_path):
+    """Satellite 3: a child process writes frames, tears the write head
+    (header promising more payload than was flushed), and SIGKILLs
+    itself. Reopening the ring yields every complete frame and exactly
+    one truncated tail, counted on history_frames_truncated_total."""
+    d = str(tmp_path / "game1")
+    child = textwrap.dedent("""
+        import os, signal, struct, sys, zlib
+        from goworld_tpu.telemetry.history import MAGIC, HistoryWriter
+        from goworld_tpu.telemetry.metrics import Registry
+
+        reg = Registry()
+        c = reg.counter("child_work_total")
+        w = HistoryWriter(sys.argv[1], "game1", registry=reg)
+        for _ in range(5):
+            c.inc()
+            w.write_frame()
+        # Crash mid-append: the header claims 64 payload bytes but only
+        # 7 hit the disk before the kill.
+        w._f.write(struct.pack("<III", MAGIC, 64, zlib.crc32(b"x")))
+        w._f.write(b"partial")
+        w._f.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(_REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, "-c", child, d],
+                          env=env, cwd=str(_REPO), timeout=120)
+    assert proc.returncode == -signal.SIGKILL
+
+    before = _module_counter("history_frames_truncated_total")
+    frames, truncated = read_frames(d)
+    assert truncated == 1
+    assert _module_counter("history_frames_truncated_total") == before + 1
+    assert len(frames) == 5  # every completed frame survived the kill
+    assert [f["seq"] for f in frames] == list(range(5))
+    assert all(f["counters"]["child_work_total"] == [[{}, 1.0]]
+               for f in frames)
+
+
+def test_history_reader_tolerates_every_torn_shape(tmp_path):
+    good = json.dumps({"seq": 0}).encode()
+    frame = _HEADER.pack(MAGIC, len(good), zlib.crc32(good)) + good
+
+    short = tmp_path / "seg-00000000"  # trailing short header
+    short.write_bytes(frame + b"\x01\x02")
+    assert read_segment(str(short)) == ([{"seq": 0}], 1)
+
+    badmagic = tmp_path / "seg-00000001"
+    badmagic.write_bytes(_HEADER.pack(0xDEADBEEF, 4, 0) + b"abcd")
+    assert read_segment(str(badmagic)) == ([], 1)
+
+    badcrc = tmp_path / "seg-00000002"  # CRC mismatch ends the segment
+    badcrc.write_bytes(frame + _HEADER.pack(MAGIC, len(good), 123) + good)
+    assert read_segment(str(badcrc)) == ([{"seq": 0}], 1)
+
+    shortpay = tmp_path / "seg-00000003"  # payload shorter than promised
+    shortpay.write_bytes(_HEADER.pack(MAGIC, 64, zlib.crc32(good)) + good)
+    assert read_segment(str(shortpay)) == ([], 1)
+
+    frames, truncated = read_frames(str(tmp_path))
+    assert len(frames) == 2 and truncated == 4
+
+
+def test_history_debug_route(tmp_path):
+    from goworld_tpu.utils.debug_http import DebugHTTPServer
+
+    async def run():
+        srv = DebugHTTPServer("127.0.0.1", 0)
+        await srv.start()
+
+        def fetch():
+            url = f"http://127.0.0.1:{srv.port}/history"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                return r.status, json.loads(r.read())
+
+        status, doc = await asyncio.to_thread(fetch)
+        assert status == 200
+        assert "history_dir unset" in doc["note"]  # no writer registered
+
+        reg = Registry()
+        w = HistoryWriter(str(tmp_path / "d"), "dispatcher1", registry=reg)
+        w.write_frame()
+        set_active_writer(w)
+        try:
+            status, doc = await asyncio.to_thread(fetch)
+            assert status == 200
+            assert doc["process"] == "dispatcher1"
+            assert doc["frames_written"] == 1
+            assert doc["recent"][-1]["seq"] == 0
+        finally:
+            clear_active_writer(w)
+            w.close(final=False)
+        await srv.stop()
+
+    asyncio.run(run())
+
+
+# --- p999 in the percentile plumbing (satellite 2) ----------------------------
+
+
+def test_histogram_p999_snapshot_and_render():
+    reg = Registry()
+    h = reg.histogram("resp_seconds")
+    for _ in range(2000):
+        h.observe(0.0002)
+    h.observe(3.0)
+    h.observe(3.0)
+    snap = reg.snapshot()["resp_seconds"]["series"][0]
+    # The two 3 s outliers are past the 99.9th percentile's rank but not
+    # the 99th's — p999 lands in a strictly higher bucket.
+    assert snap["p999"] > snap["p99"] >= snap["p50"]
+    text = reg.render()
+    assert "resp_seconds_p999" in text
+
+
+# --- [telemetry] history keys + [slo] config ---------------------------------
+
+
+def test_config_history_and_slo_sections(tmp_path):
+    from goworld_tpu.config import read_config
+
+    ini = (
+        "[deployment]\ndispatchers = 1\ngames = 1\ngates = 1\n"
+        "[telemetry]\nhistory_dir = /tmp/gw-history\n"
+        "history_interval = 0.5\nhistory_segment_bytes = 8192\n"
+        "history_segments = 4\n"
+        "[slo]\ntick_p99_budget = 0.05\ndelivery_p99_budget = 0.02\n"
+        "bot_error_rate = 0\nsteady_state_retraces = 0\n"
+        "error_budget = 0.1\nburn_short_polls = 3\nburn_long_polls = 30\n")
+    p = tmp_path / "slo.ini"
+    p.write_text(ini)
+    read_config.set_config_file(str(p))
+    try:
+        cfg = read_config.get()
+        t = cfg.telemetry
+        assert t.history_dir == "/tmp/gw-history"
+        assert t.history_interval == 0.5
+        assert t.history_segment_bytes == 8192
+        assert t.history_segments == 4
+        s = cfg.slo
+        assert s.enabled()
+        assert s.tick_p99_budget == 0.05
+        assert s.delivery_p99_budget == 0.02
+        assert s.bot_error_rate == 0.0
+        assert s.steady_state_retraces == 0
+        assert s.error_budget == 0.1
+        assert (s.burn_short_polls, s.burn_long_polls) == (3, 30)
+    finally:
+        read_config.set_config_file(None)
+    # No [slo] section → every budget unset → the plane is off.
+    assert not SLOConfig().enabled()
+
+    for needle, repl, match in [
+        ("history_segment_bytes = 8192", "history_segment_bytes = 100",
+         "history_segment_bytes"),
+        ("history_segments = 4", "history_segments = 1",
+         "history_segments"),
+        ("error_budget = 0.1", "error_budget = 0", "error_budget"),
+        ("burn_long_polls = 30", "burn_long_polls = 2", "burn windows"),
+        ("tick_p99_budget = 0.05", "tick_p99_budget = -1", "must be >= 0"),
+    ]:
+        bad = tmp_path / "bad.ini"
+        bad.write_text(ini.replace(needle, repl))
+        read_config.set_config_file(str(bad))
+        try:
+            with pytest.raises(ValueError, match=match):
+                read_config.get()
+        finally:
+            read_config.set_config_file(None)
+
+
+def test_r6_covers_history_and_slo_keys():
+    from goworld_tpu.analysis.rules import _sample_keys
+
+    fams, _lines = _sample_keys(str(_REPO))
+    assert {"history_dir", "history_interval", "history_segment_bytes",
+            "history_segments"} <= fams["telemetry"]
+    assert {"tick_p99_budget", "delivery_p99_budget", "bot_error_rate",
+            "steady_state_retraces", "error_budget", "burn_short_polls",
+            "burn_long_polls"} <= fams["slo"]
+
+
+# --- the SLO plane ------------------------------------------------------------
+
+
+def test_judge_values_and_render_verdict():
+    slo = SLOConfig(tick_p99_budget=0.001, steady_state_retraces=0)
+    v = judge_values(slo, tick_p99=0.01, steady_state_retraces=0)
+    assert v["ok"] is False
+    assert v["budgets"]["tick_p99"]["ok"] is False
+    assert v["budgets"]["steady_state_retraces"]["ok"] is True
+    assert "delivery_p99" not in v["budgets"]  # unset budgets not judged
+    line = render_verdict(v)
+    assert "tick_p99=0.01 (budget 0.001) VIOLATED" in line
+    assert "steady_state_retraces=0 (budget 0) OK" in line
+    # No data is not a violation.
+    assert judge_values(slo, tick_p99=None)["ok"] is True
+
+
+def _procs_with_tick_p99(p99: float) -> dict:
+    return {"game1": {"metrics": {"game_tick_phase_seconds": {"series": [
+        {"labels": {"phase": "total"}, "count": 10, "p99": p99}]}}}}
+
+
+def test_slo_judge_burn_windows_compliance_and_alerts():
+    slo = SLOConfig(tick_p99_budget=0.001, bot_error_rate=0.0,
+                    error_budget=0.5, burn_short_polls=2,
+                    burn_long_polls=4)
+    judge = SLOJudge(slo)
+    for _ in range(4):
+        judge.judge_poll(_procs_with_tick_p99(0.0001))
+    s = judge.summary()
+    assert s["ok"] is True and s["polls"] == 4
+    b = s["budgets"]["tick_p99"]
+    assert b["compliance"] == 1.0 and b["burn_long"] == 0.0
+    # bot_error_rate has no cluster-side metric: declared, never judged.
+    assert s["budgets"]["bot_error_rate"]["note"]
+    assert judge.alerts() == []
+
+    judge.judge_poll(_procs_with_tick_p99(0.5))
+    judge.judge_poll(_procs_with_tick_p99(0.5))
+    s = judge.summary()
+    b = s["budgets"]["tick_p99"]
+    assert s["ok"] is False
+    # Long window (maxlen 4) holds [0,0,1,1]: 50% violation rate over a
+    # 50% error budget = burn 1.0; the short window is fully violated.
+    assert b["compliance"] == 0.5
+    assert b["burn_long"] == 1.0
+    assert b["burn_short"] == 2.0
+    assert any("SLO tick_p99 out of budget" in a for a in judge.alerts())
+
+
+def test_collector_publishes_slo_summary_and_alerts():
+    async def run():
+        async def game():
+            return {"health": {"kind": "game", "id": 1, "entities": 4,
+                               "clients": 0, "queue_depth": 0},
+                    "metrics": {
+                        "game_tick_phase_seconds": {
+                            "type": "histogram",
+                            "series": [{"labels": {"phase": "total"},
+                                        "count": 50, "p99": 0.25}]},
+                        "aoi_link_bytes_total": {
+                            "type": "counter",
+                            "series": [
+                                {"labels": {"tier": "halo",
+                                            "link": "0->1"}, "value": 800},
+                                {"labels": {"tier": "halo",
+                                            "link": "1->0"}, "value": 200},
+                                {"labels": {"tier": "ici-allgather",
+                                            "link": "dev1"},
+                                 "value": 5000}]}}}
+
+        slo = SLOConfig(tick_p99_budget=0.001, error_budget=0.01,
+                        burn_short_polls=1, burn_long_polls=2)
+        coll = ClusterCollector([("game1", game)], interval=0.05, slo=slo)
+        await coll.poll_once()
+        v = coll.view()
+        s = v["summary"]["slo"]
+        assert s["enabled"] is True and s["ok"] is False
+        assert s["budgets"]["tick_p99"]["observed"] == 0.25
+        assert s["budgets"]["tick_p99"]["burn_short"] >= 1.0
+        assert any(a.startswith("SLO tick_p99")
+                   for a in v["summary"]["alerts"])
+        # ROADMAP item 5: per-link comms counters roll up per tier.
+        comms = v["summary"]["comms"]
+        assert comms["links"] == 3
+        assert comms["bytes"] == {"halo": 1000, "ici-allgather": 5000}
+
+    asyncio.run(run())
+
+
+def test_run_scenario_slo_gate(tmp_path):
+    """Acceptance: an [slo] scenario run publishes the verdict in its
+    headline and fails (negative test) when the budget sits below the
+    observed tick p99."""
+    from goworld_tpu.scenarios.runner import run_scenario
+
+    with pytest.raises(SLOViolation, match="tick_p99.*VIOLATED"):
+        run_scenario("battle_royale", engine="batched", ticks_scale=0.25,
+                     slo=SLOConfig(tick_p99_budget=1e-12))
+
+    headline = run_scenario(
+        "battle_royale", engine="batched", ticks_scale=0.25,
+        slo=SLOConfig(tick_p99_budget=100.0, steady_state_retraces=0))
+    verdict = headline["slo"]
+    assert verdict["ok"] is True
+    assert verdict["budgets"]["tick_p99"]["observed"] > 0
+    assert verdict["budgets"]["steady_state_retraces"]["ok"] is True
+
+
+# --- post-mortem bundles ------------------------------------------------------
+
+
+class _FakeFlight:
+    def __init__(self, ticks: list[dict]) -> None:
+        self._ticks = ticks
+
+    def ticks(self) -> list[dict]:
+        return list(self._ticks)
+
+
+def _tick_rows(n: int) -> list[dict]:
+    return [{"ts": 100.0 + i, "total_ms": 5.0,
+             "phases_ms": {"aoi": 2.0, "sync_send": 1.0},
+             "entities": 42} for i in range(n)]
+
+
+def test_flight_ticks_to_spans_layout():
+    spans = flight_ticks_to_spans(_tick_rows(1))
+    assert [s["name"] for s in spans] == [
+        "tick.total", "tick.aoi", "tick.sync_send"]
+    root = spans[0]
+    assert root["args"]["entities"] == 42
+    assert root["dur"] == pytest.approx(0.005)
+    # Phases are consecutive child intervals under the tick root.
+    assert spans[1]["parent"] == root["span"]
+    assert spans[2]["ts"] == pytest.approx(spans[1]["ts"] + spans[1]["dur"])
+
+
+def test_bundle_collect_load_and_offline_renders(tmp_path):
+    hroot = tmp_path / "history"
+    reg = Registry()
+    reg.counter("deaths_total").inc(2)
+    ticks = _tick_rows(3)
+    w = HistoryWriter(str(hroot / "game1"), "game1", registry=reg,
+                      flight=_FakeFlight(ticks))
+    w.write_frame()
+    w.close()  # final frame — the dead process's ring speaks for it
+
+    disp_spans = [{"name": "dispatcher.route", "ts": 100.5, "dur": 0.002,
+                   "trace": 5, "span": 1, "parent": 0}]
+    bdir = tmp_path / "bundle"
+    manifest = collect_bundle(
+        str(bdir), reason="test-crash", history_dir=str(hroot),
+        cluster_view={"summary": {"reporting": 1}},
+        process_spans={"dispatcher1": disp_spans},
+        flights={"game1": {"recent": ticks}})
+    assert manifest["reason"] == "test-crash"
+    assert manifest["processes"] == ["dispatcher1", "game1"]
+
+    box = load_bundle(str(bdir))
+    game = box["processes"]["game1"]
+    assert game["frames"][0]["flight"] == ticks
+    assert game["frames"][0]["counters"]["deaths_total"] == [[{}, 2.0]]
+
+    # The merged offline timeline includes the ring's flight-derived
+    # spans next to the scraped dispatcher spans.
+    spans = dict(bundle_process_spans(str(bdir)))
+    assert any(s["name"] == "tick.total" for s in spans["game1"])
+    assert any(s["name"] == "dispatcher.route" for s in spans["dispatcher1"])
+    merged = merge_spans(sorted(spans.items()))
+    names = {e["name"] for e in merged["traceEvents"] if e.get("ph") == "X"}
+    assert {"tick.total", "tick.aoi", "dispatcher.route"} <= names
+
+    # gwpost --bundle: one-command offline render into the bundle.
+    from goworld_tpu.tools import gwpost
+
+    assert gwpost.main(["--bundle", str(bdir)]) == 0
+    trace = json.loads((bdir / "trace.json").read_text())
+    assert any(e.get("name") == "tick.total"
+               for e in trace["traceEvents"])
+
+    # tracecat --bundle: the span CLI accepts the same bundle offline.
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tracecat_bundle_test", _REPO / "tools" / "tracecat.py")
+    tracecat = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tracecat)
+    out = tmp_path / "tc.json"
+    assert tracecat.main(["--bundle", str(bdir), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert any(e.get("name") == "dispatcher.route"
+               for e in doc["traceEvents"])

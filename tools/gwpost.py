@@ -1,0 +1,13 @@
+"""Thin launcher for ``goworld_tpu.tools.gwpost`` (kept beside tracecat
+and gwtop so every operator console lives in one directory; the real
+implementation is importable from the deployed package — run it as
+``python -m goworld_tpu.tools.gwpost`` in production)."""
+
+from __future__ import annotations
+
+import sys
+
+from goworld_tpu.tools.gwpost import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -15,10 +15,16 @@ Usage:
 
     python tools/tracecat.py [-configfile goworld.ini] [-o trace.json]
                              [--trace-id HEX]   # keep one trace only
+    python tools/tracecat.py --bundle DIR [-o trace.json]
+                             # offline: a gwpost post-mortem bundle as
+                             # the span source — no process need be alive
 
 Load the output at https://ui.perfetto.dev (or chrome://tracing). Spans
 share a host clock (same-machine deployment), so cross-process ordering
 is honest to ~µs; the stdout summary names each complete trace seen.
+In ``--bundle`` mode the spans come from the bundle's scraped rings plus
+spans synthesized from each process's history-ring flight rows — the
+killed process's final ticks included.
 """
 
 from __future__ import annotations
@@ -58,16 +64,12 @@ def merge(process_spans: list[tuple[str, list[dict]]],
 
     ``process_spans`` = [(process_name, spans)] — pid is the list index
     (stable, so re-running yields comparable files). Optionally filters
-    to a single trace id.
+    to a single trace id. (Shared with the post-mortem renderer —
+    telemetry/postmortem.py owns the implementation.)
     """
-    from goworld_tpu.telemetry.tracing import chrome_events
+    from goworld_tpu.telemetry.postmortem import merge_spans
 
-    events: list[dict] = []
-    for pid, (name, spans) in enumerate(process_spans, start=1):
-        if trace_id is not None:
-            spans = [s for s in spans if s["trace"] == trace_id]
-        events.extend(chrome_events(spans, name, pid))
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    return merge_spans(process_spans, trace_id=trace_id)
 
 
 def trace_summary(process_spans: list[tuple[str, list[dict]]]) -> dict:
@@ -97,31 +99,44 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("-o", "--out", default="trace.json")
     parser.add_argument("--trace-id", default="",
                         help="keep only this trace id (hex)")
+    parser.add_argument("--bundle", default="",
+                        help="offline source: a gwpost post-mortem "
+                             "bundle directory instead of live HTTP")
     args = parser.parse_args(argv)
 
-    from goworld_tpu.config import get as get_config, set_config_file
-
-    if args.configfile:
-        set_config_file(args.configfile)
-    cfg = get_config()
-    endpoints = collect_endpoints(cfg)
-    if not endpoints:
-        print("tracecat: no process in the config has an http_addr",
-              file=sys.stderr)
-        return 1
-
     process_spans: list[tuple[str, list[dict]]] = []
-    for name, addr in endpoints:
-        try:
-            ring = scrape(addr)
-        except Exception as exc:
-            print(f"tracecat: {name} @ {addr} unreachable: {exc}",
+    if args.bundle:
+        from goworld_tpu.telemetry.postmortem import bundle_process_spans
+
+        process_spans = bundle_process_spans(args.bundle)
+        if not process_spans:
+            print(f"tracecat: bundle {args.bundle} holds no spans",
                   file=sys.stderr)
-            continue
-        process_spans.append((ring.get("process") or name, ring["spans"]))
-    if not process_spans:
-        print("tracecat: no process reachable", file=sys.stderr)
-        return 1
+            return 1
+    else:
+        from goworld_tpu.config import get as get_config, set_config_file
+
+        if args.configfile:
+            set_config_file(args.configfile)
+        cfg = get_config()
+        endpoints = collect_endpoints(cfg)
+        if not endpoints:
+            print("tracecat: no process in the config has an http_addr",
+                  file=sys.stderr)
+            return 1
+
+        for name, addr in endpoints:
+            try:
+                ring = scrape(addr)
+            except Exception as exc:
+                print(f"tracecat: {name} @ {addr} unreachable: {exc}",
+                      file=sys.stderr)
+                continue
+            process_spans.append(
+                (ring.get("process") or name, ring["spans"]))
+        if not process_spans:
+            print("tracecat: no process reachable", file=sys.stderr)
+            return 1
 
     tid = int(args.trace_id, 16) if args.trace_id else None
     out = merge(process_spans, trace_id=tid)
